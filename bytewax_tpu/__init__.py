@@ -1,0 +1,13 @@
+"""bytewax_tpu: a TPU-native stateful stream-processing framework.
+
+A Python ``Dataflow``/operator API (map/filter/join/windowing/stateful
+operators, partitioned sources and sinks, epoch-based checkpoint/resume
+and rescaling) with an execution engine designed for TPUs: eligible
+dataflow segments are lowered to JAX/XLA programs over a device mesh,
+keyed shuffles become ``all_to_all`` collectives over ICI, and per-key
+operator state lives as key-hash-sharded pytrees in HBM.
+
+Capability parity target: bytewax (see ``SURVEY.md``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Pre-built input and output connectors."""

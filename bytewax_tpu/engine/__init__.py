@@ -1,0 +1,2 @@
+"""Execution engine: plan flattening, host driver, recovery store, and
+the XLA acceleration tier."""

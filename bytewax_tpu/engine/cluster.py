@@ -1,0 +1,44 @@
+"""Multi-process cluster execution.
+
+The reference forms a TCP mesh between processes
+(``/root/reference/src/run.rs:257-351``).  The TPU-native equivalent
+is multi-host JAX: one driver process per host, device collectives
+over ICI/DCN via ``jax.distributed``.  Host-side epoch/commit
+coordination rides the recovery store.
+
+Round-1 scope: single-host (all worker lanes in-process).  This module
+holds the multi-host entrypoint surface; ``jax.distributed``
+initialization lands with the multi-slice work.
+"""
+
+from datetime import timedelta
+from typing import Any, List, Optional
+
+from bytewax_tpu.dataflow import Dataflow
+
+__all__ = ["cluster_proc_main"]
+
+
+def cluster_proc_main(
+    flow: Dataflow,
+    addresses: List[str],
+    proc_id: int,
+    *,
+    epoch_interval: Optional[timedelta] = None,
+    recovery_config: Optional[Any] = None,
+    worker_count_per_proc: int = 1,
+) -> None:
+    """Run this process's share of a multi-process cluster.
+
+    Process 0 is the JAX distributed coordinator; ``addresses[0]`` is
+    used as the coordinator address.
+    """
+    # Running the full lane set in every process would duplicate
+    # every read and write; per-process partition ownership +
+    # jax.distributed lands with the multi-host milestone.
+    msg = (
+        "multi-process clusters are not implemented yet; run all "
+        "worker lanes in one process (cluster_main with addresses=[]) "
+        "or use the device mesh for scale-out"
+    )
+    raise NotImplementedError(msg)

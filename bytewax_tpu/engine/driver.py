@@ -1,0 +1,764 @@
+"""Host-tier execution engine.
+
+A single-process driver interprets the 9-core-operator plan with *W*
+logical worker lanes (the analog of the reference's worker threads,
+``/root/reference/src/worker.rs:68-159``): source partitions and keyed
+state are deterministically assigned to lanes, keyed exchanges re-tag
+lanes exactly like the reference's ``routed_exchange``
+(``src/timely.rs:806-812``), and a global epoch clock drives eager
+processing, ``notify_at`` wakeups, EOF, and snapshot-at-epoch-close
+semantics (the reference's ``EagerNotificator``,
+``src/timely.rs:169-270``).
+
+This tier is the *correctness oracle* and the arbitrary-Python-UDF
+path.  The XLA tier (:mod:`bytewax_tpu.engine.xla`) accelerates
+eligible segments of the same plan on the device mesh; both tiers share
+this driver's epoch/recovery bookkeeping.
+"""
+
+import pickle
+import time
+import zlib
+from datetime import datetime, timedelta, timezone
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from bytewax_tpu.dataflow import Dataflow, Operator
+from bytewax_tpu.engine.flatten import Plan, flatten
+from bytewax_tpu.engine.recovery_store import RecoveryStore, ResumeFrom
+from bytewax_tpu.inputs import (
+    AbortExecution,
+    DynamicSource,
+    FixedPartitionedSource,
+)
+from bytewax_tpu.outputs import DynamicSink, FixedPartitionedSink
+
+__all__ = ["cluster_main", "run_main"]
+
+_EMPTY_COOLDOWN = timedelta(milliseconds=1)
+_DEFAULT_EPOCH_INTERVAL = timedelta(seconds=10)
+
+Entry = Tuple[int, List[Any]]  # (worker lane, items)
+
+
+def _route_hash(key: str) -> int:
+    """Deterministic cross-process key hash (like the reference's use
+    of a consistent hash for routing; builtin ``hash`` is salted)."""
+    return zlib.adler32(key.encode("utf-8"))
+
+
+def _now() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def _extract_kv(item: Any, step_id: str) -> Tuple[str, Any]:
+    try:
+        k, v = item
+    except (TypeError, ValueError) as ex:
+        msg = (
+            f"step {step_id!r} requires `(key, value)` 2-tuple from "
+            f"upstream for routing; got a {type(item)!r} instead"
+        )
+        raise TypeError(msg) from ex
+    if not isinstance(k, str):
+        msg = (
+            f"step {step_id!r} requires `str` keys in `(key, value)` "
+            f"from upstream; got a {type(k)!r} instead"
+        )
+        raise TypeError(msg)
+    return k, v
+
+
+class _Abort(Exception):
+    """Internal: a source requested hard abort."""
+
+
+class _StepError(RuntimeError):
+    """User code in a step raised; carries context like the
+    reference's error chaining (``src/errors.rs``)."""
+
+
+def _reraise(step_id: str, what: str, ex: BaseException) -> None:
+    msg = f"error calling {what} in step {step_id!r}"
+    note = getattr(ex, "add_note", None)
+    if note is not None:
+        try:
+            note(msg)
+        except TypeError:
+            pass
+    raise ex
+
+
+class _OpRt:
+    """Base runtime for one core operator."""
+
+    def __init__(self, op: Operator, driver: "_Driver"):
+        self.op = op
+        self.driver = driver
+        self.eof = False
+        #: port name -> queued entries
+        self.queues: Dict[str, List[Entry]] = {
+            port: [] for port in op.ups.keys()
+        }
+
+    def queued(self) -> bool:
+        return any(q for q in self.queues.values())
+
+    def ups_eof(self) -> bool:
+        ups = self.op.up_streams()
+        return all(
+            self.driver.rts[self.driver.plan.producer[s.stream_id]].eof
+            for s in ups
+        )
+
+    def drain(self) -> None:
+        for port, q in self.queues.items():
+            if q:
+                entries, self.queues[port] = q, []
+                self.process(port, entries)
+
+    def process(self, port: str, entries: List[Entry]) -> None:
+        raise NotImplementedError()
+
+    def advance(self, now: datetime) -> None:
+        """Timer-driven work (notify wakeups); default none."""
+
+    def on_upstream_eof(self) -> None:
+        """All upstreams are EOF and queues are drained."""
+
+    def emit(self, port: str, entry: Entry) -> None:
+        if not entry[1]:
+            return
+        stream = self.op.downs[port]
+        self.driver.route(stream.stream_id, entry)
+
+    # -- epoch snapshot hooks ---------------------------------------------
+
+    def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
+        """Return (state_key, state-or-None) changed this epoch."""
+        return []
+
+    def close(self) -> None:
+        """Shutdown cleanup at clean EOF."""
+
+
+class _InputRt(_OpRt):
+    def __init__(self, op: Operator, driver: "_Driver"):
+        super().__init__(op, driver)
+        source = op.conf["source"]
+        self.step_id = op.step_id
+        self.parts: Dict[str, Any] = {}
+        self.part_worker: Dict[str, int] = {}
+        self.next_awake: Dict[str, Optional[datetime]] = {}
+        self.pending_snaps: List[Tuple[str, Any]] = []
+        if isinstance(source, FixedPartitionedSource):
+            names = sorted(set(source.list_parts()))
+            for i, name in enumerate(names):
+                resume = driver.resume_state(op.step_id, name)
+                try:
+                    part = source.build_part(op.step_id, name, resume)
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(op.step_id, "`build_part`", ex)
+                self.parts[name] = part
+                self.part_worker[name] = i % driver.worker_count
+                # Respect the partition's initial schedule (e.g.
+                # SimplePollingSource align_to), like the reference
+                # does right after build_part (src/inputs.rs:354-362).
+                self.next_awake[name] = part.next_awake()
+            self.stateful = True
+        elif isinstance(source, DynamicSource):
+            for w in range(driver.worker_count):
+                name = f"worker-{w}"
+                try:
+                    part = source.build(op.step_id, w, driver.worker_count)
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(op.step_id, "`build`", ex)
+                self.parts[name] = part
+                self.part_worker[name] = w
+                self.next_awake[name] = part.next_awake()
+            self.stateful = False
+        else:
+            msg = (
+                f"source of step {op.step_id!r} must be a "
+                "FixedPartitionedSource or DynamicSource; "
+                f"got {source!r}"
+            )
+            raise TypeError(msg)
+
+    def process(self, port: str, entries: List[Entry]) -> None:
+        raise AssertionError("input ops have no upstreams")
+
+    def poll(self, now: datetime) -> bool:
+        progressed = False
+        for name in list(self.parts.keys()):
+            part = self.parts[name]
+            na = self.next_awake[name]
+            if na is not None and na > now:
+                continue
+            try:
+                batch = part.next_batch()
+                batch = batch if isinstance(batch, list) else list(batch)
+            except StopIteration:
+                if self.stateful:
+                    self.pending_snaps.append((name, part.snapshot()))
+                part.close()
+                del self.parts[name]
+                progressed = True
+                continue
+            except AbortExecution:
+                raise _Abort() from None
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(self.op.step_id, "`next_batch`", ex)
+            if batch:
+                self.emit(
+                    "down", (self.part_worker[name], batch)
+                )
+                progressed = True
+            part_na = part.next_awake()
+            if part_na is None and not batch:
+                part_na = now + _EMPTY_COOLDOWN
+            self.next_awake[name] = part_na
+        if not self.parts:
+            self.eof = True
+        return progressed
+
+    def next_poll_at(self) -> Optional[datetime]:
+        times = [t for t in self.next_awake.values() if t is not None]
+        if len(times) < len(self.parts):
+            return None  # some part is ready now
+        return min(times) if times else None
+
+    def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
+        if not self.stateful:
+            return []
+        snaps, self.pending_snaps = self.pending_snaps, []
+        for name, part in self.parts.items():
+            try:
+                snaps.append((name, part.snapshot()))
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(self.op.step_id, "`snapshot`", ex)
+        return snaps
+
+    def close(self) -> None:
+        for part in self.parts.values():
+            part.close()
+        self.parts.clear()
+
+
+class _FlatMapBatchRt(_OpRt):
+    def __init__(self, op: Operator, driver: "_Driver"):
+        super().__init__(op, driver)
+        self.mapper: Callable = op.conf["mapper"]
+
+    def process(self, port: str, entries: List[Entry]) -> None:
+        for w, items in entries:
+            try:
+                out = list(self.mapper(items))
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(self.op.step_id, "the mapper", ex)
+            self.emit("down", (w, out))
+
+
+class _BranchRt(_OpRt):
+    def __init__(self, op: Operator, driver: "_Driver"):
+        super().__init__(op, driver)
+        self.predicate: Callable = op.conf["predicate"]
+
+    def process(self, port: str, entries: List[Entry]) -> None:
+        for w, items in entries:
+            trues, falses = [], []
+            for item in items:
+                try:
+                    keep = self.predicate(item)
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(self.op.step_id, "the predicate", ex)
+                (trues if keep else falses).append(item)
+            self.emit("trues", (w, trues))
+            self.emit("falses", (w, falses))
+
+
+class _MergeRt(_OpRt):
+    def process(self, port: str, entries: List[Entry]) -> None:
+        for entry in entries:
+            self.emit("down", entry)
+
+
+class _RedistributeRt(_OpRt):
+    def __init__(self, op: Operator, driver: "_Driver"):
+        super().__init__(op, driver)
+        self._rr = 0
+
+    def process(self, port: str, entries: List[Entry]) -> None:
+        w_count = self.driver.worker_count
+        buckets: Dict[int, List[Any]] = {}
+        for _w, items in entries:
+            for item in items:
+                buckets.setdefault(self._rr % w_count, []).append(item)
+                self._rr += 1
+        for w, items in buckets.items():
+            self.emit("down", (w, items))
+
+
+class _InspectDebugRt(_OpRt):
+    def __init__(self, op: Operator, driver: "_Driver"):
+        super().__init__(op, driver)
+        self.inspector: Callable = op.conf["inspector"]
+
+    def process(self, port: str, entries: List[Entry]) -> None:
+        epoch = self.driver.epoch
+        for w, items in entries:
+            for item in items:
+                try:
+                    self.inspector(self.op.step_id, item, epoch, w)
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(self.op.step_id, "the inspector", ex)
+            self.emit("down", (w, items))
+
+
+class _NoopRt(_OpRt):
+    def process(self, port: str, entries: List[Entry]) -> None:
+        for entry in entries:
+            self.emit("down", entry)
+
+
+class _StatefulBatchRt(_OpRt):
+    def __init__(self, op: Operator, driver: "_Driver"):
+        super().__init__(op, driver)
+        self.builder: Callable = op.conf["builder"]
+        self.logics: Dict[str, Any] = {}
+        self.sched: Dict[str, datetime] = {}
+        self.awoken: Set[str] = set()
+        # Eagerly rebuild logics for every resumed key so EOF-driven
+        # emission (fold_final etc.) fires even with no new input
+        # (reference loads snaps into logics at startup:
+        # src/operators.rs:976-1006).
+        for key, state in driver.resume_states(op.step_id).items():
+            logic = self._build(state)
+            self.logics[key] = logic
+            self._resched(key, logic)
+
+    def _build(self, state: Optional[Any]) -> Any:
+        try:
+            return self.builder(state)
+        except BaseException as ex:  # noqa: BLE001
+            _reraise(self.op.step_id, "the logic builder", ex)
+
+    def _resched(self, key: str, logic: Any) -> None:
+        try:
+            at = logic.notify_at()
+        except BaseException as ex:  # noqa: BLE001
+            _reraise(self.op.step_id, "`notify_at`", ex)
+        if at is not None:
+            if at.tzinfo is None:
+                msg = (
+                    f"`notify_at` return value in step {self.op.step_id!r} "
+                    "must be timezone-aware"
+                )
+                raise ValueError(msg)
+            self.sched[key] = at
+        else:
+            self.sched.pop(key, None)
+
+    def _handle(
+        self, key: str, emits: Any, discard: bool, out: Dict[int, List[Any]]
+    ) -> None:
+        w_home = _route_hash(key) % self.driver.worker_count
+        bucket = out.setdefault(w_home, [])
+        for x in emits:
+            bucket.append((key, x))
+        self.awoken.add(key)
+        if discard:
+            self.logics.pop(key, None)
+            self.sched.pop(key, None)
+        else:
+            logic = self.logics.get(key)
+            if logic is not None:
+                self._resched(key, logic)
+
+    def _flush(self, out: Dict[int, List[Any]]) -> None:
+        for w, items in out.items():
+            self.emit("down", (w, items))
+
+    def process(self, port: str, entries: List[Entry]) -> None:
+        out: Dict[int, List[Any]] = {}
+        for _w, items in entries:
+            groups: Dict[str, List[Any]] = {}
+            for item in items:
+                k, v = _extract_kv(item, self.op.step_id)
+                groups.setdefault(k, []).append(v)
+            for key, values in groups.items():
+                logic = self.logics.get(key)
+                if logic is None:
+                    logic = self._build(None)
+                    self.logics[key] = logic
+                try:
+                    emits, discard = logic.on_batch(values)
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(self.op.step_id, "`on_batch`", ex)
+                self._handle(key, emits, discard, out)
+        self._flush(out)
+
+    def advance(self, now: datetime) -> None:
+        due = sorted(
+            (key for key, at in self.sched.items() if at <= now)
+        )
+        if not due:
+            return
+        out: Dict[int, List[Any]] = {}
+        for key in due:
+            logic = self.logics.get(key)
+            if logic is None:
+                self.sched.pop(key, None)
+                continue
+            self.sched.pop(key, None)
+            try:
+                emits, discard = logic.on_notify()
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(self.op.step_id, "`on_notify`", ex)
+            self._handle(key, emits, discard, out)
+        self._flush(out)
+
+    def on_upstream_eof(self) -> None:
+        out: Dict[int, List[Any]] = {}
+        for key in sorted(self.logics.keys()):
+            logic = self.logics[key]
+            try:
+                emits, discard = logic.on_eof()
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(self.op.step_id, "`on_eof`", ex)
+            self._handle(key, emits, discard, out)
+        self._flush(out)
+
+    def next_notify_at(self) -> Optional[datetime]:
+        return min(self.sched.values()) if self.sched else None
+
+    def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
+        snaps: List[Tuple[str, Optional[Any]]] = []
+        for key in sorted(self.awoken):
+            logic = self.logics.get(key)
+            if logic is None:
+                snaps.append((key, None))
+            else:
+                try:
+                    snaps.append((key, logic.snapshot()))
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(self.op.step_id, "`snapshot`", ex)
+        self.awoken.clear()
+        return snaps
+
+
+class _OutputRt(_OpRt):
+    def __init__(self, op: Operator, driver: "_Driver"):
+        super().__init__(op, driver)
+        sink = op.conf["sink"]
+        self.parts: Dict[str, Any] = {}
+        self.pending_snaps: List[Tuple[str, Any]] = []
+        if isinstance(sink, FixedPartitionedSink):
+            self.stateful = True
+            self.part_names = sorted(set(sink.list_parts()))
+            if not self.part_names:
+                msg = f"sink of step {op.step_id!r} has no partitions"
+                raise ValueError(msg)
+            self.part_fn = sink.part_fn
+            for name in self.part_names:
+                resume = driver.resume_state(op.step_id, name)
+                try:
+                    self.parts[name] = sink.build_part(
+                        op.step_id, name, resume
+                    )
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(op.step_id, "`build_part`", ex)
+        elif isinstance(sink, DynamicSink):
+            self.stateful = False
+            for w in range(driver.worker_count):
+                try:
+                    self.parts[f"worker-{w}"] = sink.build(
+                        op.step_id, w, driver.worker_count
+                    )
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(op.step_id, "`build`", ex)
+        else:
+            msg = (
+                f"sink of step {op.step_id!r} must be a "
+                f"FixedPartitionedSink or DynamicSink; got {sink!r}"
+            )
+            raise TypeError(msg)
+
+    def process(self, port: str, entries: List[Entry]) -> None:
+        if self.stateful:
+            count = len(self.part_names)
+            for _w, items in entries:
+                buckets: Dict[str, List[Any]] = {}
+                for item in items:
+                    k, v = _extract_kv(item, self.op.step_id)
+                    try:
+                        idx = self.part_fn(k) % count
+                    except BaseException as ex:  # noqa: BLE001
+                        _reraise(self.op.step_id, "`part_fn`", ex)
+                    buckets.setdefault(self.part_names[idx], []).append(v)
+                for name, values in buckets.items():
+                    try:
+                        self.parts[name].write_batch(values)
+                    except BaseException as ex:  # noqa: BLE001
+                        _reraise(self.op.step_id, "`write_batch`", ex)
+        else:
+            for w, items in entries:
+                part = self.parts[f"worker-{w}"]
+                try:
+                    part.write_batch(items)
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(self.op.step_id, "`write_batch`", ex)
+
+    def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
+        if not self.stateful:
+            return []
+        snaps = []
+        for name, part in self.parts.items():
+            try:
+                snaps.append((name, part.snapshot()))
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(self.op.step_id, "`snapshot`", ex)
+        return snaps
+
+    def close(self) -> None:
+        for part in self.parts.values():
+            part.close()
+        self.parts.clear()
+
+
+_RT_FOR = {
+    "input": _InputRt,
+    "flat_map_batch": _FlatMapBatchRt,
+    "branch": _BranchRt,
+    "merge": _MergeRt,
+    "redistribute": _RedistributeRt,
+    "inspect_debug": _InspectDebugRt,
+    "stateful_batch": _StatefulBatchRt,
+    "output": _OutputRt,
+    "_noop": _NoopRt,
+}
+
+
+class _Driver:
+    def __init__(
+        self,
+        flow: Dataflow,
+        *,
+        worker_count: int,
+        epoch_interval: Optional[timedelta],
+        recovery_config: Optional[Any],
+    ):
+        self.plan: Plan = flatten(flow)
+        self.worker_count = worker_count
+        self.epoch_interval = (
+            epoch_interval
+            if epoch_interval is not None
+            else _DEFAULT_EPOCH_INTERVAL
+        )
+        if self.epoch_interval < timedelta(0):
+            msg = "epoch_interval must be non-negative"
+            raise ValueError(msg)
+
+        self.store: Optional[RecoveryStore] = None
+        self._loads: Dict[Tuple[str, str], bytes] = {}
+        resume = ResumeFrom(0, 1)
+        if recovery_config is not None:
+            self.store = RecoveryStore(recovery_config.db_dir)
+            resume = self.store.resume_from()
+            self._loads = self.store.load_snaps(resume.resume_epoch)
+            ei = self.epoch_interval.total_seconds()
+            backup = recovery_config.backup_interval.total_seconds()
+            if ei > 0:
+                self._commit_delay: Optional[int] = int(-(-backup // ei))
+            elif backup <= 0:
+                self._commit_delay = 0
+            else:
+                # Zero-length epochs close every loop iteration, so no
+                # finite epoch delay honors a wall-clock backup
+                # interval; retain everything (never commit/GC).
+                self._commit_delay = None
+        self.resume = resume
+        self.epoch = resume.resume_epoch
+
+        self.rts: List[_OpRt] = []
+
+    def resume_state(self, step_id: str, state_key: str) -> Optional[Any]:
+        ser = self._loads.get((step_id, state_key))
+        return pickle.loads(ser) if ser is not None else None
+
+    def resume_states(self, step_id: str) -> Dict[str, Any]:
+        return {
+            key: pickle.loads(ser)
+            for (sid, key), ser in self._loads.items()
+            if sid == step_id
+        }
+
+    def route(self, stream_id: str, entry: Entry) -> None:
+        for ci, port in self.plan.consumers.get(stream_id, []):
+            self.rts[ci].queues[port].append(entry)
+        self._progressed = True
+
+    def _close_epoch(self) -> None:
+        if self.store is not None:
+            snaps: List[Tuple[str, str, Optional[bytes]]] = []
+            for rt in self.rts:
+                for state_key, state in rt.epoch_snaps():
+                    ser = (
+                        pickle.dumps(state) if state is not None else None
+                    )
+                    snaps.append((rt.op.step_id, state_key, ser))
+            if self._commit_delay is None:
+                commit_epoch = None
+            else:
+                commit_epoch = self.epoch - self._commit_delay
+                commit_epoch = commit_epoch if commit_epoch > 0 else None
+            self.store.write_epoch(
+                self.resume.ex_num,
+                self.worker_count,
+                self.epoch,
+                snaps,
+                commit_epoch,
+            )
+        else:
+            for rt in self.rts:
+                rt.epoch_snaps()  # still clears awoken sets
+        self.epoch += 1
+
+    def run(self) -> None:
+        # Build runtimes (applies resume state).
+        for op in self.plan.ops:
+            self.rts.append(_RT_FOR[op.name](op, self))
+
+        if self.store is not None:
+            self.store.write_ex_started(
+                self.resume.ex_num, self.worker_count, self.resume.resume_epoch
+            )
+
+        inputs = [rt for rt in self.rts if isinstance(rt, _InputRt)]
+        epoch_started = time.monotonic()
+        interval_s = self.epoch_interval.total_seconds()
+        aborted = False
+
+        try:
+            while True:
+                self._progressed = False
+                now = _now()
+
+                for rt in inputs:
+                    if not rt.eof and rt.poll(now):
+                        self._progressed = True
+
+                for rt in self.rts:
+                    rt.drain()
+                    rt.advance(now)
+                    if not rt.eof and not rt.queued() and not isinstance(
+                        rt, _InputRt
+                    ):
+                        if rt.op.up_streams() and rt.ups_eof():
+                            rt.on_upstream_eof()
+                            rt.drain()
+                            rt.eof = True
+
+                all_eof = all(rt.eof for rt in self.rts)
+                elapsed = time.monotonic() - epoch_started
+
+                if all_eof:
+                    self._close_epoch()
+                    break
+                if elapsed >= interval_s and (
+                    interval_s > 0 or self._progressed
+                ):
+                    self._close_epoch()
+                    epoch_started = time.monotonic()
+
+                if not self._progressed:
+                    waits = []
+                    for rt in inputs:
+                        if rt.eof:
+                            continue
+                        at = rt.next_poll_at()
+                        if at is not None:
+                            waits.append((at - now).total_seconds())
+                        else:
+                            waits.append(0.0)
+                    for rt in self.rts:
+                        if isinstance(rt, _StatefulBatchRt):
+                            at = rt.next_notify_at()
+                            if at is not None:
+                                waits.append((at - now).total_seconds())
+                    if interval_s > 0:
+                        waits.append(interval_s - elapsed)
+                    wait = min(waits) if waits else 0.001
+                    if wait > 0:
+                        time.sleep(min(wait, 0.05))
+        except _Abort:
+            aborted = True
+        finally:
+            if self.store is not None:
+                self.store.close()
+
+        if not aborted:
+            for rt in self.rts:
+                rt.close()
+
+
+def run_main(
+    flow: Dataflow,
+    *,
+    epoch_interval: Optional[timedelta] = None,
+    recovery_config: Optional[Any] = None,
+) -> None:
+    """Execute a dataflow in the current process with one worker lane.
+
+    Blocks until execution is complete.  Entry-point parity with the
+    reference's ``run_main`` (``src/run.rs:114-146``).
+
+    :arg flow: Dataflow to run.
+    :arg epoch_interval: System time length of each epoch (snapshot
+        interval).  Defaults to 10 seconds.
+    :arg recovery_config: State recovery config.  Defaults to no
+        recovery.
+    """
+    _Driver(
+        flow,
+        worker_count=1,
+        epoch_interval=epoch_interval,
+        recovery_config=recovery_config,
+    ).run()
+
+
+def cluster_main(
+    flow: Dataflow,
+    addresses: List[str],
+    proc_id: int,
+    *,
+    epoch_interval: Optional[timedelta] = None,
+    recovery_config: Optional[Any] = None,
+    worker_count_per_proc: int = 1,
+) -> None:
+    """Execute a dataflow in the current process as part of a cluster.
+
+    Entry-point parity with the reference's ``cluster_main``
+    (``src/run.rs:239-351``).  With an empty ``addresses`` list this
+    runs all ``worker_count_per_proc`` worker lanes in-process (this is
+    how multi-worker semantics are unit tested, mirroring the
+    reference's in-process Timely cluster).  Multi-process clusters
+    are launched via ``python -m bytewax_tpu.run``.
+    """
+    if addresses and len(addresses) > 1:
+        from bytewax_tpu.engine.cluster import cluster_proc_main
+
+        cluster_proc_main(
+            flow,
+            addresses,
+            proc_id,
+            epoch_interval=epoch_interval,
+            recovery_config=recovery_config,
+            worker_count_per_proc=worker_count_per_proc,
+        )
+        return
+    _Driver(
+        flow,
+        worker_count=worker_count_per_proc,
+        epoch_interval=epoch_interval,
+        recovery_config=recovery_config,
+    ).run()

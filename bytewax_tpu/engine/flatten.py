@@ -1,0 +1,82 @@
+"""Flatten a nested operator tree into the 9-core-operator plan.
+
+The reference does this reflectively in the engine with a build stack
+(``/root/reference/src/worker.rs:255-497``); here it is a plain
+recursive walk producing a topologically-ordered list of core
+operators plus stream wiring tables.
+"""
+
+from typing import Dict, List, Tuple
+
+from bytewax_tpu.dataflow import Dataflow, DataflowError, Operator
+
+__all__ = ["Plan", "flatten"]
+
+CORE_OPS = frozenset(
+    {
+        "_noop",
+        "branch",
+        "flat_map_batch",
+        "input",
+        "inspect_debug",
+        "merge",
+        "output",
+        "redistribute",
+        "stateful_batch",
+    }
+)
+
+
+class Plan:
+    """Execution plan: core ops in topological order + stream wiring."""
+
+    def __init__(self, flow: Dataflow):
+        self.flow = flow
+        self.ops: List[Operator] = []
+        #: stream_id -> index of producing core op in ``ops``
+        self.producer: Dict[str, int] = {}
+        #: stream_id -> [(consumer op index, port name)]
+        self.consumers: Dict[str, List[Tuple[int, str]]] = {}
+
+    def up_stream_ids(self, op: Operator) -> List[str]:
+        return [s.stream_id for s in op.up_streams()]
+
+
+def _walk(op: Operator, plan: Plan) -> None:
+    if op.core:
+        if op.name not in CORE_OPS:
+            msg = f"unknown core operator {op.name!r} at {op.step_id!r}"
+            raise DataflowError(msg)
+        idx = len(plan.ops)
+        plan.ops.append(op)
+        for port, val in op.ups.items():
+            streams = [val] if not isinstance(val, list) else val
+            for s in streams:
+                plan.consumers.setdefault(s.stream_id, []).append((idx, port))
+        for s in op.down_streams():
+            plan.producer[s.stream_id] = idx
+    else:
+        for sub in op.substeps:
+            _walk(sub, plan)
+
+
+def flatten(flow: Dataflow) -> Plan:
+    """Flatten the operator tree; validate ≥1 input and ≥1 output
+    (reference parity: ``src/worker.rs:474-483``)."""
+    plan = Plan(flow)
+    for op in flow.substeps:
+        _walk(op, plan)
+    names = {op.name for op in plan.ops}
+    if "input" not in names:
+        msg = (
+            f"dataflow {flow.flow_id!r} needs at least one input step; "
+            "add an `bytewax_tpu.operators.input` step"
+        )
+        raise DataflowError(msg)
+    if "output" not in names:
+        msg = (
+            f"dataflow {flow.flow_id!r} needs at least one output step; "
+            "add an `bytewax_tpu.operators.output` step"
+        )
+        raise DataflowError(msg)
+    return plan

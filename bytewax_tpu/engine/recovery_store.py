@@ -1,0 +1,357 @@
+"""SQLite-backed recovery store and resume-epoch calculation.
+
+Store format parity with the reference engine
+(``/root/reference/src/recovery.rs:456-531`` schema,
+``:1180-1275`` resume math, ``:948-989`` GC); implementation is our
+own, host-side Python over :mod:`sqlite3`.  Device state arrives here
+already materialized (the driver calls ``jax.device_get`` on sharded
+state pytrees at epoch close before serializing).
+
+Tables per ``part-{i}.sqlite3``:
+
+- ``parts(part_index, part_count)`` — identity, written at init.
+- ``exs(ex_num, worker_index, worker_count, resume_epoch)`` — one row
+  per (execution, worker), written at execution start.
+- ``fronts(ex_num, worker_index, epoch)`` — worker frontier, upserted
+  at every epoch close.
+- ``commits(epoch)`` — GC watermark for this partition.
+- ``snaps(step_id, state_key, epoch, ser_change)`` — pickled state
+  changes; ``NULL`` ``ser_change`` is a discard marker.
+"""
+
+import os
+import sqlite3
+import zlib
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple, Union
+
+__all__ = [
+    "InconsistentPartitionsError",
+    "MissingPartitionsError",
+    "NoPartitionsError",
+    "RecoveryStore",
+    "ResumeFrom",
+    "init_db_dir",
+]
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS parts (
+    part_index INTEGER NOT NULL,
+    part_count INTEGER NOT NULL,
+    PRIMARY KEY (part_index)
+);
+CREATE TABLE IF NOT EXISTS exs (
+    ex_num INTEGER NOT NULL,
+    worker_index INTEGER NOT NULL,
+    worker_count INTEGER NOT NULL,
+    resume_epoch INTEGER NOT NULL,
+    PRIMARY KEY (ex_num, worker_index)
+);
+CREATE TABLE IF NOT EXISTS fronts (
+    ex_num INTEGER NOT NULL,
+    worker_index INTEGER NOT NULL,
+    epoch INTEGER NOT NULL,
+    PRIMARY KEY (ex_num, worker_index)
+);
+CREATE TABLE IF NOT EXISTS commits (
+    epoch INTEGER NOT NULL,
+    PRIMARY KEY (epoch)
+);
+CREATE TABLE IF NOT EXISTS snaps (
+    step_id TEXT NOT NULL,
+    state_key TEXT NOT NULL,
+    epoch INTEGER NOT NULL,
+    ser_change BLOB,
+    PRIMARY KEY (step_id, state_key, epoch)
+);
+"""
+
+
+class NoPartitionsError(FileNotFoundError):
+    """Raised when no recovery partitions are found in the recovery
+    directory; it was probably not initialized with
+    :func:`init_db_dir` first."""
+
+
+class MissingPartitionsError(FileNotFoundError):
+    """Raised when an incomplete set of recovery partitions is found."""
+
+
+class InconsistentPartitionsError(ValueError):
+    """Raised when the recovery partitions contain inconsistent data:
+    state needed to resume was already garbage collected in some
+    partition.  Your ``backup_interval`` is probably shorter than the
+    time between your backups."""
+
+
+def _connect(path: Path) -> sqlite3.Connection:
+    con = sqlite3.connect(path, isolation_level=None)
+    # Litestream/backup friendly, matching the reference's pragmas
+    # (src/recovery.rs:521-531).
+    con.execute("PRAGMA journal_mode = WAL")
+    con.execute("PRAGMA busy_timeout = 5000")
+    con.execute("PRAGMA synchronous = NORMAL")
+    return con
+
+
+def init_db_dir(db_dir: Union[str, Path], count: int) -> None:
+    """Create a set of empty recovery partitions.
+
+    :arg db_dir: Directory to create partitions in; must exist.
+    :arg count: Number of partitions to create.
+    """
+    db_dir = Path(db_dir)
+    if not db_dir.is_dir():
+        msg = f"recovery DB dir {str(db_dir)!r} does not exist"
+        raise NotADirectoryError(msg)
+    for i in range(count):
+        con = _connect(db_dir / f"part-{i}.sqlite3")
+        try:
+            con.executescript(_SCHEMA)
+            con.execute(
+                "INSERT OR REPLACE INTO parts (part_index, part_count) VALUES (?, ?)",
+                (i, count),
+            )
+        finally:
+            con.close()
+
+
+class ResumeFrom:
+    """Where to resume processing: execution number and epoch."""
+
+    def __init__(self, ex_num: int, resume_epoch: int):
+        self.ex_num = ex_num
+        self.resume_epoch = resume_epoch
+
+    def __repr__(self) -> str:
+        return f"ResumeFrom(ex_num={self.ex_num}, resume_epoch={self.resume_epoch})"
+
+
+#: Epoch the very first execution starts at.
+INIT_EPOCH = 1
+
+
+def _stable_hash(key: str) -> int:
+    return zlib.adler32(key.encode("utf-8"))
+
+
+class RecoveryStore:
+    """Open handle on all recovery partitions of a dataflow."""
+
+    def __init__(self, db_dir: Union[str, Path]):
+        db_dir = Path(db_dir)
+        paths = sorted(db_dir.glob("part-*.sqlite3"))
+        if not paths:
+            msg = (
+                f"no recovery partitions found in {str(db_dir)!r}; "
+                "init the recovery store with "
+                "`python -m bytewax_tpu.recovery` first"
+            )
+            raise NoPartitionsError(msg)
+        self._cons: Dict[int, sqlite3.Connection] = {}
+        part_count: Optional[int] = None
+        for path in paths:
+            con = _connect(path)
+            con.executescript(_SCHEMA)
+            row = con.execute(
+                "SELECT part_index, part_count FROM parts"
+            ).fetchone()
+            if row is None:
+                con.close()
+                msg = f"recovery partition {str(path)!r} has no identity row"
+                raise MissingPartitionsError(msg)
+            idx, count = row
+            if part_count is None:
+                part_count = count
+            elif part_count != count:
+                msg = (
+                    f"recovery partitions in {str(db_dir)!r} disagree on "
+                    f"partition count ({part_count} vs {count})"
+                )
+                raise InconsistentPartitionsError(msg)
+            self._cons[idx] = con
+        assert part_count is not None
+        missing = set(range(part_count)) - set(self._cons)
+        if missing:
+            msg = (
+                f"missing recovery partitions {sorted(missing)} of "
+                f"{part_count} in {str(db_dir)!r}"
+            )
+            raise MissingPartitionsError(msg)
+        self.part_count = part_count
+
+    def close(self) -> None:
+        for con in self._cons.values():
+            con.close()
+
+    def _part_for_key(self, step_id: str, state_key: str) -> sqlite3.Connection:
+        return self._cons[
+            _stable_hash(f"{step_id}\x00{state_key}") % self.part_count
+        ]
+
+    def _part_for_worker(self, worker_index: int) -> sqlite3.Connection:
+        return self._cons[worker_index % self.part_count]
+
+    # -- resume calculation ------------------------------------------------
+
+    def resume_from(self) -> ResumeFrom:
+        """Compute the next execution number and the epoch to resume at.
+
+        Mirrors the reference's resume SQL
+        (``src/recovery.rs:1180-1275``): the resume epoch is the
+        minimum over workers of each worker's latest frontier in the
+        most recent execution; inconsistent GC raises.
+        """
+        exs: List[Tuple[int, int, int, int]] = []
+        fronts: List[Tuple[int, int, int]] = []
+        for con in self._cons.values():
+            exs.extend(
+                con.execute(
+                    "SELECT ex_num, worker_index, worker_count, resume_epoch "
+                    "FROM exs"
+                ).fetchall()
+            )
+            fronts.extend(
+                con.execute(
+                    "SELECT ex_num, worker_index, epoch FROM fronts"
+                ).fetchall()
+            )
+
+        if not exs:
+            resume = ResumeFrom(0, INIT_EPOCH)
+        else:
+            last_ex = max(row[0] for row in exs)
+            last_rows = [row for row in exs if row[0] == last_ex]
+            worker_count = last_rows[0][2]
+            front_by_worker: Dict[int, int] = {}
+            for ex_num, worker_index, epoch in fronts:
+                if ex_num == last_ex:
+                    front_by_worker[worker_index] = max(
+                        front_by_worker.get(worker_index, 0), epoch
+                    )
+            worker_epochs = []
+            for _ex, worker_index, _count, start_epoch in last_rows:
+                worker_epochs.append(
+                    front_by_worker.get(worker_index, start_epoch)
+                )
+            # Workers of the last execution whose exs row is lost
+            # (e.g. a partition was restored from a stale backup)
+            # simply don't constrain the minimum; the commit check
+            # below catches true inconsistency.
+            resume = ResumeFrom(last_ex + 1, min(worker_epochs))
+
+        for idx, con in self._cons.items():
+            row = con.execute("SELECT MAX(epoch) FROM commits").fetchone()
+            commit_epoch = row[0] if row and row[0] is not None else None
+            if commit_epoch is not None and commit_epoch >= resume.resume_epoch:
+                msg = (
+                    f"recovery partition {idx} already garbage-collected "
+                    f"state up to epoch {commit_epoch}, but the computed "
+                    f"resume epoch is {resume.resume_epoch}; partitions are "
+                    "from inconsistent backups"
+                )
+                raise InconsistentPartitionsError(msg)
+        return resume
+
+    def load_snaps(self, before_epoch: int) -> Dict[Tuple[str, str], bytes]:
+        """Load the latest state change per (step, key) strictly before
+        an epoch.  Discard markers remove the key."""
+        out: Dict[Tuple[str, str], bytes] = {}
+        for con in self._cons.values():
+            rows = con.execute(
+                "SELECT s.step_id, s.state_key, s.ser_change "
+                "FROM snaps s JOIN ("
+                "  SELECT step_id, state_key, MAX(epoch) AS epoch FROM snaps "
+                "  WHERE epoch < ? GROUP BY step_id, state_key"
+                ") latest ON s.step_id = latest.step_id "
+                "AND s.state_key = latest.state_key "
+                "AND s.epoch = latest.epoch",
+                (before_epoch,),
+            ).fetchall()
+            for step_id, state_key, ser_change in rows:
+                if ser_change is not None:
+                    out[(step_id, state_key)] = ser_change
+        return out
+
+    # -- write path --------------------------------------------------------
+
+    def write_ex_started(
+        self, ex_num: int, worker_count: int, resume_epoch: int
+    ) -> None:
+        """Record that an execution started, before any epoch closes."""
+        for worker_index in range(worker_count):
+            con = self._part_for_worker(worker_index)
+            con.execute(
+                "INSERT OR REPLACE INTO exs "
+                "(ex_num, worker_index, worker_count, resume_epoch) "
+                "VALUES (?, ?, ?, ?)",
+                (ex_num, worker_index, worker_count, resume_epoch),
+            )
+
+    def write_epoch(
+        self,
+        ex_num: int,
+        worker_count: int,
+        epoch: int,
+        snaps: List[Tuple[str, str, Optional[bytes]]],
+        commit_epoch: Optional[int],
+    ) -> None:
+        """Durably close an epoch: write snapshots, advance all worker
+        frontiers to ``epoch + 1``, then advance the commit watermark
+        and garbage collect superseded snapshots."""
+        for con in self._cons.values():
+            con.execute("BEGIN")
+        try:
+            for step_id, state_key, ser_change in snaps:
+                con = self._part_for_key(step_id, state_key)
+                con.execute(
+                    "INSERT OR REPLACE INTO snaps "
+                    "(step_id, state_key, epoch, ser_change) "
+                    "VALUES (?, ?, ?, ?)",
+                    (step_id, state_key, epoch, ser_change),
+                )
+            for worker_index in range(worker_count):
+                con = self._part_for_worker(worker_index)
+                con.execute(
+                    "INSERT OR REPLACE INTO fronts (ex_num, worker_index, epoch) "
+                    "VALUES (?, ?, ?)",
+                    (ex_num, worker_index, epoch + 1),
+                )
+            if commit_epoch is not None and commit_epoch > 0:
+                for con in self._cons.values():
+                    con.execute(
+                        "INSERT OR REPLACE INTO commits (epoch) VALUES (?)",
+                        (commit_epoch,),
+                    )
+                    con.execute("DELETE FROM commits WHERE epoch < ?", (commit_epoch,))
+                    # GC: drop snaps superseded by a newer snap at or
+                    # before the commit watermark.
+                    con.execute(
+                        "DELETE FROM snaps WHERE EXISTS ("
+                        "  SELECT 1 FROM snaps newer "
+                        "  WHERE newer.step_id = snaps.step_id "
+                        "  AND newer.state_key = snaps.state_key "
+                        "  AND newer.epoch > snaps.epoch "
+                        "  AND newer.epoch <= ?"
+                        ")",
+                        (commit_epoch,),
+                    )
+                    # Discard markers at/below the watermark with
+                    # nothing older left are themselves dead weight.
+                    con.execute(
+                        "DELETE FROM snaps WHERE ser_change IS NULL "
+                        "AND epoch <= ? AND NOT EXISTS ("
+                        "  SELECT 1 FROM snaps older "
+                        "  WHERE older.step_id = snaps.step_id "
+                        "  AND older.state_key = snaps.state_key "
+                        "  AND older.epoch < snaps.epoch"
+                        ")",
+                        (commit_epoch,),
+                    )
+        except BaseException:
+            for con in self._cons.values():
+                con.execute("ROLLBACK")
+            raise
+        else:
+            for con in self._cons.values():
+                con.execute("COMMIT")

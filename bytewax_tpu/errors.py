@@ -1,0 +1,7 @@
+"""Framework error types."""
+
+__all__ = ["BytewaxRuntimeError"]
+
+
+class BytewaxRuntimeError(RuntimeError):
+    """Raised when the engine encounters a runtime error."""

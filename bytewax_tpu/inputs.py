@@ -1,0 +1,323 @@
+"""Low-level input interfaces and input helpers.
+
+If you want pre-built connectors, see :mod:`bytewax_tpu.connectors`.
+
+API parity with the reference (``/root/reference/pysrc/bytewax/inputs.py``);
+implementation is our own.  Sources are driven host-side by the engine; the
+engine batches their output into device micro-batches.
+"""
+
+import asyncio
+import itertools
+from abc import ABC, abstractmethod
+from datetime import datetime, timedelta, timezone
+from typing import (
+    AsyncIterator,
+    Callable,
+    Generic,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    TypeVar,
+)
+
+X = TypeVar("X")
+S = TypeVar("S")
+Sn = TypeVar("Sn")
+
+__all__ = [
+    "AbortExecution",
+    "DynamicSource",
+    "FixedPartitionedSource",
+    "SimplePollingSource",
+    "Source",
+    "StatefulSourcePartition",
+    "StatelessSourcePartition",
+    "batch",
+    "batch_async",
+    "batch_getter",
+    "batch_getter_ex",
+]
+
+
+class AbortExecution(RuntimeError):
+    """Raise this from ``next_batch`` to abort the whole execution
+    immediately, without a final snapshot (simulates a hard crash for
+    recovery testing).
+
+    Reference parity: ``src/inputs.rs:99-104``.
+    """
+
+
+class Source(ABC, Generic[X]):  # noqa: B024
+    """Where a dataflow gets input data from.
+
+    Do not subclass this directly; subclass
+    :class:`FixedPartitionedSource` or :class:`DynamicSource`.
+    """
+
+
+class StatefulSourcePartition(ABC, Generic[X, S]):
+    """Input partition that maintains recoverable state.
+
+    ``next_batch`` must never block: return an empty iterable if there
+    are no items yet, and use :meth:`next_awake` to schedule polling.
+    """
+
+    @abstractmethod
+    def next_batch(self) -> Iterable[X]:
+        """Attempt to get the next batch of input items, non-blocking.
+
+        Raise :class:`StopIteration` when complete (EOF).
+        """
+        ...
+
+    def next_awake(self) -> Optional[datetime]:
+        """Next system time this partition should be polled.
+
+        ``None`` (default) means poll again as soon as possible (the
+        engine applies a short cooldown after empty batches, matching
+        the reference's 1 ms: ``src/inputs.rs:38``).
+        """
+        return None
+
+    @abstractmethod
+    def snapshot(self) -> S:
+        """Snapshot the position of the next read of this partition.
+
+        This will be returned to you via ``build_part``'s
+        ``resume_state`` on resume; the source must resume reading
+        *exactly* at this position for exactly-once semantics.
+        """
+        ...
+
+    def close(self) -> None:
+        """Cleanup this partition on EOF or shutdown."""
+        return None
+
+
+class FixedPartitionedSource(Source[X], Generic[X, S]):
+    """An input source with a fixed number of independent partitions.
+
+    Partitions are distributed across workers; state is snapshotted and
+    routed back on resume and rescale.
+    """
+
+    @abstractmethod
+    def list_parts(self) -> List[str]:
+        """List all local partition ids.  Must be deterministic and
+        unique across the whole cluster."""
+        ...
+
+    @abstractmethod
+    def build_part(
+        self,
+        step_id: str,
+        for_part: str,
+        resume_state: Optional[S],
+    ) -> StatefulSourcePartition[X, S]:
+        """Build anew or resume an input partition."""
+        ...
+
+
+class StatelessSourcePartition(ABC, Generic[X]):
+    """Input partition that does not maintain recoverable state."""
+
+    @abstractmethod
+    def next_batch(self) -> Iterable[X]:
+        """Attempt to get the next batch of input items, non-blocking.
+
+        Raise :class:`StopIteration` when complete (EOF).
+        """
+        ...
+
+    def next_awake(self) -> Optional[datetime]:
+        """Next system time this partition should be polled."""
+        return None
+
+    def close(self) -> None:
+        """Cleanup this partition on EOF or shutdown."""
+        return None
+
+
+class DynamicSource(Source[X]):
+    """An input source where all workers can read distinct items.
+
+    Reads are not recoverable; designed for ephemeral sources.
+    """
+
+    @abstractmethod
+    def build(
+        self, step_id: str, worker_index: int, worker_count: int
+    ) -> StatelessSourcePartition[X]:
+        """Build an input partition for a worker.
+
+        Use ``worker_index``/``worker_count`` to avoid duplicate reads.
+        """
+        ...
+
+
+class _SimplePollingPartition(StatefulSourcePartition[X, None]):
+    def __init__(
+        self,
+        interval: timedelta,
+        align_to: Optional[datetime],
+        getter: Callable[[], Optional[X]],
+    ):
+        self._interval = interval
+        self._getter = getter
+        now = datetime.now(timezone.utc)
+        if align_to is not None and align_to > now:
+            self._next_awake = align_to
+        elif align_to is not None:
+            # Next aligned instant after now.
+            behind = (now - align_to) // interval
+            self._next_awake = align_to + interval * (behind + 1)
+        else:
+            self._next_awake = now
+
+    def next_batch(self) -> List[X]:
+        self._next_awake += self._interval
+        try:
+            item = self._getter()
+        except SimplePollingSource.Retry as ex:
+            self._next_awake = datetime.now(timezone.utc) + ex.timeout
+            return []
+        if item is None:
+            return []
+        return [item]
+
+    def next_awake(self) -> Optional[datetime]:
+        return self._next_awake
+
+    def snapshot(self) -> None:
+        return None
+
+
+class SimplePollingSource(FixedPartitionedSource[X, None]):
+    """Calls a user-defined function at a regular interval.
+
+    Subclass and implement :meth:`next_item`.  Raise
+    :class:`SimplePollingSource.Retry` to retry sooner than the
+    interval.
+
+    Reference parity: ``inputs.py:333``.
+    """
+
+    class Retry(Exception):
+        """Raise from ``next_item`` to retry after a timeout."""
+
+        def __init__(self, timeout: timedelta):
+            self.timeout = timeout
+
+    def __init__(self, interval: timedelta, align_to: Optional[datetime] = None):
+        if interval < timedelta(seconds=0):
+            msg = "interval must be positive"
+            raise ValueError(msg)
+        self._interval = interval
+        self._align_to = align_to
+
+    def list_parts(self) -> List[str]:
+        return ["singleton"]
+
+    def build_part(
+        self, step_id: str, for_part: str, resume_state: Optional[None]
+    ) -> _SimplePollingPartition[X]:
+        return _SimplePollingPartition(
+            self._interval, self._align_to, self.next_item
+        )
+
+    @abstractmethod
+    def next_item(self) -> Optional[X]:
+        """Fetch the next item; return ``None`` if nothing new."""
+        ...
+
+
+def batch(ib: Iterable[X], batch_size: int) -> Iterator[List[X]]:
+    """Batch an iterable into lists of up to ``batch_size``."""
+    it = iter(ib)
+    while True:
+        chunk = list(itertools.islice(it, batch_size))
+        if not chunk:
+            return
+        yield chunk
+
+
+def batch_getter(
+    getter: Callable[[], X], batch_size: int, yield_on: Optional[X] = None
+) -> Iterator[List[X]]:
+    """Batch a getter that returns a sentinel when no more items."""
+    while True:
+        chunk: List[X] = []
+        while len(chunk) < batch_size:
+            item = getter()
+            if item == yield_on:
+                break
+            chunk.append(item)
+        yield chunk
+
+
+def batch_getter_ex(
+    getter: Callable[[], X], batch_size: int, yield_ex=IndexError
+) -> Iterator[List[X]]:
+    """Batch a getter that raises an exception when no more items."""
+    while True:
+        chunk: List[X] = []
+        while len(chunk) < batch_size:
+            try:
+                chunk.append(getter())
+            except yield_ex:
+                break
+        yield chunk
+
+
+def batch_async(
+    aib: AsyncIterator[X],
+    timeout: timedelta,
+    batch_size: int,
+    loop: Optional[asyncio.AbstractEventLoop] = None,
+) -> Iterator[List[X]]:
+    """Batch an async iterator from within a sync ``next_batch``.
+
+    Gathers up to ``batch_size`` items, waiting at most ``timeout``;
+    yields possibly-empty batches without blocking forever.
+
+    Reference parity: ``inputs.py:546``.
+    """
+    loop = loop if loop is not None else asyncio.new_event_loop()
+    pending: List[asyncio.Task] = []
+    eof = False
+
+    async def gather() -> List[X]:
+        nonlocal eof
+        chunk: List[X] = []
+        deadline = loop.time() + timeout.total_seconds()
+        while len(chunk) < batch_size:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            # Resume the in-flight anext from a previous timeout, if any;
+            # shield keeps it alive across wait_for cancellation.
+            task = pending.pop() if pending else loop.create_task(
+                aib.__anext__()  # type: ignore[arg-type]
+            )
+            try:
+                item = await asyncio.wait_for(
+                    asyncio.shield(task), timeout=remaining
+                )
+            except asyncio.TimeoutError:
+                pending.append(task)
+                break
+            except StopAsyncIteration:
+                eof = True
+                break
+            chunk.append(item)
+        return chunk
+
+    while True:
+        chunk = loop.run_until_complete(gather())
+        if chunk or not eof:
+            yield chunk
+        if eof:
+            return

@@ -1,0 +1,1095 @@
+"""Built-in operators.
+
+The engine only interprets the **core** operators (marked
+``@operator(_core=True)``): ``branch``, ``flat_map_batch``, ``input``,
+``inspect_debug``, ``merge``, ``output``, ``redistribute``,
+``stateful_batch``, and ``_noop``.  Everything else here is pure composition
+on top of those, so it runs identically on the host tier and on the XLA tier.
+
+API parity with the reference operator library
+(``/root/reference/pysrc/bytewax/operators/__init__.py``); implementations are
+our own.
+"""
+
+import copy
+import itertools
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from datetime import datetime, timedelta, timezone
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Generic,
+    Iterable,
+    List,
+    Optional,
+    Tuple,
+    TypeVar,
+)
+
+from typing_extensions import Literal, TypeAlias
+
+from bytewax_tpu.dataflow import (
+    Dataflow,
+    KeyedStream,
+    Stream,
+    f_repr,
+    operator,
+    _new_stream,
+)
+from bytewax_tpu.inputs import Source
+from bytewax_tpu.outputs import Sink
+
+X = TypeVar("X")
+Y = TypeVar("Y")
+V = TypeVar("V")
+W = TypeVar("W")
+S = TypeVar("S")
+DK = TypeVar("DK")
+DV = TypeVar("DV")
+
+_EMPTY: Tuple = ()
+
+
+def _identity(x: X) -> X:
+    return x
+
+
+def _get_system_utc() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def _untyped_none() -> Any:
+    return None
+
+
+# --------------------------------------------------------------------------
+# Core operators
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BranchOut(Generic[X, Y]):
+    """Streams returned from :func:`branch`."""
+
+    trues: Stream[X]
+    falses: Stream[Y]
+
+
+@operator(_core=True)
+def branch(
+    step_id: str,
+    up: Stream[X],
+    predicate: Callable[[X], bool],
+) -> BranchOut:
+    """Divide items into two streams with a predicate.
+
+    Reference parity: ``operators/__init__.py:119`` /
+    ``src/operators.rs:34-100``.
+
+    :arg step_id: Unique ID.
+    :arg up: Stream to divide.
+    :arg predicate: Returns a truthy value to route an item to
+        ``trues``, falsy to ``falses``.
+    :returns: :class:`BranchOut` with ``trues`` and ``falses`` streams.
+    """
+    if not callable(predicate):
+        msg = f"predicate of branch {step_id!r} must be callable"
+        raise TypeError(msg)
+    return BranchOut(trues=_new_stream("trues"), falses=_new_stream("falses"))
+
+
+@operator(_core=True)
+def flat_map_batch(
+    step_id: str,
+    up: Stream[X],
+    mapper: Callable[[List[X]], Iterable[Y]],
+) -> Stream[Y]:
+    """Transform an entire batch of items 1-to-many.
+
+    This is the lowest-level stateless transform; all ``map``-family
+    operators lower to it.  On the XLA tier, batches whose mapper is
+    jax-traceable are fused into the compiled step.
+
+    Reference parity: ``operators/__init__.py:179`` /
+    ``src/operators.rs:122-228``.
+    """
+    if not callable(mapper):
+        msg = f"mapper of flat_map_batch {step_id!r} must be callable"
+        raise TypeError(msg)
+    return _new_stream("down")
+
+
+@operator(_core=True)
+def input(  # noqa: A001
+    step_id: str,
+    flow: Dataflow,
+    source: Source[X],
+) -> Stream[X]:
+    """Introduce items into a dataflow from a source.
+
+    Reference parity: ``operators/__init__.py:240`` /
+    ``src/inputs.rs:449-858``.
+    """
+    if not isinstance(source, Source):
+        msg = f"source of input {step_id!r} must be a Source; got {source!r}"
+        raise TypeError(msg)
+    return _new_stream("down")
+
+
+def _default_debug_inspector(step_id: str, item: Any, epoch: int, worker: int) -> None:
+    print(f"{step_id} W{worker} @{epoch}: {item!r}", flush=True)
+
+
+@operator(_core=True)
+def inspect_debug(
+    step_id: str,
+    up: Stream[X],
+    inspector: Callable[[str, X, int, int], None] = _default_debug_inspector,
+) -> Stream[X]:
+    """Observe items, their epoch, and worker.
+
+    Reference parity: ``operators/__init__.py:296`` /
+    ``src/operators.rs:230-317``.
+    """
+    return _new_stream("down")
+
+
+@operator(_core=True)
+def merge(step_id: str, *ups: Stream[X]) -> Stream[X]:
+    """Combine multiple streams together.
+
+    Reference parity: ``operators/__init__.py:394`` /
+    ``src/operators.rs:319-343``.
+    """
+    if len(ups) < 1:
+        msg = f"merge {step_id!r} requires at least one upstream"
+        raise TypeError(msg)
+    return _new_stream("down")
+
+
+@operator(_core=True)
+def output(step_id: str, up: Stream[X], sink: Sink[X]) -> None:
+    """Write items out of a dataflow into a sink.
+
+    Reference parity: ``operators/__init__.py:449`` /
+    ``src/outputs.rs:200-589``.
+    """
+    if not isinstance(sink, Sink):
+        msg = f"sink of output {step_id!r} must be a Sink; got {sink!r}"
+        raise TypeError(msg)
+    return None
+
+
+@operator(_core=True)
+def redistribute(step_id: str, up: Stream[X]) -> Stream[X]:
+    """Redistribute items randomly across all workers.
+
+    Reference parity: ``operators/__init__.py:497`` /
+    ``src/operators.rs:345-361``.
+    """
+    return _new_stream("down")
+
+
+@operator(_core=True)
+def _noop(step_id: str, up: Stream[X]) -> Stream[X]:
+    """No-op passthrough; used to enforce stream identity boundaries."""
+    return _new_stream("down")
+
+
+class StatefulBatchLogic(ABC, Generic[V, W, S]):
+    """Abstract logic for :func:`stateful_batch`, the stateful engine
+    primitive.
+
+    One instance exists per key; the engine guarantees all values for a
+    key are routed to the same instance in epoch order.
+
+    Reference parity: ``operators/__init__.py:593`` /
+    ``src/operators.rs:441-1041``.
+    """
+
+    #: Return as the second value to keep the logic for this key.
+    RETAIN: bool = False
+    #: Return as the second value to discard the logic for this key.
+    DISCARD: bool = True
+
+    @abstractmethod
+    def on_batch(self, values: List[V]) -> Tuple[Iterable[W], bool]:
+        """Called with all values for this key arriving in a batch.
+
+        :returns: ``(emit_values, is_complete)``.
+        """
+        ...
+
+    def on_notify(self) -> Tuple[Iterable[W], bool]:
+        """Called when the scheduled notification time has passed."""
+        return (_EMPTY, StatefulBatchLogic.RETAIN)
+
+    def on_eof(self) -> Tuple[Iterable[W], bool]:
+        """Called once the upstream is EOF for this execution.
+
+        This will not be called on recovery resume; state is retained
+        unless you return DISCARD.
+        """
+        return (_EMPTY, StatefulBatchLogic.RETAIN)
+
+    def notify_at(self) -> Optional[datetime]:
+        """Next system time this logic wants :meth:`on_notify` called."""
+        return None
+
+    @abstractmethod
+    def snapshot(self) -> S:
+        """Return an immutable copy of the state for recovery."""
+        ...
+
+
+@operator(_core=True)
+def stateful_batch(
+    step_id: str,
+    up: KeyedStream[V],
+    builder: Callable[[Optional[S]], StatefulBatchLogic[V, W, S]],
+) -> KeyedStream[W]:
+    """Advanced generic stateful operator.
+
+    Keys are hash-routed to a home worker (chip shard on the XLA tier);
+    ``builder`` is called with ``None`` for new keys or the resume
+    snapshot on recovery.
+
+    Reference parity: ``operators/__init__.py:795`` /
+    ``src/operators.rs:441-1041``.
+    """
+    if not callable(builder):
+        msg = f"builder of stateful_batch {step_id!r} must be callable"
+        raise TypeError(msg)
+    return _new_stream("down")
+
+
+# --------------------------------------------------------------------------
+# Stateful per-item sugar
+# --------------------------------------------------------------------------
+
+
+class StatefulLogic(ABC, Generic[V, W, S]):
+    """Abstract logic for :func:`stateful`; per-item flavor of
+    :class:`StatefulBatchLogic`.
+
+    Reference parity: ``operators/__init__.py:918``.
+    """
+
+    RETAIN: bool = False
+    DISCARD: bool = True
+
+    @abstractmethod
+    def on_item(self, value: V) -> Tuple[Iterable[W], bool]:
+        """Called on each new upstream item."""
+        ...
+
+    def on_notify(self) -> Tuple[Iterable[W], bool]:
+        return (_EMPTY, StatefulLogic.RETAIN)
+
+    def on_eof(self) -> Tuple[Iterable[W], bool]:
+        return (_EMPTY, StatefulLogic.RETAIN)
+
+    def notify_at(self) -> Optional[datetime]:
+        return None
+
+    @abstractmethod
+    def snapshot(self) -> S:
+        ...
+
+
+@dataclass
+class _StatefulShim(StatefulBatchLogic[V, W, S]):
+    builder: Callable[[Optional[S]], StatefulLogic[V, W, S]]
+    logic: Optional[StatefulLogic[V, W, S]]
+
+    def on_batch(self, values: List[V]) -> Tuple[Iterable[W], bool]:
+        emits: List[W] = []
+        for v in values:
+            # A mid-batch discard must not drop the remaining values
+            # for the key: rebuild fresh logic and keep going (the
+            # reference does the same: operators/__init__.py:1030-1042).
+            if self.logic is None:
+                self.logic = self.builder(None)
+            vs, is_complete = self.logic.on_item(v)
+            emits.extend(vs)
+            if is_complete:
+                self.logic = None
+        if self.logic is None:
+            return (emits, StatefulBatchLogic.DISCARD)
+        return (emits, StatefulBatchLogic.RETAIN)
+
+    def on_notify(self) -> Tuple[Iterable[W], bool]:
+        assert self.logic is not None
+        return self.logic.on_notify()
+
+    def on_eof(self) -> Tuple[Iterable[W], bool]:
+        assert self.logic is not None
+        return self.logic.on_eof()
+
+    def notify_at(self) -> Optional[datetime]:
+        assert self.logic is not None
+        return self.logic.notify_at()
+
+    def snapshot(self) -> S:
+        assert self.logic is not None
+        return self.logic.snapshot()
+
+
+@operator
+def stateful(
+    step_id: str,
+    up: KeyedStream[V],
+    builder: Callable[[Optional[S]], StatefulLogic[V, W, S]],
+) -> KeyedStream[W]:
+    """Advanced per-item stateful operator.
+
+    Reference parity: ``operators/__init__.py:1065``.
+    """
+
+    def shim_builder(resume_state: Optional[S]) -> _StatefulShim[V, W, S]:
+        return _StatefulShim(builder, builder(resume_state))
+
+    return stateful_batch("stateful_batch", up, shim_builder)
+
+
+# --------------------------------------------------------------------------
+# Stateless sugar
+# --------------------------------------------------------------------------
+
+
+@operator
+def flat_map(
+    step_id: str,
+    up: Stream[X],
+    mapper: Callable[[X], Iterable[Y]],
+) -> Stream[Y]:
+    """Transform items one-to-many.
+
+    Reference parity: ``operators/__init__.py:1460``.
+    """
+
+    def shim_mapper(xs: List[X]) -> Iterable[Y]:
+        return itertools.chain.from_iterable(mapper(x) for x in xs)
+
+    return flat_map_batch("flat_map_batch", up, shim_mapper)
+
+
+@operator
+def flat_map_value(
+    step_id: str,
+    up: KeyedStream[V],
+    mapper: Callable[[V], Iterable[W]],
+) -> KeyedStream[W]:
+    """Transform values one-to-many.
+
+    Reference parity: ``operators/__init__.py:1526``.
+    """
+
+    def shim_mapper(k_v: Tuple[str, V]) -> Iterable[Tuple[str, W]]:
+        try:
+            k, v = k_v
+        except TypeError as ex:
+            msg = (
+                f"step {step_id!r} requires (key, value) 2-tuple from "
+                f"upstream; got a {type(k_v)!r} instead"
+            )
+            raise TypeError(msg) from ex
+        return ((k, w) for w in mapper(v))
+
+    return flat_map("flat_map", up, shim_mapper)
+
+
+@operator
+def flatten(
+    step_id: str,
+    up: Stream[Iterable[X]],
+) -> Stream[X]:
+    """Move all sub-items up a level.
+
+    Reference parity: ``operators/__init__.py:1593``.
+    """
+
+    def shim_mapper(x: Iterable[X]) -> Iterable[X]:
+        if not isinstance(x, Iterable):
+            msg = (
+                f"step {step_id!r} requires upstream to be iterables; "
+                f"got a {type(x)!r} instead"
+            )
+            raise TypeError(msg)
+        return x
+
+    return flat_map("flat_map", up, shim_mapper)
+
+
+@operator
+def filter(  # noqa: A001
+    step_id: str,
+    up: Stream[X],
+    predicate: Callable[[X], bool],
+) -> Stream[X]:
+    """Keep only some items.
+
+    Reference parity: ``operators/__init__.py:1652``.
+    """
+
+    def shim_mapper(x: X) -> Iterable[X]:
+        keep = predicate(x)
+        if not isinstance(keep, bool):
+            msg = (
+                f"return value of predicate {f_repr(predicate)} "
+                f"in step {step_id!r} must be a bool; got {keep!r} instead"
+            )
+            raise TypeError(msg)
+        if keep:
+            return (x,)
+        return _EMPTY
+
+    return flat_map("flat_map", up, shim_mapper)
+
+
+@operator
+def filter_value(
+    step_id: str,
+    up: KeyedStream[V],
+    predicate: Callable[[V], bool],
+) -> KeyedStream[V]:
+    """Keep only some values; keys untouched.
+
+    Reference parity: ``operators/__init__.py:1726``.
+    """
+
+    def shim_mapper(v: V) -> Iterable[V]:
+        keep = predicate(v)
+        if not isinstance(keep, bool):
+            msg = (
+                f"return value of predicate {f_repr(predicate)} "
+                f"in step {step_id!r} must be a bool; got {keep!r} instead"
+            )
+            raise TypeError(msg)
+        if keep:
+            return (v,)
+        return _EMPTY
+
+    return flat_map_value("filter", up, shim_mapper)
+
+
+@operator
+def filter_map(
+    step_id: str,
+    up: Stream[X],
+    mapper: Callable[[X], Optional[Y]],
+) -> Stream[Y]:
+    """Transform items one-to-maybe-one; ``None`` is discarded.
+
+    Reference parity: ``operators/__init__.py:1790``.
+    """
+
+    def shim_mapper(x: X) -> Iterable[Y]:
+        y = mapper(x)
+        if y is not None:
+            return (y,)
+        return _EMPTY
+
+    return flat_map("flat_map", up, shim_mapper)
+
+
+@operator
+def filter_map_value(
+    step_id: str,
+    up: KeyedStream[V],
+    mapper: Callable[[V], Optional[W]],
+) -> KeyedStream[W]:
+    """Transform values one-to-maybe-one; ``None`` is discarded.
+
+    Reference parity: ``operators/__init__.py:1860``.
+    """
+
+    def shim_mapper(v: V) -> Iterable[W]:
+        w = mapper(v)
+        if w is not None:
+            return (w,)
+        return _EMPTY
+
+    return flat_map_value("flat_map_value", up, shim_mapper)
+
+
+@operator
+def inspect(
+    step_id: str,
+    up: Stream[X],
+    inspector: Callable[[str, X], None] = None,  # type: ignore[assignment]
+) -> Stream[X]:
+    """Observe items for debugging; prints by default.
+
+    Reference parity: ``operators/__init__.py:2021``.
+    """
+    if inspector is None:
+        def inspector(i_step_id: str, item: X) -> None:  # noqa: A002
+            print(f"{i_step_id}: {item!r}", flush=True)
+
+    def shim_inspector(
+        _fq_step_id: str, item: X, _epoch: int, _worker_idx: int
+    ) -> None:
+        inspector(step_id, item)
+
+    return inspect_debug("inspect_debug", up, shim_inspector)
+
+
+@operator
+def key_on(step_id: str, up: Stream[X], key: Callable[[X], str]) -> KeyedStream[X]:
+    """Add a key for each item, making a :class:`KeyedStream`.
+
+    Reference parity: ``operators/__init__.py:2375``.
+    """
+
+    def shim_mapper(x: X) -> Tuple[str, X]:
+        k = key(x)
+        if not isinstance(k, str):
+            msg = (
+                f"return value of key function {f_repr(key)} "
+                f"in step {step_id!r} must be a str; got {k!r} instead"
+            )
+            raise TypeError(msg)
+        return (k, x)
+
+    return map("map", up, shim_mapper)
+
+
+@operator
+def key_rm(step_id: str, up: KeyedStream[X]) -> Stream[X]:
+    """Discard keys.
+
+    Reference parity: ``operators/__init__.py:2439``.
+    """
+
+    def shim_mapper(k_v: Tuple[str, X]) -> X:
+        _k, v = k_v
+        return v
+
+    return map("map", up, shim_mapper)
+
+
+@operator
+def map(  # noqa: A001
+    step_id: str,
+    up: Stream[X],
+    mapper: Callable[[X], Y],
+) -> Stream[Y]:
+    """Transform items one-by-one.
+
+    Reference parity: ``operators/__init__.py:2497``.
+    """
+
+    def shim_mapper(xs: List[X]) -> Iterable[Y]:
+        return [mapper(x) for x in xs]
+
+    return flat_map_batch("flat_map_batch", up, shim_mapper)
+
+
+@operator
+def map_value(
+    step_id: str,
+    up: KeyedStream[V],
+    mapper: Callable[[V], W],
+) -> KeyedStream[W]:
+    """Transform values one-by-one.
+
+    Reference parity: ``operators/__init__.py:2557``.
+    """
+
+    def shim_mapper(k_v: Tuple[str, V]) -> Tuple[str, W]:
+        try:
+            k, v = k_v
+        except TypeError as ex:
+            msg = (
+                f"step {step_id!r} requires (key, value) 2-tuple from "
+                f"upstream; got a {type(k_v)!r} instead"
+            )
+            raise TypeError(msg) from ex
+        return (k, mapper(v))
+
+    return map("map", up, shim_mapper)
+
+
+@operator
+def raises(step_id: str, up: Stream[Any]) -> None:
+    """Raise an exception and crash the dataflow on any item.
+
+    Reference parity: ``operators/__init__.py:2767``.
+    """
+
+    def shim_mapper(x: Any) -> Iterable[Any]:
+        msg = f"`raises` step {step_id!r} got an item: {x!r}"
+        raise RuntimeError(msg)
+
+    from bytewax_tpu.connectors.stdio import StdOutSink
+
+    nop = flat_map("flat_map", up, shim_mapper)
+    return output("output", nop, StdOutSink())
+
+
+# --------------------------------------------------------------------------
+# Keyed aggregation sugar
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _FoldFinalLogic(StatefulLogic[V, S, S]):
+    step_id: str
+    folder: Callable[[S, V], S]
+    state: S
+
+    def on_item(self, value: V) -> Tuple[Iterable[S], bool]:
+        self.state = self.folder(self.state, value)
+        return (_EMPTY, StatefulLogic.RETAIN)
+
+    def on_eof(self) -> Tuple[Iterable[S], bool]:
+        return ((self.state,), StatefulLogic.DISCARD)
+
+    def snapshot(self) -> S:
+        return copy.deepcopy(self.state)
+
+
+@operator
+def fold_final(
+    step_id: str,
+    up: KeyedStream[V],
+    builder: Callable[[], S],
+    folder: Callable[[S, V], S],
+) -> KeyedStream[S]:
+    """Build an empty accumulator, then combine values into it; emit at
+    EOF.  Only works on finite streams.
+
+    Reference parity: ``operators/__init__.py:1944``.
+    """
+
+    def shim_builder(resume_state: Optional[S]) -> _FoldFinalLogic[V, S]:
+        state = resume_state if resume_state is not None else builder()
+        return _FoldFinalLogic(step_id, folder, state)
+
+    return stateful("stateful", up, shim_builder)
+
+
+@operator
+def count_final(
+    step_id: str,
+    up: Stream[X],
+    key: Callable[[X], str],
+) -> KeyedStream[int]:
+    """Count the number of occurrences of items in the entire stream;
+    emit at EOF.  Only works on finite streams.
+
+    Vectorized on the XLA tier as a segment-sum over hashed key ids.
+
+    Reference parity: ``operators/__init__.py:1221``.
+    """
+    down = map("key", up, lambda x: (key(x), 1))
+    return reduce_final("sum", down, lambda s, x: s + x)
+
+
+@operator
+def max_final(
+    step_id: str,
+    up: KeyedStream[V],
+    by=_identity,
+) -> KeyedStream:
+    """Find the maximum value for each key; emit at EOF.
+
+    Reference parity: ``operators/__init__.py:2624``.
+    """
+    return reduce_final("reduce_final", up, lambda s, x: max(s, x, key=by))
+
+
+@operator
+def min_final(
+    step_id: str,
+    up: KeyedStream[V],
+    by=_identity,
+) -> KeyedStream:
+    """Find the minimum value for each key; emit at EOF.
+
+    Reference parity: ``operators/__init__.py:2692``.
+    """
+    return reduce_final("reduce_final", up, lambda s, x: min(s, x, key=by))
+
+
+@operator
+def reduce_final(
+    step_id: str,
+    up: KeyedStream[V],
+    reducer: Callable[[V, V], V],
+) -> KeyedStream[V]:
+    """Distill all values for a key down into a single value; emit at
+    EOF.  Like :func:`fold_final` but the first value is the initial
+    accumulator.
+
+    Includes a map-side pre-combine within each batch (the reference
+    does the same: ``operators/__init__.py:2836-2847``), which is also
+    what lets the XLA tier turn this into a device-side segment
+    reduction.
+    """
+
+    def pre_reducer(mixed_batch: List[Tuple[str, V]]) -> Iterable[Tuple[str, V]]:
+        states: Dict[str, V] = {}
+        for k, v in mixed_batch:
+            if k in states:
+                states[k] = reducer(states[k], v)
+            else:
+                states[k] = v
+        return states.items()
+
+    pre_up = flat_map_batch("pre_reduce", up, pre_reducer)
+
+    def shim_folder(s: V, v: V) -> V:
+        if s is None:
+            return v
+        return reducer(s, v)
+
+    return fold_final("fold_final", pre_up, _untyped_none, shim_folder)
+
+
+# --------------------------------------------------------------------------
+# collect
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _CollectState(Generic[V]):
+    acc: List[V]
+    timeout_at: datetime
+
+
+@dataclass
+class _CollectLogic(StatefulLogic[V, List[V], _CollectState[V]]):
+    step_id: str
+    now_getter: Callable[[], datetime]
+    timeout: timedelta
+    max_size: int
+    state: _CollectState[V]
+
+    def on_item(self, value: V) -> Tuple[Iterable[List[V]], bool]:
+        now = self.now_getter()
+        self.state.timeout_at = now + self.timeout
+        self.state.acc.append(value)
+        if len(self.state.acc) >= self.max_size:
+            return ((self.state.acc,), StatefulLogic.DISCARD)
+        return (_EMPTY, StatefulLogic.RETAIN)
+
+    def on_notify(self) -> Tuple[Iterable[List[V]], bool]:
+        return ((self.state.acc,), StatefulLogic.DISCARD)
+
+    def on_eof(self) -> Tuple[Iterable[List[V]], bool]:
+        return ((self.state.acc,), StatefulLogic.DISCARD)
+
+    def notify_at(self) -> Optional[datetime]:
+        return self.state.timeout_at
+
+    def snapshot(self) -> _CollectState[V]:
+        return copy.deepcopy(self.state)
+
+
+@operator
+def collect(
+    step_id: str,
+    up: KeyedStream[V],
+    timeout: timedelta,
+    max_size: int,
+) -> KeyedStream[List[V]]:
+    """Collect items into a list up to a size or a timeout.
+
+    Reference parity: ``operators/__init__.py:1148``.
+    """
+
+    def shim_builder(
+        resume_state: Optional[_CollectState[V]],
+    ) -> _CollectLogic[V]:
+        state = (
+            resume_state
+            if resume_state is not None
+            else _CollectState([], _get_system_utc() + timeout)
+        )
+        return _CollectLogic(step_id, _get_system_utc, timeout, max_size, state)
+
+    return stateful("stateful", up, shim_builder)
+
+
+# --------------------------------------------------------------------------
+# enrich_cached
+# --------------------------------------------------------------------------
+
+
+class TTLCache(Generic[DK, DV]):
+    """A dict-like cache with a fixed time-to-live.
+
+    Reference parity: ``operators/__init__.py:1275``.
+    """
+
+    def __init__(
+        self,
+        getter: Callable[[DK], DV],
+        now_getter: Callable[[], datetime],
+        ttl: timedelta,
+    ):
+        self._getter = getter
+        self._now_getter = now_getter
+        self._ttl = ttl
+        self._cache: Dict[DK, Tuple[datetime, DV]] = {}
+
+    def get(self, k: DK) -> DV:
+        """Get the cached value for a key, refreshing if expired."""
+        now = self._now_getter()
+        try:
+            ts, v = self._cache[k]
+            if now - ts >= self._ttl:
+                raise KeyError()
+        except KeyError:
+            v = self._getter(k)
+            self._cache[k] = (now, v)
+        return v
+
+    def remove(self, k: DK) -> None:
+        """Remove the cached value for a key."""
+        del self._cache[k]
+
+
+@operator
+def enrich_cached(
+    step_id: str,
+    up: Stream[X],
+    getter: Callable[[DK], DV],
+    mapper: Callable[[TTLCache[DK, DV], X], Y],
+    ttl: timedelta = timedelta.max,
+    _now_getter: Callable[[], datetime] = _get_system_utc,
+) -> Stream[Y]:
+    """Enrich / join items using a cached lookup to an external service.
+
+    Reference parity: ``operators/__init__.py:1314``.
+    """
+    now = _now_getter()
+
+    def batch_now_getter() -> datetime:
+        return now
+
+    cache = TTLCache(getter, batch_now_getter, ttl)
+
+    def shim_mapper(xs: List[X]) -> Iterable[Y]:
+        nonlocal now
+        now = _now_getter()
+        return [mapper(cache, x) for x in xs]
+
+    return flat_map_batch("flat_map_batch", up, shim_mapper)
+
+
+# --------------------------------------------------------------------------
+# join
+# --------------------------------------------------------------------------
+
+JoinInsertMode: TypeAlias = Literal["first", "last", "product"]
+"""How to handle multiple values from a side during a join:
+``first`` keeps only the first value per side, ``last`` the most
+recent, ``product`` keeps all (cross-join)."""
+
+JoinEmitMode: TypeAlias = Literal["complete", "final", "running"]
+"""When to emit joined rows: ``complete`` once all sides have a value
+(then the state resets), ``final`` only at EOF (finite streams only),
+``running`` on every new value (missing sides are ``None``)."""
+
+_LONE_NONE = [None]
+
+
+class _JoinState:
+    def __init__(self, seen: List[List[Any]]):
+        self.seen = seen
+
+    @classmethod
+    def for_side_count(cls, side_count: int) -> "_JoinState":
+        return cls([[] for _ in range(side_count)])
+
+    def set_val(self, side: int, value: Any) -> None:
+        self.seen[side] = [value]
+
+    def add_val(self, side: int, value: Any) -> None:
+        self.seen[side].append(value)
+
+    def is_set(self, side: int) -> bool:
+        return len(self.seen[side]) > 0
+
+    def all_set(self) -> bool:
+        return all(len(vals) > 0 for vals in self.seen)
+
+    def astuples(self) -> List[Tuple]:
+        return list(
+            itertools.product(
+                *(vals if vals else _LONE_NONE for vals in self.seen)
+            )
+        )
+
+    def clear(self) -> None:
+        self.seen = [[] for _ in self.seen]
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, _JoinState) and self.seen == other.seen
+
+    def __repr__(self) -> str:
+        return f"_JoinState({self.seen!r})"
+
+
+@dataclass
+class _JoinLogic(StatefulLogic[Tuple[int, Any], Tuple, _JoinState]):
+    insert_mode: str
+    emit_mode: str
+    state: _JoinState
+
+    def on_item(self, value: Tuple[int, Any]) -> Tuple[Iterable[Tuple], bool]:
+        side, side_value = value
+        if self.insert_mode == "first":
+            if not self.state.is_set(side):
+                self.state.set_val(side, side_value)
+        elif self.insert_mode == "last":
+            self.state.set_val(side, side_value)
+        else:  # product
+            self.state.add_val(side, side_value)
+
+        if self.emit_mode == "complete" and self.state.all_set():
+            return (self.state.astuples(), StatefulLogic.DISCARD)
+        if self.emit_mode == "running":
+            return (self.state.astuples(), StatefulLogic.RETAIN)
+        return (_EMPTY, StatefulLogic.RETAIN)
+
+    def on_eof(self) -> Tuple[Iterable[Tuple], bool]:
+        if self.emit_mode == "final":
+            return (self.state.astuples(), StatefulLogic.DISCARD)
+        return (_EMPTY, StatefulLogic.RETAIN)
+
+    def snapshot(self) -> _JoinState:
+        return copy.deepcopy(self.state)
+
+
+@operator
+def _join_label_merge(
+    step_id: str,
+    *ups: KeyedStream[Any],
+) -> KeyedStream[Tuple[int, Any]]:
+    labeled = []
+    for i, up in enumerate(ups):
+        labeled.append(
+            map_value(f"label_{i}", up, lambda v, _i=i: (_i, v))
+        )
+    return merge("merge", *labeled)
+
+
+@operator
+def join(
+    step_id: str,
+    *sides: KeyedStream[Any],
+    insert_mode: JoinInsertMode = "last",
+    emit_mode: JoinEmitMode = "complete",
+) -> KeyedStream[Tuple]:
+    """Gather together the value for a key on multiple streams.
+
+    Reference parity: ``operators/__init__.py:2324``.
+    """
+    if insert_mode not in ("first", "last", "product"):
+        msg = f"unknown join insert mode {insert_mode!r}"
+        raise ValueError(msg)
+    if emit_mode not in ("complete", "final", "running"):
+        msg = f"unknown join emit mode {emit_mode!r}"
+        raise ValueError(msg)
+
+    side_count = len(sides)
+
+    def shim_builder(
+        resume_state: Optional[_JoinState],
+    ) -> _JoinLogic:
+        state = (
+            resume_state
+            if resume_state is not None
+            else _JoinState.for_side_count(side_count)
+        )
+        return _JoinLogic(insert_mode, emit_mode, state)
+
+    merged = _join_label_merge("add_names", *sides)
+    return stateful("join", merged, shim_builder)
+
+
+# --------------------------------------------------------------------------
+# stateful_map / stateful_flat_map
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _StatefulFlatMapLogic(StatefulLogic[V, W, S]):
+    step_id: str
+    mapper: Callable[[Optional[S], V], Tuple[Optional[S], Iterable[W]]]
+    state: Optional[S]
+
+    def on_item(self, value: V) -> Tuple[Iterable[W], bool]:
+        res = self.mapper(self.state, value)
+        try:
+            self.state, ws = res
+        except TypeError as ex:
+            msg = (
+                f"return value of mapper {f_repr(self.mapper)} in step "
+                f"{self.step_id!r} must be a 2-tuple of "
+                "(updated_state, emit_values); got a "
+                f"{type(res)!r} instead"
+            )
+            raise TypeError(msg) from ex
+        if self.state is None:
+            return (ws, StatefulLogic.DISCARD)
+        return (ws, StatefulLogic.RETAIN)
+
+    def snapshot(self) -> S:
+        return copy.deepcopy(self.state)  # type: ignore[return-value]
+
+
+@operator
+def stateful_flat_map(
+    step_id: str,
+    up: KeyedStream[V],
+    mapper: Callable[[Optional[S], V], Tuple[Optional[S], Iterable[W]]],
+) -> KeyedStream[W]:
+    """Transform values one-to-many, referencing a persistent state.
+
+    Returning ``None`` as the updated state discards it.
+
+    Reference parity: ``operators/__init__.py:2893``.
+    """
+
+    def shim_builder(resume_state: Optional[S]) -> _StatefulFlatMapLogic[V, W, S]:
+        return _StatefulFlatMapLogic(step_id, mapper, resume_state)
+
+    return stateful("stateful", up, shim_builder)
+
+
+@operator
+def stateful_map(
+    step_id: str,
+    up: KeyedStream[V],
+    mapper: Callable[[Optional[S], V], Tuple[Optional[S], W]],
+) -> KeyedStream[W]:
+    """Transform values one-to-one, referencing a persistent state.
+
+    Returning ``None`` as the updated state discards it.
+
+    Reference parity: ``operators/__init__.py:2920``.
+    """
+
+    def shim_mapper(
+        state: Optional[S], value: V
+    ) -> Tuple[Optional[S], Iterable[W]]:
+        res = mapper(state, value)
+        try:
+            state, w = res
+        except TypeError as ex:
+            msg = (
+                f"return value of mapper {f_repr(mapper)} in step "
+                f"{step_id!r} must be a 2-tuple of (updated_state, "
+                f"emit_value); got a {type(res)!r} instead"
+            )
+            raise TypeError(msg) from ex
+        return (state, (w,))
+
+    return stateful_flat_map("stateful_flat_map", up, shim_mapper)

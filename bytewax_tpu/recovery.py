@@ -1,0 +1,82 @@
+"""Failure recovery.
+
+Snapshots of all operator/partition state are taken at every epoch
+boundary and written into a fixed number of SQLite *recovery
+partitions*; on resume the engine computes the epoch to roll back to
+and rebuilds all state from the latest consistent snapshots.  The
+partition count is independent of the worker/chip count, which is what
+makes rescaling work.
+
+Store layout parity with the reference (``/root/reference/src/recovery.rs``):
+``part-{i}.sqlite3`` files, snapshots keyed by ``(step_id, state_key,
+epoch)``, per-execution frontier rows, and a delayed commit (GC)
+watermark controlled by ``backup_interval``.
+
+Usage: create the fixed partition set once with :func:`init_db_dir`
+(or ``python -m bytewax_tpu.recovery``), then pass a
+:class:`RecoveryConfig` to the entry point.
+"""
+
+import argparse
+from datetime import timedelta
+from pathlib import Path
+from typing import Optional, Union
+
+from bytewax_tpu.engine.recovery_store import (
+    InconsistentPartitionsError,
+    MissingPartitionsError,
+    NoPartitionsError,
+    init_db_dir,
+)
+
+__all__ = [
+    "InconsistentPartitionsError",
+    "MissingPartitionsError",
+    "NoPartitionsError",
+    "RecoveryConfig",
+    "init_db_dir",
+]
+
+
+class RecoveryConfig:
+    """Configuration settings for recovery.
+
+    :arg db_dir: Local directory holding recovery partitions,
+        pre-created via :func:`init_db_dir`.
+
+    :arg backup_interval: Amount of system time to wait to permanently
+        delete a state snapshot after it is no longer needed.  Set to
+        how long it takes you to copy the partition files off-machine.
+        Defaults to zero.
+    """
+
+    def __init__(
+        self,
+        db_dir: Union[str, Path],
+        backup_interval: Optional[timedelta] = None,
+    ):
+        self.db_dir = Path(db_dir)
+        self.backup_interval = (
+            backup_interval if backup_interval is not None else timedelta(0)
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"RecoveryConfig({str(self.db_dir)!r}, "
+            f"backup_interval={self.backup_interval!r})"
+        )
+
+
+def _main() -> None:
+    parser = argparse.ArgumentParser(
+        prog="python -m bytewax_tpu.recovery",
+        description="Create a new set of empty recovery partitions.",
+    )
+    parser.add_argument("db_dir", type=Path, help="Directory to create partitions in")
+    parser.add_argument("part_count", type=int, help="Number of partitions")
+    args = parser.parse_args()
+    init_db_dir(args.db_dir, args.part_count)
+
+
+if __name__ == "__main__":
+    _main()

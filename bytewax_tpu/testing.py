@@ -1,0 +1,189 @@
+"""Helper tools for testing dataflows.
+
+API parity with the reference (``/root/reference/pysrc/bytewax/testing.py``);
+implementation is our own.
+"""
+
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta, timezone
+from itertools import islice
+from typing import Any, Iterable, Iterator, List, Optional, TypeVar, Union
+
+from bytewax_tpu.inputs import (
+    AbortExecution,
+    FixedPartitionedSource,
+    StatefulSourcePartition,
+)
+from bytewax_tpu.outputs import DynamicSink, StatelessSinkPartition
+from bytewax_tpu.engine.driver import cluster_main, run_main
+
+X = TypeVar("X")
+
+__all__ = [
+    "TestingSink",
+    "TestingSource",
+    "TimeTestingGetter",
+    "cluster_main",
+    "ffwd_iter",
+    "poll_next_batch",
+    "run_main",
+]
+
+
+@dataclass
+class TimeTestingGetter:
+    """Wrapper providing a modifiable fake clock for unit tests."""
+
+    now: datetime
+
+    def advance(self, td: timedelta) -> None:
+        """Advance the current time by ``td``."""
+        self.now += td
+
+    def get(self) -> datetime:
+        """Return the "current time"."""
+        return self.now
+
+
+def ffwd_iter(it: Iterator[Any], n: int) -> None:
+    """Skip a stateful iterator forward ``n`` items."""
+    next(islice(it, n, n), None)
+
+
+class TestingSource(FixedPartitionedSource[X, int]):
+    """Produce input from a Python iterable; unit testing only.
+
+    The iterable may contain in-band control sentinels: :class:`EOF`
+    stops this execution (the next resumes after it), :class:`ABORT`
+    simulates a crash (triggers once; the next execution replays from
+    the last snapshot), :class:`PAUSE` stops emitting for a duration.
+    """
+
+    __test__ = False
+
+    @dataclass
+    class EOF:
+        """Signal the input to EOF; the next execution continues from
+        the item after this."""
+
+    @dataclass
+    class ABORT:
+        """Abort the execution when the input reaches this item.
+
+        Each abort only triggers once; skipped on resume.  Not usable
+        in multi-worker executions.
+        """
+
+        _triggered: bool = False
+
+    @dataclass
+    class PAUSE:
+        """Signal this input to not emit items for a duration."""
+
+        for_duration: timedelta = field(default_factory=timedelta)
+
+    def __init__(
+        self,
+        ib: Iterable[Union[X, EOF, ABORT, PAUSE]],
+        batch_size: int = 1,
+    ):
+        self._ib = ib
+        self._batch_size = batch_size
+
+    def list_parts(self) -> List[str]:
+        return ["iterable"]
+
+    def build_part(
+        self, step_id: str, for_part: str, resume_state: Optional[int]
+    ) -> "_IterSourcePartition[X]":
+        return _IterSourcePartition(self._ib, self._batch_size, resume_state)
+
+
+class _IterSourcePartition(StatefulSourcePartition[X, int]):
+    def __init__(
+        self,
+        ib: Iterable,
+        batch_size: int,
+        resume_state: Optional[int],
+    ):
+        self._start_idx = 0 if resume_state is None else resume_state
+        self._batch_size = batch_size
+        self._next_awake: Optional[datetime] = None
+        self._it = iter(ib)
+        ffwd_iter(self._it, self._start_idx)
+        self._raise: Optional[Exception] = None
+
+    def next_batch(self) -> List[X]:
+        if self._raise is not None:
+            raise self._raise
+        self._next_awake = None
+
+        batch: List[X] = []
+        for item in self._it:
+            if isinstance(item, TestingSource.EOF):
+                self._raise = StopIteration()
+                # Skip over the sentinel on continuation.
+                self._start_idx += 1
+                break
+            elif isinstance(item, TestingSource.ABORT):
+                if not item._triggered:
+                    self._raise = AbortExecution()
+                    item._triggered = True
+                    break
+            elif isinstance(item, TestingSource.PAUSE):
+                now = datetime.now(tz=timezone.utc)
+                self._next_awake = now + item.for_duration
+                break
+            else:
+                batch.append(item)
+                if len(batch) >= self._batch_size:
+                    break
+
+        if batch or self._raise is not None or self._next_awake is not None:
+            self._start_idx += len(batch)
+            return batch
+        raise StopIteration()
+
+    def next_awake(self) -> Optional[datetime]:
+        return self._next_awake
+
+    def snapshot(self) -> int:
+        return self._start_idx
+
+
+class _ListSinkPartition(StatelessSinkPartition[X]):
+    def __init__(self, ls: List[X]):
+        self._ls = ls
+
+    def write_batch(self, items: List[X]) -> None:
+        self._ls += items
+
+
+class TestingSink(DynamicSink[X]):
+    """Append each output item to a list; unit testing only.
+
+    The list is not cleared between executions.
+    """
+
+    __test__ = False
+
+    def __init__(self, ls: List[X]):
+        self._ls = ls
+
+    def build(
+        self, step_id: str, worker_index: int, worker_count: int
+    ) -> _ListSinkPartition[X]:
+        return _ListSinkPartition(self._ls)
+
+
+def poll_next_batch(
+    part: StatefulSourcePartition, timeout: timedelta = timedelta(seconds=5)
+) -> List:
+    """Repeatedly poll a partition until it returns a batch."""
+    batch: List = []
+    start = datetime.now(timezone.utc)
+    while len(batch) <= 0:
+        if datetime.now(timezone.utc) - start > timeout:
+            raise TimeoutError()
+        batch = list(part.next_batch())
+    return batch

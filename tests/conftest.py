@@ -1,0 +1,63 @@
+"""pytest config: fixtures mirroring the reference's test strategy
+(SURVEY.md §4): every dataflow test runs under three entry points
+(single lane, cluster with 1 lane, cluster with 2 lanes), and device
+tests run on a virtual 8-device CPU mesh."""
+
+import os
+
+# Force a deterministic virtual 8-device CPU mesh for all tests BEFORE
+# jax initializes; real TPU runs use bench.py / run.py directly.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+from datetime import datetime, timezone  # noqa: E402
+
+from pytest import fixture  # noqa: E402
+
+from bytewax_tpu.recovery import RecoveryConfig, init_db_dir  # noqa: E402
+from bytewax_tpu.testing import cluster_main, run_main  # noqa: E402
+
+
+@fixture(params=["run_main", "cluster_main-1thread", "cluster_main-2thread"])
+def entry_point_name(request):
+    """Run a version of the test for each execution entry point."""
+    return request.param
+
+
+def _wrapped_cluster_main1x2(*args, **kwargs):
+    return cluster_main(*args, [], 0, worker_count_per_proc=2, **kwargs)
+
+
+def _wrapped_cluster_main1x1(*args, **kwargs):
+    return cluster_main(*args, [], 0, **kwargs)
+
+
+@fixture
+def entry_point(entry_point_name):
+    """Callable for each execution entry point."""
+    if entry_point_name == "run_main":
+        return run_main
+    elif entry_point_name == "cluster_main-1thread":
+        return _wrapped_cluster_main1x1
+    elif entry_point_name == "cluster_main-2thread":
+        return _wrapped_cluster_main1x2
+    else:
+        msg = f"unknown entry point name: {entry_point_name!r}"
+        raise ValueError(msg)
+
+
+@fixture
+def recovery_config(tmp_path):
+    """A recovery config pointing at a 1-partition store."""
+    init_db_dir(tmp_path, 1)
+    yield RecoveryConfig(str(tmp_path))
+
+
+@fixture
+def now():
+    """Current datetime in UTC."""
+    yield datetime.now(timezone.utc)

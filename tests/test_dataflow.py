@@ -1,0 +1,116 @@
+"""Graph data model tests (reference model:
+``/root/reference/pytests/test_dataflow.py``)."""
+
+import re
+
+import pytest
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow, DataflowError
+from bytewax_tpu.engine.flatten import flatten
+from bytewax_tpu.testing import TestingSink, TestingSource
+
+
+def test_flow_requires_id():
+    with pytest.raises(DataflowError):
+        Dataflow("")
+
+
+def test_flow_id_no_period():
+    with pytest.raises(DataflowError, match="period"):
+        Dataflow("a.b")
+
+
+def test_step_id_no_period():
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource([]))
+    with pytest.raises(DataflowError, match="period"):
+        op.map("a.b", s, lambda x: x)
+
+
+def test_step_id_must_be_string():
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource([]))
+    with pytest.raises(DataflowError):
+        op.map(17, s, lambda x: x)
+
+
+def test_duplicate_step_id_raises():
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource([]))
+    op.map("dup", s, lambda x: x)
+    with pytest.raises(DataflowError, match="dup"):
+        op.map("dup", s, lambda x: x)
+
+
+def test_stream_from_other_flow_raises():
+    flow_a = Dataflow("a")
+    flow_b = Dataflow("b")
+    s_a = op.input("inp", flow_a, TestingSource([]))
+    s_b = op.input("inp", flow_b, TestingSource([]))
+    with pytest.raises(DataflowError, match="different dataflow"):
+        op.merge("bad", s_b, s_a)
+
+
+def test_then_chaining():
+    flow = Dataflow("test_df")
+    out = []
+    (
+        op.input("inp", flow, TestingSource([1, 2]))
+        .then(op.map, "double", lambda x: x * 2)
+        .then(op.output, "out", TestingSink(out))
+    )
+    ids = [o.step_id for o in flow.substeps]
+    assert ids == ["test_df.inp", "test_df.double", "test_df.out"]
+
+
+def test_nested_step_ids():
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource([1]))
+    op.map("my_map", s, lambda x: x)
+    outer = flow.substeps[1]
+    assert outer.step_id == "test_df.my_map"
+    assert not outer.core
+    inner = outer.substeps[0]
+    assert inner.step_id == "test_df.my_map.flat_map_batch"
+    assert inner.core
+
+
+def test_flatten_requires_input():
+    flow = Dataflow("test_df")
+    with pytest.raises(DataflowError, match="input"):
+        flatten(flow)
+
+
+def test_flatten_requires_output():
+    flow = Dataflow("test_df")
+    op.input("inp", flow, TestingSource([]))
+    with pytest.raises(DataflowError, match="output"):
+        flatten(flow)
+
+
+def test_flatten_core_only():
+    flow = Dataflow("test_df")
+    out = []
+    s = op.input("inp", flow, TestingSource([1]))
+    s = op.map("m", s, lambda x: x)
+    b = op.branch("b", s, lambda x: True)
+    m = op.merge("mg", b.trues, b.falses)
+    op.output("out", m, TestingSink(out))
+    plan = flatten(flow)
+    assert all(o.core for o in plan.ops)
+    names = [o.name for o in plan.ops]
+    assert names == ["input", "flat_map_batch", "branch", "merge", "output"]
+
+
+def test_operator_requires_stream_arg():
+    with pytest.raises(DataflowError, match="Stream or\n?.*Dataflow"):
+        op.map("m", 42, lambda x: x)
+
+
+def test_branch_out_fields():
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource([1]))
+    b = op.branch("b", s, lambda x: x > 0)
+    assert b.trues.stream_id.endswith("trues")
+    assert b.falses.stream_id.endswith("falses")

@@ -1,0 +1,336 @@
+"""End-to-end host-tier execution tests (model:
+``/root/reference/pytests/operators/``)."""
+
+import re
+
+import pytest
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+
+def test_map(entry_point):
+    inp = [0, 1, 2]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.map("add_one", s, lambda x: x + 1)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [1, 2, 3]
+
+
+def test_filter(entry_point):
+    inp = [1, 2, 3, 4]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.filter("is_odd", s, lambda x: x % 2 == 1)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [1, 3]
+
+
+def test_filter_raises_on_non_bool():
+    inp = [1]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.filter("bad", s, lambda x: x)  # not a bool
+    op.output("out", s, TestingSink(out))
+    with pytest.raises(TypeError, match="must be a `?bool`?"):
+        run_main(flow)
+
+
+def test_flat_map(entry_point):
+    inp = ["a b", "c"]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.flat_map("split", s, lambda x: x.split())
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == ["a", "b", "c"]
+
+
+def test_branch(entry_point):
+    inp = [1, 2, 3, 4]
+    evens = []
+    odds = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    b = op.branch("parity", s, lambda x: x % 2 == 0)
+    op.output("evens", b.trues, TestingSink(evens))
+    op.output("odds", b.falses, TestingSink(odds))
+    entry_point(flow)
+    assert sorted(evens) == [2, 4]
+    assert sorted(odds) == [1, 3]
+
+
+def test_merge(entry_point):
+    out = []
+    flow = Dataflow("test_df")
+    s1 = op.input("inp1", flow, TestingSource([1, 2]))
+    s2 = op.input("inp2", flow, TestingSource([3, 4]))
+    m = op.merge("m", s1, s2)
+    op.output("out", m, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [1, 2, 3, 4]
+
+
+def test_key_on_key_rm(entry_point):
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource([1, 2]))
+    k = op.key_on("key", s, lambda x: str(x))
+    u = op.key_rm("unkey", k)
+    op.output("out", u, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [1, 2]
+
+
+def test_redistribute(entry_point):
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource([1, 2, 3]))
+    s = op.redistribute("redist", s)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [1, 2, 3]
+
+
+def test_stateful_map(entry_point):
+    inp = [("a", 1), ("b", 10), ("a", 2), ("b", 20)]
+    out = []
+
+    def running_sum(state, v):
+        state = (state or 0) + v
+        return (state, state)
+
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.stateful_map("sum", s, running_sum)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("a", 1), ("a", 3), ("b", 10), ("b", 30)]
+
+
+def test_stateful_map_requires_str_key():
+    inp = [(1, 1)]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.stateful_map("sum", s, lambda st, v: (st, v))
+    op.output("out", s, TestingSink(out))
+    with pytest.raises(TypeError, match="str"):
+        run_main(flow)
+
+
+def test_stateful_map_requires_2_tuple():
+    inp = [17]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.stateful_map("sum", s, lambda st, v: (st, v))
+    op.output("out", s, TestingSink(out))
+    with pytest.raises(TypeError, match="2-tuple"):
+        run_main(flow)
+
+
+def test_reduce_final(entry_point):
+    inp = [("a", 1), ("a", 2), ("b", 5)]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.reduce_final("sum", s, lambda a, b: a + b)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("a", 3), ("b", 5)]
+
+
+def test_fold_final(entry_point):
+    inp = [("a", 1), ("a", 2), ("b", 5)]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.fold_final("collect", s, list, lambda acc, x: acc + [x])
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("a", [1, 2]), ("b", [5])]
+
+
+def test_count_final(entry_point):
+    inp = ["apple", "banana", "apple", "banana", "banana"]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.count_final("count", s, lambda x: x)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("apple", 2), ("banana", 3)]
+
+
+def test_max_final(entry_point):
+    inp = [("key1", 1), ("key1", 3), ("key2", 2), ("key2", 19)]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.max_final("max", s)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("key1", 3), ("key2", 19)]
+
+
+def test_min_final(entry_point):
+    inp = [("key1", 1), ("key1", 3), ("key2", 2), ("key2", 19)]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.min_final("min", s)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("key1", 1), ("key2", 2)]
+
+
+def test_wordcount(entry_point):
+    inp = ["a b a", "b a"]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.flat_map("split", s, str.split)
+    s = op.count_final("count", s, lambda w: w)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("a", 3), ("b", 2)]
+
+
+def test_raises_op():
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource([1]))
+    op.raises("raises", s)
+    with pytest.raises(RuntimeError, match="raises"):
+        run_main(flow)
+
+
+def test_inspect(capsys):
+    flow = Dataflow("my_flow")
+    s = op.input("inp", flow, TestingSource([0, 1, 2]))
+    s = op.inspect("help", s)
+    out = []
+    op.output("out", s, TestingSink(out))
+    run_main(flow)
+    captured = capsys.readouterr()
+    assert captured.out == "my_flow.help: 0\nmy_flow.help: 1\nmy_flow.help: 2\n"
+
+
+def test_inspect_debug_epoch_worker(capsys):
+    flow = Dataflow("my_flow")
+    s = op.input("inp", flow, TestingSource([0]))
+    s = op.inspect_debug("help", s)
+    out = []
+    op.output("out", s, TestingSink(out))
+    run_main(flow)
+    captured = capsys.readouterr()
+    assert captured.out == "my_flow.help W0 @1: 0\n"
+
+
+def test_join(entry_point):
+    out = []
+    flow = Dataflow("test_df")
+    l = op.input("l", flow, TestingSource([("a", 1)]))
+    r = op.input("r", flow, TestingSource([("a", "x")]))
+    j = op.join("join", l, r)
+    op.output("out", j, TestingSink(out))
+    entry_point(flow)
+    assert out == [("a", (1, "x"))]
+
+
+def test_join_running(entry_point):
+    out = []
+    flow = Dataflow("test_df")
+    l = op.input("l", flow, TestingSource([("a", 1), ("a", 2)], batch_size=10))
+    r = op.input("r", flow, TestingSource([("a", "x")]))
+    j = op.join("join", l, r, emit_mode="running")
+    op.output("out", j, TestingSink(out))
+    entry_point(flow)
+    # Every update emits a row; missing sides are None.
+    assert ("a", (2, "x")) in out or ("a", (1, None)) in out
+    assert len(out) >= 2
+
+
+def test_stateful_flat_map(entry_point):
+    inp = [("a", 1), ("a", 2)]
+    out = []
+
+    def dup(state, v):
+        state = (state or 0) + 1
+        return (state, [v] * state)
+
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.stateful_flat_map("dup", s, dup)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("a", 1), ("a", 2), ("a", 2)]
+
+
+def test_flat_map_value(entry_point):
+    inp = [("a", "x y")]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.flat_map_value("split", s, str.split)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("a", "x"), ("a", "y")]
+
+
+def test_filter_map(entry_point):
+    inp = ["1", "two", "3"]
+    out = []
+
+    def parse(x):
+        try:
+            return int(x)
+        except ValueError:
+            return None
+
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.filter_map("parse", s, parse)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [1, 3]
+
+
+def test_flatten(entry_point):
+    inp = [[1, 2], [3]]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.flatten("flatten", s)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [1, 2, 3]
+
+
+def test_stateful_mid_batch_discard_continues(entry_point):
+    # A discard mid-batch must not drop the remaining values for that
+    # key in the same delivery batch.
+    inp = [("k", 1), ("k", 2), ("k", 3), ("k", 4)]
+    out = []
+
+    def discard_at_3(state, v):
+        total = (state or 0) + v
+        if total >= 3:
+            return (None, total)  # discard state
+        return (total, total)
+
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=4))
+    s = op.stateful_map("sum", s, discard_at_3)
+    op.output("out", s, TestingSink(out))
+    entry_point(flow)
+    assert out == [("k", 1), ("k", 3), ("k", 3), ("k", 4)]

@@ -1,0 +1,286 @@
+"""Recovery/resume semantics tests (model:
+``/root/reference/pytests/test_recovery.py`` — same scenarios, asserting
+identical replay sets)."""
+
+import os
+import shutil
+from datetime import timedelta
+
+import pytest
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.recovery import (
+    InconsistentPartitionsError,
+    MissingPartitionsError,
+    NoPartitionsError,
+    RecoveryConfig,
+    init_db_dir,
+)
+from bytewax_tpu.testing import TestingSink, TestingSource, cluster_main, run_main
+
+ZERO_TD = timedelta(seconds=0)
+FIVE_TD = timedelta(seconds=5)
+
+
+def test_abort_no_snapshots(recovery_config):
+    inp = [0, 1, 2, TestingSource.ABORT(), 3, 4]
+    out = []
+
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    op.output("out", s, TestingSink(out))
+
+    # Epoch interval of 5s means no snapshot before the abort.
+    run_main(flow, epoch_interval=FIVE_TD, recovery_config=recovery_config)
+    assert out == [0, 1, 2]
+
+    # So resume replays all input.
+    out.clear()
+    run_main(flow, epoch_interval=FIVE_TD, recovery_config=recovery_config)
+    assert out == [0, 1, 2, 3, 4]
+
+
+def test_abort_with_snapshots(recovery_config):
+    inp = [0, 1, 2, TestingSource.ABORT(), 3, 4]
+    out = []
+
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    op.output("out", s, TestingSink(out))
+
+    # Epoch interval of 0 means a snapshot after each item.
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    assert out == [0, 1, 2]
+
+    # Resume as if it was an EOF.
+    out.clear()
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    assert out == [3, 4]
+
+
+def test_continuation(recovery_config):
+    inp = [0, 1, 2, TestingSource.EOF(), 3, 4]
+    out = []
+
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    op.output("out", s, TestingSink(out))
+
+    run_main(flow, epoch_interval=FIVE_TD, recovery_config=recovery_config)
+    assert out == [0, 1, 2]
+
+    out.clear()
+    run_main(flow, epoch_interval=FIVE_TD, recovery_config=recovery_config)
+    assert out == [3, 4]
+
+    out.clear()
+    run_main(flow, epoch_interval=FIVE_TD, recovery_config=recovery_config)
+    assert out == []
+
+    out.clear()
+    run_main(flow, epoch_interval=FIVE_TD, recovery_config=recovery_config)
+    assert out == []
+
+
+def test_continuation_with_delayed_backup(tmp_path):
+    init_db_dir(tmp_path, 1)
+    recovery_config = RecoveryConfig(str(tmp_path), backup_interval=FIVE_TD * 2)
+
+    inp = [
+        0,
+        TestingSource.EOF(),
+        1,
+        TestingSource.EOF(),
+        2,
+        TestingSource.EOF(),
+        3,
+        TestingSource.EOF(),
+        4,
+    ]
+    out = []
+
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    op.output("out", s, TestingSink(out))
+
+    for expect in ([0], [1], [2], [3], [4], []):
+        out.clear()
+        run_main(flow, epoch_interval=FIVE_TD, recovery_config=recovery_config)
+        assert out == expect
+
+
+def keep_max(max_val, new_val):
+    if max_val is None:
+        max_val = 0
+    max_val = max(max_val, new_val)
+    return (max_val, max_val)
+
+
+def build_keep_max_dataflow(inp, out):
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.stateful_map("max", s, keep_max)
+    op.output("out", s, TestingSink(out))
+    return flow
+
+
+def test_stateful_continuation(recovery_config):
+    inp = [
+        ("a", 4),
+        ("b", 4),
+        TestingSource.EOF(),
+        ("a", 1),
+        ("b", 5),
+    ]
+    out = []
+    flow = build_keep_max_dataflow(inp, out)
+
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    assert out == [("a", 4), ("b", 4)]
+
+    # State (max so far) must survive the continuation.
+    out.clear()
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    assert out == [("a", 4), ("b", 5)]
+
+
+def test_rescale(tmp_path):
+    init_db_dir(tmp_path, 3)
+    recovery_config = RecoveryConfig(str(tmp_path))
+
+    inp = [
+        ("a", 4),
+        ("b", 4),
+        TestingSource.EOF(),
+        ("a", 1),
+        ("b", 5),
+        TestingSource.EOF(),
+        ("a", 8),
+        ("b", 1),
+    ]
+    out = []
+
+    flow = build_keep_max_dataflow(inp, out)
+
+    def entry_point(worker_count_per_proc):
+        cluster_main(
+            flow,
+            addresses=[],
+            proc_id=0,
+            epoch_interval=ZERO_TD,
+            recovery_config=recovery_config,
+            worker_count_per_proc=worker_count_per_proc,
+        )
+
+    # 2 continuations with different worker counts each time.
+    entry_point(3)
+    assert out == [("a", 4), ("b", 4)]
+
+    out.clear()
+    entry_point(5)
+    assert out == [("a", 4), ("b", 5)]
+
+    out.clear()
+    entry_point(1)
+    assert out == [("a", 8), ("b", 5)]
+
+
+def test_no_parts(tmp_path):
+    # Don't init_db_dir.
+    recovery_config = RecoveryConfig(str(tmp_path))
+
+    inp = []
+    out = []
+    flow = build_keep_max_dataflow(inp, out)
+
+    with pytest.raises(NoPartitionsError):
+        run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+
+
+def test_missing_parts(tmp_path):
+    init_db_dir(tmp_path, 3)
+    recovery_config = RecoveryConfig(str(tmp_path))
+
+    os.remove(tmp_path / "part-0.sqlite3")
+
+    inp = []
+    out = []
+    flow = build_keep_max_dataflow(inp, out)
+
+    with pytest.raises(MissingPartitionsError):
+        run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+
+
+def test_inconsistent_parts(tmp_path):
+    part_count = 3
+    init_db_dir(tmp_path, part_count)
+    recovery_config = RecoveryConfig(str(tmp_path), backup_interval=ZERO_TD)
+
+    for i in range(part_count):
+        shutil.copy(tmp_path / f"part-{i}.sqlite3", tmp_path / f"part-{i}.run0")
+
+    inp = [
+        ("a", 4),
+        ("b", 4),
+        TestingSource.ABORT(),
+        ("a", 1),
+        ("b", 5),
+    ]
+    out = []
+    flow = build_keep_max_dataflow(inp, out)
+
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    assert out == [("a", 4), ("b", 4)]
+
+    # Overwrite partition 0 with its initial (pre-run) version.  With
+    # backup interval 0 the other partitions have already GC'd the
+    # state needed to resume that far back.
+    out.clear()
+    shutil.copy(tmp_path / "part-0.run0", tmp_path / "part-0.sqlite3")
+    with pytest.raises(InconsistentPartitionsError):
+        run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+
+
+def test_fold_final_discard_not_resurrected(recovery_config):
+    # fold_final emits at EOF and discards its state; the discard must
+    # be durable so the key is not resurrected on the next execution.
+    inp = [
+        ("a", 1),
+        ("a", 2),
+        TestingSource.EOF(),
+        ("b", 10),
+    ]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.fold_final("sum", s, int, lambda acc, x: acc + x)
+    op.output("out", s, TestingSink(out))
+
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    assert sorted(out) == [("a", 3)]
+
+    out.clear()
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    assert sorted(out) == [("b", 10)]
+
+
+def test_fold_final_resume_mid_stream_keeps_state(recovery_config):
+    # An ABORT mid-stream must preserve partial fold state so the
+    # final result is identical to an uninterrupted run.
+    inp = [
+        ("a", 1),
+        TestingSource.ABORT(),
+        ("a", 2),
+    ]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.fold_final("sum", s, int, lambda acc, x: acc + x)
+    op.output("out", s, TestingSink(out))
+
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    assert out == []
+
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    assert out == [("a", 3)]
