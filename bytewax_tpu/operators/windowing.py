@@ -1,0 +1,1187 @@
+"""Time-based windowing operators.
+
+The windowing machinery is the Clock / Windower / WindowLogic triad,
+all pure composition over :func:`bytewax_tpu.operators.stateful_batch`
+(reference parity:
+``/root/reference/pysrc/bytewax/operators/windowing.py``;
+implementation is our own):
+
+- a :class:`Clock` assigns each value a timestamp and maintains the
+  *watermark* (the point in time before which no more values are
+  expected);
+- a :class:`Windower` maps timestamps to integer window ids, decides
+  lateness, merging, and closing;
+- a :class:`WindowLogic` accumulates values per open window.
+
+Window-id assignment for tumbling/sliding windows is pure arithmetic on
+``(timestamp - align_to) // offset`` — which is exactly what makes the
+XLA tier able to vectorize window bucketing as integer math on device.
+Session windows are data-dependent (gap merging) and stay key-local.
+"""
+
+import copy
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta, timezone
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Generic,
+    Iterable,
+    List,
+    Optional,
+    Set,
+    Tuple,
+    TypeVar,
+    Union,
+    cast,
+)
+
+from typing_extensions import Literal, Self, TypeAlias
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import KeyedStream, Stream, operator
+from bytewax_tpu.operators import (
+    JoinEmitMode,
+    JoinInsertMode,
+    StatefulBatchLogic,
+    _get_system_utc,
+    _identity,
+    _JoinState,
+    _untyped_none,
+)
+from bytewax_tpu.utils import partition
+
+V = TypeVar("V")
+W = TypeVar("W")
+W_co = TypeVar("W_co", covariant=True)
+X = TypeVar("X")
+S = TypeVar("S")
+SC = TypeVar("SC")
+SW = TypeVar("SW")
+
+ZERO_TD: timedelta = timedelta(seconds=0)
+
+UTC_MIN: datetime = datetime.min.replace(tzinfo=timezone.utc)
+"""Minimum representable datetime in UTC."""
+
+UTC_MAX: datetime = datetime.max.replace(tzinfo=timezone.utc)
+"""Maximum representable datetime in UTC."""
+
+LATE_SESSION_ID: int = -1
+"""Sentinel window ID assigned to late items in session windows."""
+
+_EMPTY: Tuple = ()
+
+__all__ = [
+    "Clock",
+    "ClockLogic",
+    "EventClock",
+    "LATE_SESSION_ID",
+    "SessionWindower",
+    "SlidingWindower",
+    "SystemClock",
+    "TumblingWindower",
+    "UTC_MAX",
+    "UTC_MIN",
+    "WindowLogic",
+    "WindowMetadata",
+    "WindowOut",
+    "Windower",
+    "WindowerLogic",
+    "ZERO_TD",
+    "collect_window",
+    "count_window",
+    "fold_window",
+    "join_window",
+    "max_window",
+    "min_window",
+    "reduce_window",
+    "window",
+]
+
+
+# --------------------------------------------------------------------------
+# Clocks
+# --------------------------------------------------------------------------
+
+
+class ClockLogic(ABC, Generic[V, S]):
+    """Instance of a clock on a single key; assigns timestamps and
+    tracks the watermark.  Watermarks must never go backwards."""
+
+    @abstractmethod
+    def before_batch(self) -> None:
+        """Prepare for a batch of incoming values (e.g. sample the
+        system clock once per batch)."""
+        ...
+
+    @abstractmethod
+    def on_item(self, value: V) -> Tuple[datetime, datetime]:
+        """Return ``(value_timestamp, current_watermark)``."""
+        ...
+
+    @abstractmethod
+    def on_notify(self) -> datetime:
+        """Return the current watermark on a timer wakeup."""
+        ...
+
+    @abstractmethod
+    def on_eof(self) -> datetime:
+        """Return the watermark at upstream EOF; return
+        :data:`UTC_MAX` to close all windows on EOF."""
+        ...
+
+    @abstractmethod
+    def to_system_utc(self, timestamp: datetime) -> Optional[datetime]:
+        """Convert a clock timestamp into the system time the engine
+        should wake up at; ``None`` disables timer wakeups."""
+        ...
+
+    @abstractmethod
+    def snapshot(self) -> S:
+        """Immutable copy of state for recovery."""
+        ...
+
+
+class Clock(ABC, Generic[V, S]):
+    """A definition of time for windowing operators."""
+
+    @abstractmethod
+    def build(self, resume_state: Optional[S]) -> ClockLogic[V, S]:
+        """Construct a new clock logic for a key (or resume one)."""
+        ...
+
+
+@dataclass
+class _SystemClockLogic(ClockLogic[Any, None]):
+    now_getter: Callable[[], datetime]
+    _now: datetime = field(init=False)
+
+    def __post_init__(self) -> None:
+        self._now = self.now_getter()
+
+    def before_batch(self) -> None:
+        self._now = self.now_getter()
+
+    def on_item(self, value: Any) -> Tuple[datetime, datetime]:
+        return (self._now, self._now)
+
+    def on_notify(self) -> datetime:
+        self._now = self.now_getter()
+        return self._now
+
+    def on_eof(self) -> datetime:
+        return UTC_MAX
+
+    def to_system_utc(self, timestamp: datetime) -> Optional[datetime]:
+        return timestamp
+
+    def snapshot(self) -> None:
+        return None
+
+
+@dataclass
+class SystemClock(Clock[Any, None]):
+    """Use the current system time as the timestamp of each value.
+
+    The watermark is the current system time; at EOF it jumps to
+    :data:`UTC_MAX` so all windows close.
+    """
+
+    now_getter: Callable[[], datetime] = _get_system_utc
+
+    def build(self, resume_state: None) -> _SystemClockLogic:
+        return _SystemClockLogic(self.now_getter)
+
+
+@dataclass
+class _EventClockState:
+    system_time_of_max_event: datetime
+    watermark_base: datetime
+
+
+@dataclass
+class _EventClockLogic(ClockLogic[V, _EventClockState]):
+    now_getter: Callable[[], datetime]
+    ts_getter: Callable[[V], datetime]
+    to_system: Callable[[datetime], Optional[datetime]]
+    wait_for_system_duration: timedelta
+    state: Optional[_EventClockState] = None
+    _system_now: datetime = field(init=False)
+
+    def __post_init__(self) -> None:
+        self._system_now = self.now_getter()
+        if self.state is None:
+            self.state = _EventClockState(
+                system_time_of_max_event=self._system_now,
+                watermark_base=UTC_MIN,
+            )
+
+    def _watermark(self) -> datetime:
+        assert self.state is not None
+        # Watermark advances with elapsed system time since the max
+        # event was seen, so idle streams still make progress.
+        return self.state.watermark_base + (
+            self._system_now - self.state.system_time_of_max_event
+        )
+
+    def before_batch(self) -> None:
+        # Clamp: never let "now" regress (NTP adjustments etc.); a
+        # stalled clock holds the watermark steady rather than
+        # violating monotonicity.
+        system_now = self.now_getter()
+        if system_now > self._system_now:
+            self._system_now = system_now
+
+    def on_item(self, value: V) -> Tuple[datetime, datetime]:
+        assert self.state is not None
+        ts = self.ts_getter(value)
+        watermark = self._watermark()
+        try:
+            new_base = ts - self.wait_for_system_duration
+        except OverflowError:
+            # Unrepresentable; keep the old base so the watermark
+            # keeps advancing with system time without regressing.
+            return ts, watermark
+        if new_base > watermark:
+            self.state.watermark_base = new_base
+            self.state.system_time_of_max_event = self._system_now
+            return ts, new_base
+        return ts, watermark
+
+    def on_notify(self) -> datetime:
+        self.before_batch()
+        return self._watermark()
+
+    def on_eof(self) -> datetime:
+        return UTC_MAX
+
+    def to_system_utc(self, timestamp: datetime) -> Optional[datetime]:
+        return self.to_system(timestamp)
+
+    def snapshot(self) -> _EventClockState:
+        return copy.deepcopy(self.state)  # type: ignore[arg-type]
+
+
+@dataclass
+class EventClock(Clock[V, _EventClockState]):
+    """Use a timestamp embedded within each value.
+
+    The watermark is the largest timestamp seen so far, minus
+    ``wait_for_system_duration``, plus the system time elapsed since
+    that value was seen.  Values are processed correctly as long as
+    they are not out-of-order by more than the waiting duration.
+
+    :arg ts_getter: Called once per value to get its (timezone-aware,
+        UTC) timestamp.
+    :arg wait_for_system_duration: How long to wait for out-of-order
+        values after seeing a timestamp.
+    :arg now_getter: Source of "system" time; defaults to the current
+        UTC time.  Override for deterministic tests.
+    :arg to_system_utc: Map a window-close timestamp to the system
+        time the engine should wake up at; ``None`` return disables
+        timer-driven closes (then only new values or EOF close
+        windows).
+    """
+
+    ts_getter: Callable[[V], datetime]
+    wait_for_system_duration: timedelta
+    now_getter: Callable[[], datetime] = _get_system_utc
+    to_system_utc: Callable[[datetime], Optional[datetime]] = _identity
+
+    def build(
+        self, resume_state: Optional[_EventClockState]
+    ) -> _EventClockLogic[V]:
+        return _EventClockLogic(
+            self.now_getter,
+            self.ts_getter,
+            self.to_system_utc,
+            self.wait_for_system_duration,
+            resume_state,
+        )
+
+
+# --------------------------------------------------------------------------
+# Windowers
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class WindowMetadata:
+    """Metadata about a window: open (inclusive) and close (exclusive)
+    times, plus the ids of any windows merged into it."""
+
+    open_time: datetime
+    close_time: datetime
+    merged_ids: Set[int] = field(default_factory=set)
+
+
+class WindowerLogic(ABC, Generic[S]):
+    """Instance of a windower on a single key; maps timestamps to
+    window ids and manages window lifetimes."""
+
+    @abstractmethod
+    def open_for(self, timestamp: datetime) -> Iterable[int]:
+        """Return the ids of all windows this (non-late) timestamp
+        belongs to, creating them if needed."""
+        ...
+
+    @abstractmethod
+    def late_for(self, timestamp: datetime) -> Iterable[int]:
+        """Return the ids of the windows a late timestamp would have
+        belonged to (for the ``late`` output stream)."""
+        ...
+
+    @abstractmethod
+    def merged(self) -> Iterable[Tuple[int, int]]:
+        """Drain and return ``(original_id, merged_into_id)`` pairs
+        for windows merged since the last call."""
+        ...
+
+    @abstractmethod
+    def close_for(
+        self, watermark: datetime
+    ) -> Iterable[Tuple[int, WindowMetadata]]:
+        """Drain and return all windows closed as-of the watermark."""
+        ...
+
+    @abstractmethod
+    def notify_at(self) -> Optional[datetime]:
+        """Next timestamp at which a window could close."""
+        ...
+
+    @abstractmethod
+    def is_empty(self) -> bool:
+        """Whether this key's windower state can be discarded."""
+        ...
+
+    @abstractmethod
+    def snapshot(self) -> S:
+        """Immutable copy of state for recovery."""
+        ...
+
+
+class Windower(ABC, Generic[S]):
+    """A definition of how values are grouped into windows."""
+
+    @abstractmethod
+    def build(self, resume_state: Optional[S]) -> WindowerLogic[S]:
+        """Construct a new windower logic for a key (or resume one)."""
+        ...
+
+
+@dataclass
+class _SlidingWindowerState:
+    opened: Dict[int, WindowMetadata] = field(default_factory=dict)
+
+
+@dataclass
+class _SlidingWindowerLogic(WindowerLogic[_SlidingWindowerState]):
+    length: timedelta
+    offset: timedelta
+    align_to: datetime
+    state: _SlidingWindowerState
+
+    def intersecting_ids(self, timestamp: datetime) -> List[int]:
+        # Window i spans [align_to + i*offset, align_to + i*offset +
+        # length); pure integer arithmetic — the XLA tier computes the
+        # same ids vectorized on device.
+        since = timestamp - self.align_to
+        first = (since - self.length) // self.offset + 1
+        last = since // self.offset
+        return list(range(first, last + 1))
+
+    def _meta_for(self, window_id: int) -> WindowMetadata:
+        open_time = self.align_to + self.offset * window_id
+        return WindowMetadata(open_time, open_time + self.length)
+
+    def open_for(self, timestamp: datetime) -> List[int]:
+        ids = self.intersecting_ids(timestamp)
+        for window_id in ids:
+            if window_id not in self.state.opened:
+                self.state.opened[window_id] = self._meta_for(window_id)
+        return ids
+
+    def late_for(self, timestamp: datetime) -> List[int]:
+        return self.intersecting_ids(timestamp)
+
+    def merged(self) -> Iterable[Tuple[int, int]]:
+        return _EMPTY
+
+    def close_for(
+        self, watermark: datetime
+    ) -> List[Tuple[int, WindowMetadata]]:
+        closed = [
+            (window_id, meta)
+            for window_id, meta in self.state.opened.items()
+            if meta.close_time <= watermark
+        ]
+        for window_id, _meta in closed:
+            del self.state.opened[window_id]
+        return closed
+
+    def notify_at(self) -> Optional[datetime]:
+        return min(
+            (meta.close_time for meta in self.state.opened.values()),
+            default=None,
+        )
+
+    def is_empty(self) -> bool:
+        return not self.state.opened
+
+    def snapshot(self) -> _SlidingWindowerState:
+        return copy.deepcopy(self.state)
+
+
+@dataclass
+class SlidingWindower(Windower[_SlidingWindowerState]):
+    """Possibly-overlapping fixed-length windows, one every ``offset``.
+
+    Windows start at ``align_to + i * offset`` for every integer ``i``
+    and span ``length``.  If ``offset < length`` windows overlap (a
+    value falls in several); if ``offset == length`` this is a
+    tumbling window.
+
+    :arg length: Length of each window.
+    :arg offset: Time between window starts.
+    :arg align_to: Align windows to this instant (may be in the past
+        or future; only the phase matters).
+    """
+
+    length: timedelta
+    offset: timedelta
+    align_to: datetime
+
+    def __post_init__(self) -> None:
+        if self.offset <= ZERO_TD:
+            msg = "offset must be positive"
+            raise ValueError(msg)
+        if self.offset > self.length:
+            # Timestamps in the gaps between windows would silently
+            # belong to no window at all.
+            msg = (
+                "sliding window `offset` can't be longer than `length`; "
+                "there would be gaps between windows that values "
+                "silently fall into; use a TumblingWindower for "
+                "non-overlapping windows"
+            )
+            raise ValueError(msg)
+
+    def build(
+        self, resume_state: Optional[_SlidingWindowerState]
+    ) -> _SlidingWindowerLogic:
+        return _SlidingWindowerLogic(
+            self.length,
+            self.offset,
+            self.align_to,
+            resume_state if resume_state is not None else _SlidingWindowerState(),
+        )
+
+
+@dataclass
+class TumblingWindower(Windower[_SlidingWindowerState]):
+    """Contiguous non-overlapping fixed-length windows.
+
+    Equivalent to a :class:`SlidingWindower` with ``offset == length``.
+
+    :arg length: Length of each window.
+    :arg align_to: Align window boundaries to this instant.
+    """
+
+    length: timedelta
+    align_to: datetime
+
+    def __post_init__(self) -> None:
+        if self.length <= ZERO_TD:
+            msg = "length must be positive"
+            raise ValueError(msg)
+
+    def build(
+        self, resume_state: Optional[_SlidingWindowerState]
+    ) -> _SlidingWindowerLogic:
+        return _SlidingWindowerLogic(
+            self.length,
+            self.length,
+            self.align_to,
+            resume_state if resume_state is not None else _SlidingWindowerState(),
+        )
+
+
+@dataclass
+class _SessionWindowerState:
+    next_id: int = 0
+    sessions: Dict[int, WindowMetadata] = field(default_factory=dict)
+    merge_queue: List[Tuple[int, int]] = field(default_factory=list)
+
+
+@dataclass
+class _SessionWindowerLogic(WindowerLogic[_SessionWindowerState]):
+    gap: timedelta
+    state: _SessionWindowerState
+
+    def _merge_overlapping(self) -> None:
+        """Merge any sessions now within ``gap`` of each other.
+
+        Scans sessions in open-time order; a session starting within
+        the gap after the previous one's close is absorbed into it.
+        """
+        if len(self.state.sessions) < 2:
+            return
+        by_open = sorted(
+            self.state.sessions.items(), key=lambda kv: kv[1].open_time
+        )
+        keep_id, keep_meta = by_open[0]
+        for this_id, this_meta in by_open[1:]:
+            if this_meta.open_time - keep_meta.close_time <= self.gap:
+                keep_meta.close_time = max(
+                    keep_meta.close_time, this_meta.close_time
+                )
+                keep_meta.merged_ids.add(this_id)
+                self.state.merge_queue.append((this_id, keep_id))
+                del self.state.sessions[this_id]
+            else:
+                keep_id, keep_meta = this_id, this_meta
+
+    def open_for(self, timestamp: datetime) -> Iterable[int]:
+        for window_id, meta in self.state.sessions.items():
+            if meta.open_time <= timestamp <= meta.close_time:
+                # Inside an existing session; boundaries unchanged so
+                # no merges are possible.
+                return (window_id,)
+            if ZERO_TD < meta.open_time - timestamp <= self.gap:
+                meta.open_time = timestamp
+                self._merge_overlapping()
+                return (window_id,)
+            if ZERO_TD < timestamp - meta.close_time <= self.gap:
+                meta.close_time = timestamp
+                self._merge_overlapping()
+                return (window_id,)
+        window_id = self.state.next_id
+        self.state.next_id += 1
+        self.state.sessions[window_id] = WindowMetadata(timestamp, timestamp)
+        return (window_id,)
+
+    def late_for(self, timestamp: datetime) -> Iterable[int]:
+        # Session membership depends on other values, so a late value
+        # can't name a specific session.
+        return (LATE_SESSION_ID,)
+
+    def merged(self) -> Iterable[Tuple[int, int]]:
+        drained = self.state.merge_queue
+        self.state.merge_queue = []
+        return drained
+
+    def close_for(
+        self, watermark: datetime
+    ) -> List[Tuple[int, WindowMetadata]]:
+        try:
+            close_after = watermark - self.gap
+        except OverflowError:
+            close_after = UTC_MIN
+        closed = [
+            (window_id, meta)
+            for window_id, meta in self.state.sessions.items()
+            if meta.close_time < close_after
+        ]
+        for window_id, _meta in closed:
+            del self.state.sessions[window_id]
+        return closed
+
+    def notify_at(self) -> Optional[datetime]:
+        min_close = min(
+            (meta.close_time for meta in self.state.sessions.values()),
+            default=None,
+        )
+        return min_close + self.gap if min_close is not None else None
+
+    def is_empty(self) -> bool:
+        # Never discard: re-using session ids after discard would give
+        # downstream joins wrong window metadata.
+        return False
+
+    def snapshot(self) -> _SessionWindowerState:
+        return copy.deepcopy(self.state)
+
+
+@dataclass
+class SessionWindower(Windower[_SessionWindowerState]):
+    """Windows that grow while values arrive within a gap of each
+    other and close when the stream goes quiet for ``gap``.
+
+    :arg gap: Maximum inactivity between values in a session.
+    """
+
+    gap: timedelta
+
+    def __post_init__(self) -> None:
+        if self.gap <= ZERO_TD:
+            msg = "gap must be positive"
+            raise ValueError(msg)
+
+    def build(
+        self, resume_state: Optional[_SessionWindowerState]
+    ) -> _SessionWindowerLogic:
+        return _SessionWindowerLogic(
+            self.gap,
+            resume_state if resume_state is not None else _SessionWindowerState(),
+        )
+
+
+# --------------------------------------------------------------------------
+# Window logic + the window operator
+# --------------------------------------------------------------------------
+
+
+class WindowLogic(ABC, Generic[V, W, S]):
+    """Accumulates values within one open window of one key."""
+
+    @abstractmethod
+    def on_value(self, value: V) -> Iterable[W]:
+        """Called on each new value; may emit early results."""
+        ...
+
+    @abstractmethod
+    def on_merge(self, original: Self) -> Iterable[W]:
+        """Called when another window merges into this one; absorb
+        ``original``'s state."""
+        ...
+
+    @abstractmethod
+    def on_close(self) -> Iterable[W]:
+        """Called when this window closes; emit final results."""
+        ...
+
+    @abstractmethod
+    def snapshot(self) -> S:
+        """Immutable copy of state for recovery."""
+        ...
+
+
+_WindowQueueEntry: TypeAlias = Tuple[V, datetime]
+
+_WindowEvent: TypeAlias = Tuple[int, str, Any]  # (window_id, "E"|"L"|"M", obj)
+
+
+@dataclass(frozen=True)
+class _WindowSnapshot(Generic[V, SC, SW, S]):
+    clock_state: SC
+    windower_state: SW
+    logic_states: Dict[int, S]
+    queue: List[_WindowQueueEntry]
+
+
+@dataclass
+class _WindowLogic(
+    StatefulBatchLogic[V, _WindowEvent, "_WindowSnapshot[V, SC, SW, S]"]
+):
+    """Orchestrates clock + windower + per-window logics for one key.
+
+    Events are tagged ``(window_id, type, payload)`` with type ``"E"``
+    (emit), ``"L"`` (late value), ``"M"`` (close metadata); the
+    :func:`window` operator fans them out into the three output
+    streams.
+    """
+
+    clock: ClockLogic[V, Any]
+    windower: WindowerLogic[Any]
+    builder: Callable[[Optional[Any]], WindowLogic[V, Any, Any]]
+    ordered: bool
+    logics: Dict[int, WindowLogic] = field(default_factory=dict)
+    queue: List[_WindowQueueEntry] = field(default_factory=list)
+    _last_watermark: datetime = UTC_MIN
+
+    def _insert(self, entries: List[_WindowQueueEntry]) -> Iterable[_WindowEvent]:
+        for value, timestamp in entries:
+            for window_id in self.windower.open_for(timestamp):
+                logic = self.logics.get(window_id)
+                if logic is None:
+                    logic = self.builder(None)
+                    self.logics[window_id] = logic
+                for w in logic.on_value(value):
+                    yield (window_id, "E", w)
+
+    def _apply_merges(self) -> Iterable[_WindowEvent]:
+        for orig_id, into_id in self.windower.merged():
+            if orig_id != into_id:
+                orig = self.logics.pop(orig_id)
+                into = self.logics[into_id]
+                for w in into.on_merge(orig):
+                    yield (into_id, "E", w)
+
+    def _apply_closes(self, watermark: datetime) -> Iterable[_WindowEvent]:
+        for window_id, meta in self.windower.close_for(watermark):
+            logic = self.logics.pop(window_id)
+            for w in logic.on_close():
+                yield (window_id, "E", w)
+            yield (window_id, "M", meta)
+
+    def _flush(self, watermark: datetime) -> Iterable[_WindowEvent]:
+        if self.ordered:
+            due, self.queue = partition(
+                self.queue, lambda entry: entry[1] <= watermark
+            )
+            due.sort(key=lambda entry: entry[1])
+        else:
+            due, self.queue = self.queue, []
+        yield from self._insert(due)
+        yield from self._apply_merges()
+        yield from self._apply_closes(watermark)
+
+    def _is_empty(self) -> bool:
+        return (
+            not self.logics and not self.queue and self.windower.is_empty()
+        )
+
+    def on_batch(self, values: List[V]) -> Tuple[Iterable[_WindowEvent], bool]:
+        self.clock.before_batch()
+        events: List[_WindowEvent] = []
+        watermark = self._last_watermark
+        for value in values:
+            ts, watermark = self.clock.on_item(value)
+            assert watermark >= self._last_watermark
+            self._last_watermark = watermark
+            if ts < watermark:
+                events.extend(
+                    (window_id, "L", value)
+                    for window_id in self.windower.late_for(ts)
+                )
+            else:
+                self.queue.append((value, ts))
+        events.extend(self._flush(watermark))
+        return (events, self._is_empty())
+
+    def on_notify(self) -> Tuple[Iterable[_WindowEvent], bool]:
+        watermark = self.clock.on_notify()
+        assert watermark >= self._last_watermark
+        self._last_watermark = watermark
+        events = list(self._flush(watermark))
+        return (events, self._is_empty())
+
+    def on_eof(self) -> Tuple[Iterable[_WindowEvent], bool]:
+        watermark = self.clock.on_eof()
+        assert watermark >= self._last_watermark
+        self._last_watermark = watermark
+        events = list(self._flush(watermark))
+        return (events, self._is_empty())
+
+    def notify_at(self) -> Optional[datetime]:
+        at = self.windower.notify_at()
+        if self.ordered and self.queue:
+            # In ordered mode a queued value only becomes due once the
+            # watermark passes it; wake up for the earliest.
+            head_at = min(entry[1] for entry in self.queue)
+            at = head_at if at is None else min(at, head_at)
+        if at is not None:
+            at = self.clock.to_system_utc(at)
+        return at
+
+    def snapshot(self) -> "_WindowSnapshot":
+        return _WindowSnapshot(
+            self.clock.snapshot(),
+            self.windower.snapshot(),
+            {wid: logic.snapshot() for wid, logic in self.logics.items()},
+            list(self.queue),
+        )
+
+
+@dataclass(frozen=True)
+class WindowOut(Generic[V, W_co]):
+    """Streams returned from a windowing operator; all sub-keyed by
+    window id."""
+
+    down: KeyedStream[Tuple[int, W_co]]
+    """Values emitted by the window logic."""
+
+    late: KeyedStream[Tuple[int, V]]
+    """Values that arrived behind the watermark for their window."""
+
+    meta: KeyedStream[Tuple[int, WindowMetadata]]
+    """Per-window metadata, emitted once when each window closes
+    (merged-away windows appear in the target's ``merged_ids``)."""
+
+
+@operator
+def window(
+    step_id: str,
+    up: KeyedStream[V],
+    clock: Clock[V, Any],
+    windower: Windower[Any],
+    builder: Callable[[Optional[S]], WindowLogic[V, W, S]],
+    ordered: bool = True,
+) -> WindowOut[V, W]:
+    """Advanced generic windowing operator.
+
+    :arg step_id: Unique ID.
+    :arg up: Keyed upstream.
+    :arg clock: Time definition.
+    :arg windower: Window definition.
+    :arg builder: Called with ``None`` (new window) or that window's
+        resume state to build its :class:`WindowLogic`.
+    :arg ordered: Apply values in timestamp order (at a latency cost)
+        instead of upstream order.  Defaults to ``True``.
+    :returns: :class:`WindowOut`.
+
+    Reference parity: ``windowing.py:1254``.
+    """
+
+    def shim_builder(
+        resume_state: Optional[_WindowSnapshot],
+    ) -> _WindowLogic:
+        if resume_state is not None:
+            return _WindowLogic(
+                clock.build(resume_state.clock_state),
+                windower.build(resume_state.windower_state),
+                builder,
+                ordered,
+                {
+                    wid: builder(state)
+                    for wid, state in resume_state.logic_states.items()
+                },
+                list(resume_state.queue),
+            )
+        return _WindowLogic(
+            clock.build(None), windower.build(None), builder, ordered
+        )
+
+    events = op.stateful_batch("stateful_batch", up, shim_builder)
+
+    def unwrap_emit(ev: _WindowEvent) -> Optional[Tuple[int, W]]:
+        window_id, typ, obj = ev
+        return (window_id, cast(W, obj)) if typ == "E" else None
+
+    def unwrap_late(ev: _WindowEvent) -> Optional[Tuple[int, V]]:
+        window_id, typ, obj = ev
+        return (window_id, cast(V, obj)) if typ == "L" else None
+
+    def unwrap_meta(ev: _WindowEvent) -> Optional[Tuple[int, WindowMetadata]]:
+        window_id, typ, obj = ev
+        return (window_id, cast(WindowMetadata, obj)) if typ == "M" else None
+
+    downs = op.filter_map_value("unwrap_down", events, unwrap_emit)
+    lates = op.filter_map_value("unwrap_late", events, unwrap_late)
+    metas = op.filter_map_value("unwrap_meta", events, unwrap_meta)
+    return WindowOut(downs, lates, metas)
+
+
+# --------------------------------------------------------------------------
+# Derived windowing operators
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _FoldWindowLogic(WindowLogic[V, S, S]):
+    folder: Callable[[S, V], S]
+    merger: Callable[[S, S], S]
+    state: S
+
+    def on_value(self, value: V) -> Iterable[S]:
+        self.state = self.folder(self.state, value)
+        return _EMPTY
+
+    def on_merge(self, original: "_FoldWindowLogic") -> Iterable[S]:
+        self.state = self.merger(self.state, original.state)
+        return _EMPTY
+
+    def on_close(self) -> Iterable[S]:
+        return (self.state,)
+
+    def snapshot(self) -> S:
+        return copy.deepcopy(self.state)
+
+
+@operator
+def fold_window(
+    step_id: str,
+    up: KeyedStream[V],
+    clock: Clock[V, Any],
+    windower: Windower[Any],
+    builder: Callable[[], S],
+    folder: Callable[[S, V], S],
+    merger: Callable[[S, S], S],
+    ordered: bool = True,
+) -> WindowOut[V, S]:
+    """Build an empty accumulator per window, combine values into it,
+    emit at window close.
+
+    On the XLA tier this is the vectorization anchor: commutative
+    folders become device-side segment reductions bucketed by the
+    window-id arithmetic.
+
+    :arg merger: Combines two accumulators when windows merge
+        (session windows).
+
+    Reference parity: ``windowing.py:1717``.
+    """
+
+    def shim_builder(resume_state: Optional[S]) -> _FoldWindowLogic[V, S]:
+        state = resume_state if resume_state is not None else builder()
+        return _FoldWindowLogic(folder, merger, state)
+
+    return window(
+        "window", up, clock, windower, shim_builder, ordered=ordered
+    )
+
+
+@operator
+def reduce_window(
+    step_id: str,
+    up: KeyedStream[V],
+    clock: Clock[V, Any],
+    windower: Windower[Any],
+    reducer: Callable[[V, V], V],
+) -> WindowOut[V, V]:
+    """Distill all values for a key in a window down to one value.
+
+    Like :func:`fold_window` but the first value is the accumulator.
+
+    Reference parity: ``windowing.py:2239``.
+    """
+
+    def shim_folder(s: V, v: V) -> V:
+        return v if s is None else reducer(s, v)
+
+    return fold_window(
+        "fold_window",
+        up,
+        clock,
+        windower,
+        _untyped_none,
+        shim_folder,
+        reducer,
+        ordered=False,
+    )
+
+
+@operator
+def max_window(
+    step_id: str,
+    up: KeyedStream[V],
+    clock: Clock[V, Any],
+    windower: Windower[Any],
+    by=_identity,
+) -> WindowOut[V, V]:
+    """Maximum value per key per window, emitted at window close.
+
+    Reference parity: ``windowing.py:2164``.
+    """
+    return reduce_window(
+        "reduce_window", up, clock, windower, lambda a, b: max(a, b, key=by)
+    )
+
+
+@operator
+def min_window(
+    step_id: str,
+    up: KeyedStream[V],
+    clock: Clock[V, Any],
+    windower: Windower[Any],
+    by=_identity,
+) -> WindowOut[V, V]:
+    """Minimum value per key per window, emitted at window close.
+
+    Reference parity: ``windowing.py:2211``.
+    """
+    return reduce_window(
+        "reduce_window", up, clock, windower, lambda a, b: min(a, b, key=by)
+    )
+
+
+def _collect_list_folder(acc: List, v: Any) -> List:
+    acc.append(v)
+    return acc
+
+
+def _collect_list_merger(a: List, b: List) -> List:
+    a.extend(b)
+    return a
+
+
+def _collect_set_folder(acc: Set, v: Any) -> Set:
+    acc.add(v)
+    return acc
+
+
+def _collect_set_merger(a: Set, b: Set) -> Set:
+    a.update(b)
+    return a
+
+
+def _collect_dict_folder(acc: Dict, k_v: Tuple) -> Dict:
+    k, v = k_v
+    acc[k] = v
+    return acc
+
+
+def _collect_dict_merger(a: Dict, b: Dict) -> Dict:
+    a.update(b)
+    return a
+
+
+@operator
+def collect_window(
+    step_id: str,
+    up: KeyedStream[V],
+    clock: Clock[V, Any],
+    windower: Windower[Any],
+    into=list,
+    ordered: bool = True,
+) -> WindowOut[V, Any]:
+    """Collect all values for a key in a window into a container
+    (``list``, ``set``, or ``dict``), emitted at window close.
+
+    For ``dict``, values must be ``(key, value)`` 2-tuples.
+
+    Reference parity: ``windowing.py:1436``.
+    """
+    if into is list:
+        folder, merger = _collect_list_folder, _collect_list_merger
+    elif into is set:
+        folder, merger = _collect_set_folder, _collect_set_merger
+    elif into is dict:
+        folder, merger = _collect_dict_folder, _collect_dict_merger
+    else:
+        msg = f"`collect_window` doesn't support `into` {into!r}"
+        raise TypeError(msg)
+
+    return fold_window(
+        "fold_window", up, clock, windower, into, folder, merger,
+        ordered=ordered,
+    )
+
+
+@operator
+def count_window(
+    step_id: str,
+    up: Stream[X],
+    clock: Clock[X, Any],
+    windower: Windower[Any],
+    key: Callable[[X], str],
+) -> WindowOut[X, int]:
+    """Count occurrences of items per key per window.
+
+    Reference parity: ``windowing.py:1579``.
+    """
+    keyed = op.key_on("keyed", up, key)
+    return fold_window(
+        "fold_window",
+        keyed,
+        clock,
+        windower,
+        lambda: 0,
+        lambda s, _: s + 1,
+        lambda s, t: s + t,
+        ordered=False,
+    )
+
+
+@dataclass
+class _JoinWindowLogic(WindowLogic[Tuple[int, Any], Tuple, _JoinState]):
+    insert_mode: JoinInsertMode
+    emit_mode: JoinEmitMode
+    state: _JoinState
+
+    def _check_emit(self) -> Iterable[Tuple]:
+        if self.emit_mode == "complete" and self.state.all_set():
+            rows = self.state.astuples()
+            self.state.clear()
+            return rows
+        if self.emit_mode == "running":
+            return self.state.astuples()
+        return _EMPTY
+
+    def on_value(self, value: Tuple[int, Any]) -> Iterable[Tuple]:
+        side, side_value = value
+        if self.insert_mode == "first":
+            if not self.state.is_set(side):
+                self.state.set_val(side, side_value)
+        elif self.insert_mode == "last":
+            self.state.set_val(side, side_value)
+        else:
+            self.state.add_val(side, side_value)
+
+        return self._check_emit()
+
+    def on_merge(self, original: "_JoinWindowLogic") -> Iterable[Tuple]:
+        # Absorb the merged-away window's sides using the same algebra
+        # as the reference (windowing.py:1879-1890): "first" lets the
+        # absorbed window fill sides, "last" keeps this window's sides
+        # where set, "product" concatenates everything.
+        mine = self.state.seen
+        theirs = original.state.seen
+        if self.insert_mode == "first":
+            self.state.seen = [
+                t if t else m for m, t in zip(mine, theirs)
+            ]
+        elif self.insert_mode == "last":
+            self.state.seen = [
+                m if m else t for m, t in zip(mine, theirs)
+            ]
+        else:
+            self.state.seen = [m + t for m, t in zip(mine, theirs)]
+        return self._check_emit()
+
+    def on_close(self) -> Iterable[Tuple]:
+        if self.emit_mode == "final":
+            return self.state.astuples()
+        return _EMPTY
+
+    def snapshot(self) -> _JoinState:
+        return copy.deepcopy(self.state)
+
+
+@operator
+def join_window(
+    step_id: str,
+    clock: Clock[Any, Any],
+    windower: Windower[Any],
+    *sides: KeyedStream[Any],
+    insert_mode: JoinInsertMode = "last",
+    emit_mode: JoinEmitMode = "final",
+    ordered: bool = True,
+) -> WindowOut[Any, Tuple]:
+    """Gather the values for a key on multiple streams within each
+    window.
+
+    Reference parity: ``windowing.py:2055``.
+    """
+    if insert_mode not in ("first", "last", "product"):
+        msg = f"unknown join insert mode {insert_mode!r}"
+        raise ValueError(msg)
+    if emit_mode not in ("complete", "final", "running"):
+        msg = f"unknown join emit mode {emit_mode!r}"
+        raise ValueError(msg)
+
+    side_count = len(sides)
+    merged = op._join_label_merge("add_names", *sides)
+
+    # The merged stream carries (side, value) pairs; an EventClock
+    # defined on bare values needs unwrapping.
+    if isinstance(clock, EventClock):
+        value_ts_getter = clock.ts_getter
+
+        def shim_getter(i_v: Tuple[int, Any]) -> datetime:
+            _i, v = i_v
+            return value_ts_getter(v)
+
+        clock = EventClock(
+            ts_getter=shim_getter,
+            wait_for_system_duration=clock.wait_for_system_duration,
+            now_getter=clock.now_getter,
+            to_system_utc=clock.to_system_utc,
+        )
+
+    def shim_builder(
+        resume_state: Optional[_JoinState],
+    ) -> _JoinWindowLogic:
+        state = (
+            resume_state
+            if resume_state is not None
+            else _JoinState.for_side_count(side_count)
+        )
+        return _JoinWindowLogic(insert_mode, emit_mode, state)
+
+    return window(
+        "window", merged, clock, windower, shim_builder, ordered=ordered
+    )

@@ -1,1 +1,22 @@
-"""utils subpackage."""
+"""Small shared helpers."""
+
+from typing import Callable, Iterable, List, Tuple, TypeVar
+
+X = TypeVar("X")
+
+__all__ = ["partition"]
+
+
+def partition(
+    xs: Iterable[X], pred: Callable[[X], bool]
+) -> Tuple[List[X], List[X]]:
+    """Split an iterable into (matching, not-matching) lists, keeping
+    order."""
+    trues: List[X] = []
+    falses: List[X] = []
+    for x in xs:
+        if pred(x):
+            trues.append(x)
+        else:
+            falses.append(x)
+    return trues, falses

@@ -1,0 +1,455 @@
+"""Windowing tests (model:
+``/root/reference/pytests/operators/windowing/``)."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+import bytewax_tpu.operators as op
+import bytewax_tpu.operators.windowing as w
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.operators.windowing import (
+    LATE_SESSION_ID,
+    EventClock,
+    SessionWindower,
+    SlidingWindower,
+    SystemClock,
+    TumblingWindower,
+    WindowMetadata,
+    ZERO_TD,
+)
+from bytewax_tpu.testing import (
+    TestingSink,
+    TestingSource,
+    TimeTestingGetter,
+    run_main,
+)
+
+ALIGN_TO = datetime(2022, 1, 1, tzinfo=timezone.utc)
+
+
+def _ts_clock():
+    return EventClock(
+        ts_getter=lambda item: item[0],
+        wait_for_system_duration=ZERO_TD,
+    )
+
+
+def test_tumbling_fold_window(entry_point):
+    inp = [
+        (ALIGN_TO + timedelta(seconds=s), val)
+        for s, val in [(1, 1), (5, 2), (61, 10), (62, 20)]
+    ]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    wo = w.fold_window(
+        "sum",
+        keyed,
+        _ts_clock(),
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN_TO),
+        builder=lambda: 0,
+        folder=lambda acc, item: acc + item[1],
+        merger=lambda a, b: a + b,
+    )
+    op.output("out", wo.down, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("ALL", (0, 3)), ("ALL", (1, 30))]
+
+
+def test_tumbling_window_metadata(entry_point):
+    inp = [(ALIGN_TO + timedelta(seconds=1), 1)]
+    metas = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    wo = w.fold_window(
+        "sum",
+        keyed,
+        _ts_clock(),
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN_TO),
+        builder=lambda: 0,
+        folder=lambda acc, item: acc + item[1],
+        merger=lambda a, b: a + b,
+    )
+    op.output("meta", wo.meta, TestingSink(metas))
+    op.output("down", wo.down, TestingSink([]))
+    entry_point(flow)
+    assert metas == [
+        (
+            "ALL",
+            (
+                0,
+                WindowMetadata(ALIGN_TO, ALIGN_TO + timedelta(minutes=1)),
+            ),
+        )
+    ]
+
+
+def test_sliding_window_overlap(entry_point):
+    # length 10s, offset 5s: an item at t=7 falls in windows 0 and 1.
+    inp = [(ALIGN_TO + timedelta(seconds=7), 1)]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    wo = w.collect_window(
+        "coll",
+        keyed,
+        _ts_clock(),
+        SlidingWindower(
+            length=timedelta(seconds=10),
+            offset=timedelta(seconds=5),
+            align_to=ALIGN_TO,
+        ),
+    )
+    op.output("out", wo.down, TestingSink(out))
+    entry_point(flow)
+    vals = sorted((wid, [v for _ts, v in items]) for _k, (wid, items) in out)
+    assert vals == [(0, [1]), (1, [1])]
+
+
+def test_late_items_go_to_late_stream(entry_point):
+    inp = [
+        (ALIGN_TO + timedelta(seconds=60), "on-time"),
+        (ALIGN_TO + timedelta(seconds=1), "late"),  # behind watermark
+    ]
+    down = []
+    late = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    wo = w.collect_window(
+        "coll",
+        keyed,
+        _ts_clock(),
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN_TO),
+    )
+    op.output("down", wo.down, TestingSink(down))
+    op.output("late", wo.late, TestingSink(late))
+    entry_point(flow)
+    assert late == [("ALL", (0, (ALIGN_TO + timedelta(seconds=1), "late")))]
+    assert len(down) == 1
+
+
+def test_session_window_merge(entry_point):
+    # Two separated sessions, then a bridging item within the gap of
+    # both merges them into one.  The clock waits long enough that the
+    # out-of-order bridge is not late.
+    ts = [0, 10, 5]
+    inp = [(ALIGN_TO + timedelta(seconds=s), s) for s in ts]
+    out = []
+    metas = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=1))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    clock = EventClock(
+        ts_getter=lambda item: item[0],
+        wait_for_system_duration=timedelta(seconds=60),
+    )
+    wo = w.collect_window(
+        "coll",
+        keyed,
+        clock,
+        SessionWindower(gap=timedelta(seconds=5)),
+        ordered=False,
+    )
+    op.output("out", wo.down, TestingSink(out))
+    op.output("meta", wo.meta, TestingSink(metas))
+    entry_point(flow)
+    assert len(out) == 1
+    _k, (wid, items) = out[0]
+    assert sorted(v for _ts, v in items) == [0, 5, 10]
+    _k, (_wid, meta) = metas[0]
+    assert meta.open_time == ALIGN_TO
+    assert meta.close_time == ALIGN_TO + timedelta(seconds=10)
+    assert len(meta.merged_ids) == 1
+
+
+def test_session_late(entry_point):
+    inp = [
+        (ALIGN_TO + timedelta(seconds=30), "a"),
+        (ALIGN_TO + timedelta(seconds=1), "late"),
+    ]
+    late = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    wo = w.collect_window(
+        "coll",
+        keyed,
+        _ts_clock(),
+        SessionWindower(gap=timedelta(seconds=4)),
+    )
+    op.output("down", wo.down, TestingSink([]))
+    op.output("late", wo.late, TestingSink(late))
+    entry_point(flow)
+    assert late == [
+        ("ALL", (LATE_SESSION_ID, (ALIGN_TO + timedelta(seconds=1), "late")))
+    ]
+
+
+def test_reduce_window(entry_point):
+    inp = [
+        (ALIGN_TO + timedelta(seconds=1), 5),
+        (ALIGN_TO + timedelta(seconds=2), 3),
+        (ALIGN_TO + timedelta(seconds=3), 9),
+    ]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    wo = w.reduce_window(
+        "max",
+        keyed,
+        _ts_clock(),
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN_TO),
+        lambda a, b: a if a[1] >= b[1] else b,
+    )
+    op.output("out", wo.down, TestingSink(out))
+    entry_point(flow)
+    assert out == [("ALL", (0, (ALIGN_TO + timedelta(seconds=3), 9)))]
+
+
+def test_max_min_window(entry_point):
+    inp = [
+        (ALIGN_TO + timedelta(seconds=1), 5),
+        (ALIGN_TO + timedelta(seconds=2), 3),
+    ]
+    maxes = []
+    mins = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    wo_max = w.max_window(
+        "max",
+        keyed,
+        _ts_clock(),
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN_TO),
+        by=lambda item: item[1],
+    )
+    wo_min = w.min_window(
+        "min",
+        keyed,
+        _ts_clock(),
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN_TO),
+        by=lambda item: item[1],
+    )
+    op.output("max_out", wo_max.down, TestingSink(maxes))
+    op.output("min_out", wo_min.down, TestingSink(mins))
+    entry_point(flow)
+    assert maxes == [("ALL", (0, (ALIGN_TO + timedelta(seconds=1), 5)))]
+    assert mins == [("ALL", (0, (ALIGN_TO + timedelta(seconds=2), 3)))]
+
+
+def test_count_window(entry_point):
+    inp = [
+        (ALIGN_TO + timedelta(seconds=1), "apple"),
+        (ALIGN_TO + timedelta(seconds=2), "apple"),
+        (ALIGN_TO + timedelta(seconds=3), "pear"),
+    ]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    wo = w.count_window(
+        "count",
+        s,
+        _ts_clock(),
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN_TO),
+        key=lambda item: item[1],
+    )
+    op.output("out", wo.down, TestingSink(out))
+    entry_point(flow)
+    assert sorted(out) == [("apple", (0, 2)), ("pear", (0, 1))]
+
+
+def test_collect_window_set_and_dict(entry_point):
+    inp = [
+        (ALIGN_TO + timedelta(seconds=1), ("x", 1)),
+        (ALIGN_TO + timedelta(seconds=2), ("x", 2)),
+        (ALIGN_TO + timedelta(seconds=3), ("y", 9)),
+    ]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    unpacked = op.map_value("unpack", keyed, lambda item: item[1])
+    wo = w.collect_window(
+        "coll",
+        unpacked,
+        EventClock(
+            ts_getter=lambda _kv: ALIGN_TO,
+            wait_for_system_duration=timedelta(seconds=60),
+        ),
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN_TO),
+        into=dict,
+    )
+    op.output("out", wo.down, TestingSink(out))
+    entry_point(flow)
+    assert out == [("ALL", (0, {"x": 2, "y": 9}))]
+
+
+def test_join_window(entry_point):
+    clock = EventClock(
+        ts_getter=lambda _v: ALIGN_TO + timedelta(seconds=1),
+        wait_for_system_duration=timedelta(seconds=60),
+    )
+    out = []
+    flow = Dataflow("test_df")
+    lefts = op.input("left", flow, TestingSource([("k", 1)]))
+    rights = op.input("right", flow, TestingSource([("k", "x")]))
+    wo = w.join_window(
+        "join",
+        clock,
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN_TO),
+        lefts,
+        rights,
+    )
+    op.output("out", wo.down, TestingSink(out))
+    entry_point(flow)
+    assert out == [("k", (0, (1, "x")))]
+
+
+def test_event_clock_watermark_advances_with_system_time():
+    getter = TimeTestingGetter(ALIGN_TO)
+    clock = EventClock(
+        ts_getter=lambda item: item[0],
+        wait_for_system_duration=timedelta(seconds=10),
+        now_getter=getter.get,
+    )
+    logic = clock.build(None)
+    logic.before_batch()
+    ts, watermark = logic.on_item((ALIGN_TO, "x"))
+    assert ts == ALIGN_TO
+    assert watermark == ALIGN_TO - timedelta(seconds=10)
+    # Watermark advances as system time passes without new items.
+    getter.advance(timedelta(seconds=7))
+    assert logic.on_notify() == ALIGN_TO - timedelta(seconds=3)
+
+
+def test_event_clock_watermark_never_regresses():
+    getter = TimeTestingGetter(ALIGN_TO)
+    clock = EventClock(
+        ts_getter=lambda item: item[0],
+        wait_for_system_duration=ZERO_TD,
+        now_getter=getter.get,
+    )
+    logic = clock.build(None)
+    logic.before_batch()
+    _, wm1 = logic.on_item((ALIGN_TO + timedelta(seconds=60), "x"))
+    # An out-of-order item must not pull the watermark back.
+    _, wm2 = logic.on_item((ALIGN_TO + timedelta(seconds=1), "y"))
+    assert wm2 == wm1
+
+
+def test_system_clock_runs(entry_point):
+    inp = list(range(5))
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    wo = w.collect_window(
+        "coll",
+        keyed,
+        SystemClock(),
+        TumblingWindower(
+            length=timedelta(hours=1),
+            align_to=ALIGN_TO,
+        ),
+    )
+    op.output("out", wo.down, TestingSink(out))
+    entry_point(flow)
+    # Everything lands in one window, closed at EOF.
+    assert len(out) == 1
+    assert out[0][1][1] == [0, 1, 2, 3, 4]
+
+
+def test_window_recovery(tmp_path):
+    from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+
+    init_db_dir(tmp_path, 1)
+    rc = RecoveryConfig(str(tmp_path))
+    # ABORT (not EOF): EOF closes all windows via the UTC_MAX
+    # watermark, so open-window state is only carried across crashes.
+    inp = [
+        (ALIGN_TO + timedelta(seconds=1), 1),
+        (ALIGN_TO + timedelta(seconds=2), 2),
+        TestingSource.ABORT(),
+        (ALIGN_TO + timedelta(seconds=3), 4),
+        (ALIGN_TO + timedelta(seconds=70), 100),
+    ]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    keyed = op.key_on("key", s, lambda _x: "ALL")
+    # wait_for_system_duration must cover the clock gap across the two
+    # executions; use a large wait so nothing is late.
+    clock = EventClock(
+        ts_getter=lambda item: item[0],
+        wait_for_system_duration=timedelta(days=365 * 100),
+    )
+    wo = w.fold_window(
+        "sum",
+        keyed,
+        clock,
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN_TO),
+        builder=lambda: 0,
+        folder=lambda acc, item: acc + item[1],
+        merger=lambda a, b: a + b,
+    )
+    op.output("out", wo.down, TestingSink(out))
+
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=rc)
+    assert out == []  # crashed with windows still open
+
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=rc)
+    assert sorted(out) == [("ALL", (0, 7)), ("ALL", (1, 100))]
+
+
+def test_sliding_offset_longer_than_length_raises():
+    with pytest.raises(ValueError, match="offset"):
+        SlidingWindower(
+            length=timedelta(seconds=1),
+            offset=timedelta(seconds=10),
+            align_to=ALIGN_TO,
+        )
+
+
+def test_join_window_product_merge_keeps_all_values(entry_point):
+    # Session merge in product mode must concatenate both windows'
+    # values, not drop the absorbed side.
+    clock = EventClock(
+        ts_getter=lambda v: v[1],
+        wait_for_system_duration=timedelta(seconds=60),
+    )
+    out = []
+    flow = Dataflow("test_df")
+    # Side 0 sees values in two sessions that a bridge then merges.
+    left = op.input(
+        "left",
+        flow,
+        TestingSource(
+            [
+                ("k", ("x", ALIGN_TO)),
+                ("k", ("y", ALIGN_TO + timedelta(seconds=10))),
+                ("k", ("bridge", ALIGN_TO + timedelta(seconds=5))),
+            ],
+            batch_size=1,
+        ),
+    )
+    right = op.input("right", flow, TestingSource([("k", ("r", ALIGN_TO))]))
+    wo = w.join_window(
+        "join",
+        clock,
+        w.SessionWindower(gap=timedelta(seconds=5)),
+        left,
+        right,
+        insert_mode="product",
+        emit_mode="final",
+    )
+    op.output("out", wo.down, TestingSink(out))
+    entry_point(flow)
+    rows = [row for _k, (_wid, row) in out]
+    left_vals = sorted(v[0] for v, _r in rows)
+    assert left_vals == ["bridge", "x", "y"]
