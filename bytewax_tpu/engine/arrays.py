@@ -1,0 +1,80 @@
+"""Columnar micro-batches.
+
+An :class:`ArrayBatch` is the unit of the XLA fast path: a dict of
+equal-length columns (numpy or jax arrays) that flows through the same
+core-operator plan as Python item lists.  Host-tier operators that
+need items expand it with :meth:`to_pylist`; device-tier operators
+consume the columns directly.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["ArrayBatch"]
+
+
+class ArrayBatch:
+    """A columnar batch of rows.
+
+    Keyed convention: a batch feeding a keyed operator carries either
+    a ``"key"`` column (strings) or a dictionary-encoded ``"key_id"``
+    column (int32 into ``key_vocab``), plus a ``"value"`` column.
+    Dictionary encoding is the fast path: the engine maps external ids
+    to state slots with one vectorized table lookup instead of
+    per-batch string sorting.
+    """
+
+    __slots__ = ("cols", "key_vocab", "value_scale")
+
+    def __init__(
+        self,
+        cols: Dict[str, Any],
+        key_vocab: Any = None,
+        value_scale: Optional[float] = None,
+    ):
+        """``value_scale`` marks the ``value`` column as fixed-point:
+        real value = stored int * scale (lossless for e.g. one-decimal
+        temperatures stored as int16 deci-units)."""
+        if not cols:
+            msg = "ArrayBatch needs at least one column"
+            raise ValueError(msg)
+        self.cols = cols
+        self.key_vocab = key_vocab
+        self.value_scale = value_scale
+
+    def __len__(self) -> int:
+        first = next(iter(self.cols.values()))
+        return len(first)
+
+    def __repr__(self) -> str:
+        return f"ArrayBatch({{{', '.join(self.cols)}}}, rows={len(self)})"
+
+    def numpy(self, name: str) -> np.ndarray:
+        return np.asarray(self.cols[name])
+
+    def to_pylist(self) -> List[Any]:
+        """Expand to Python items for host-tier consumers.
+
+        ``("key", "value")`` columns become ``(key, value)`` tuples, a
+        single column becomes its scalars, anything else becomes
+        per-row dicts.
+        """
+        names = set(self.cols)
+        if names == {"key_id", "value"} and self.key_vocab is not None:
+            vocab = np.asarray(self.key_vocab)
+            keys = vocab[np.asarray(self.cols["key_id"])].tolist()
+            values = np.asarray(self.cols["value"])
+            if self.value_scale is not None:
+                values = values * self.value_scale
+            return list(zip(keys, values.tolist()))
+        if names == {"key", "value"}:
+            keys = np.asarray(self.cols["key"]).tolist()
+            values = np.asarray(self.cols["value"])
+            if self.value_scale is not None:
+                values = values * self.value_scale
+            return list(zip(keys, values.tolist()))
+        arrays = [np.asarray(c).tolist() for c in self.cols.values()]
+        if len(arrays) == 1:
+            return arrays[0]
+        return [dict(zip(self.cols, row)) for row in zip(*arrays)]

@@ -16,15 +16,20 @@ eligible segments of the same plan on the device mesh; both tiers share
 this driver's epoch/recovery bookkeeping.
 """
 
+import os
 import pickle
 import time
 import zlib
 from datetime import datetime, timedelta, timezone
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from bytewax_tpu.dataflow import Dataflow, Operator
+from bytewax_tpu.engine.arrays import ArrayBatch
 from bytewax_tpu.engine.flatten import Plan, flatten
 from bytewax_tpu.engine.recovery_store import RecoveryStore, ResumeFrom
+from bytewax_tpu.engine.xla import AccelSpec, DeviceAggState, NonNumericValues
 from bytewax_tpu.inputs import (
     AbortExecution,
     DynamicSource,
@@ -196,7 +201,8 @@ class _InputRt(_OpRt):
                 continue
             try:
                 batch = part.next_batch()
-                batch = batch if isinstance(batch, list) else list(batch)
+                if not isinstance(batch, (list, ArrayBatch)):
+                    batch = list(batch)
             except StopIteration:
                 if self.stateful:
                     self.pending_snaps.append((name, part.snapshot()))
@@ -252,7 +258,9 @@ class _FlatMapBatchRt(_OpRt):
     def process(self, port: str, entries: List[Entry]) -> None:
         for w, items in entries:
             try:
-                out = list(self.mapper(items))
+                out = self.mapper(items)
+                if not isinstance(out, (list, ArrayBatch)):
+                    out = list(out)
             except BaseException as ex:  # noqa: BLE001
                 _reraise(self.op.step_id, "the mapper", ex)
             self.emit("down", (w, out))
@@ -265,6 +273,8 @@ class _BranchRt(_OpRt):
 
     def process(self, port: str, entries: List[Entry]) -> None:
         for w, items in entries:
+            if isinstance(items, ArrayBatch):
+                items = items.to_pylist()
             trues, falses = [], []
             for item in items:
                 try:
@@ -291,6 +301,8 @@ class _RedistributeRt(_OpRt):
         w_count = self.driver.worker_count
         buckets: Dict[int, List[Any]] = {}
         for _w, items in entries:
+            if isinstance(items, ArrayBatch):
+                items = items.to_pylist()
             for item in items:
                 buckets.setdefault(self._rr % w_count, []).append(item)
                 self._rr += 1
@@ -306,6 +318,8 @@ class _InspectDebugRt(_OpRt):
     def process(self, port: str, entries: List[Entry]) -> None:
         epoch = self.driver.epoch
         for w, items in entries:
+            if isinstance(items, ArrayBatch):
+                items = items.to_pylist()
             for item in items:
                 try:
                     self.inspector(self.op.step_id, item, epoch, w)
@@ -327,14 +341,26 @@ class _StatefulBatchRt(_OpRt):
         self.logics: Dict[str, Any] = {}
         self.sched: Dict[str, datetime] = {}
         self.awoken: Set[str] = set()
-        # Eagerly rebuild logics for every resumed key so EOF-driven
-        # emission (fold_final etc.) fires even with no new input
-        # (reference loads snaps into logics at startup:
-        # src/operators.rs:976-1006).
-        for key, state in driver.resume_states(op.step_id).items():
-            logic = self._build(state)
-            self.logics[key] = logic
-            self._resched(key, logic)
+        # Recognized aggregation shapes fold on device instead of in
+        # per-key Python logics (annotated by the flatten-time
+        # lowering pass; same snapshots, same EOF emission order).
+        self.agg: Optional[DeviceAggState] = None
+        spec = op.conf.get("_accel")
+        if isinstance(spec, AccelSpec) and driver.accel:
+            self.agg = DeviceAggState(spec.kind)
+        resumed = driver.resume_states(op.step_id)
+        if self.agg is not None:
+            for key, state in resumed.items():
+                self.agg.load(key, state)
+        else:
+            # Eagerly rebuild logics for every resumed key so
+            # EOF-driven emission (fold_final etc.) fires even with no
+            # new input (reference loads snaps into logics at startup:
+            # src/operators.rs:976-1006).
+            for key, state in resumed.items():
+                logic = self._build(state)
+                self.logics[key] = logic
+                self._resched(key, logic)
 
     def _build(self, state: Optional[Any]) -> Any:
         try:
@@ -379,8 +405,13 @@ class _StatefulBatchRt(_OpRt):
             self.emit("down", (w, items))
 
     def process(self, port: str, entries: List[Entry]) -> None:
+        if self.agg is not None:
+            self._process_accel(entries)
+            return
         out: Dict[int, List[Any]] = {}
         for _w, items in entries:
+            if isinstance(items, ArrayBatch):
+                items = items.to_pylist()
             groups: Dict[str, List[Any]] = {}
             for item in items:
                 k, v = _extract_kv(item, self.op.step_id)
@@ -396,6 +427,36 @@ class _StatefulBatchRt(_OpRt):
                     _reraise(self.op.step_id, "`on_batch`", ex)
                 self._handle(key, emits, discard, out)
         self._flush(out)
+
+    def _process_accel(self, entries: List[Entry]) -> None:
+        assert self.agg is not None
+        for i, (_w, items) in enumerate(entries):
+            try:
+                if isinstance(items, ArrayBatch):
+                    touched = self.agg.update_batch(items)
+                else:
+                    keys = []
+                    values = []
+                    for item in items:
+                        k, v = _extract_kv(item, self.op.step_id)
+                        keys.append(k)
+                        values.append(v)
+                    if not keys:
+                        continue
+                    touched = self.agg.update(
+                        np.asarray(keys), np.asarray(values)
+                    )
+            except NonNumericValues as ex:
+                if not self.agg.keys() and not self.logics:
+                    # Non-numeric values: permanently fall back to the
+                    # host tier before any device state exists.
+                    self.agg = None
+                    self.process("up", entries[i:])
+                    return
+                _reraise(self.op.step_id, "the device aggregation", ex)
+            except TypeError as ex:
+                _reraise(self.op.step_id, "the device aggregation", ex)
+            self.awoken.update(touched)
 
     def advance(self, now: datetime) -> None:
         due = sorted(
@@ -418,7 +479,17 @@ class _StatefulBatchRt(_OpRt):
         self._flush(out)
 
     def on_upstream_eof(self) -> None:
-        out: Dict[int, List[Any]] = {}
+        if self.agg is not None:
+            out: Dict[int, List[Any]] = {}
+            w_count = self.driver.worker_count
+            for key, value in self.agg.finalize():
+                out.setdefault(_route_hash(key) % w_count, []).append(
+                    (key, value)
+                )
+                self.awoken.add(key)  # discard markers at epoch close
+            self._flush(out)
+            return
+        out = {}
         for key in sorted(self.logics.keys()):
             logic = self.logics[key]
             try:
@@ -432,6 +503,10 @@ class _StatefulBatchRt(_OpRt):
         return min(self.sched.values()) if self.sched else None
 
     def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
+        if self.agg is not None:
+            snaps = self.agg.snapshots_for(sorted(self.awoken))
+            self.awoken.clear()
+            return snaps
         snaps: List[Tuple[str, Optional[Any]]] = []
         for key in sorted(self.awoken):
             logic = self.logics.get(key)
@@ -487,6 +562,8 @@ class _OutputRt(_OpRt):
         if self.stateful:
             count = len(self.part_names)
             for _w, items in entries:
+                if isinstance(items, ArrayBatch):
+                    items = items.to_pylist()
                 buckets: Dict[str, List[Any]] = {}
                 for item in items:
                     k, v = _extract_kv(item, self.op.step_id)
@@ -504,7 +581,14 @@ class _OutputRt(_OpRt):
             for w, items in entries:
                 part = self.parts[f"worker-{w}"]
                 try:
-                    part.write_batch(items)
+                    if isinstance(items, ArrayBatch):
+                        writer = getattr(part, "write_array_batch", None)
+                        if writer is not None:
+                            writer(items)
+                        else:
+                            part.write_batch(items.to_pylist())
+                    else:
+                        part.write_batch(items)
                 except BaseException as ex:  # noqa: BLE001
                     _reraise(self.op.step_id, "`write_batch`", ex)
 
@@ -557,6 +641,22 @@ class _Driver:
         if self.epoch_interval < timedelta(0):
             msg = "epoch_interval must be non-negative"
             raise ValueError(msg)
+
+        # Device acceleration of recognized aggregations; disable with
+        # BYTEWAX_TPU_ACCEL=0 to force the host-tier oracle.
+        self.accel = os.environ.get("BYTEWAX_TPU_ACCEL", "1") != "0"
+
+        # BYTEWAX_TPU_PLATFORM=cpu forces the CPU backend even when a
+        # site hook pre-registers an accelerator (useful when the chip
+        # is busy or absent; host-tier flows don't need it).
+        plat = os.environ.get("BYTEWAX_TPU_PLATFORM")
+        if plat:
+            import jax
+
+            try:
+                jax.config.update("jax_platforms", plat)
+            except Exception:  # noqa: BLE001 — already initialized
+                pass
 
         self.store: Optional[RecoveryStore] = None
         self._loads: Dict[Tuple[str, str], bytes] = {}

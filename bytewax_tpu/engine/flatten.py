@@ -6,11 +6,39 @@ recursive walk producing a topologically-ordered list of core
 operators plus stream wiring tables.
 """
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from bytewax_tpu.dataflow import Dataflow, DataflowError, Operator
 
 __all__ = ["Plan", "flatten"]
+
+
+def _find_core_stateful(op: Operator) -> Optional[Operator]:
+    for sub in op.substeps:
+        if sub.core and sub.name == "stateful_batch":
+            return sub
+        found = _find_core_stateful(sub)
+        if found is not None:
+            return found
+    return None
+
+
+def _annotate_accel(op: Operator) -> None:
+    """Lowering pass: recognize aggregation shapes and annotate their
+    core ``stateful_batch`` with a device :class:`AccelSpec` so the
+    driver folds them on device instead of per-key Python logics."""
+    from bytewax_tpu.engine.xla import AccelSpec
+    from bytewax_tpu.xla import Reducer
+
+    spec: Optional[AccelSpec] = None
+    if op.name == "reduce_final" and isinstance(op.conf.get("reducer"), Reducer):
+        spec = AccelSpec(op.conf["reducer"].kind)
+    elif op.name == "stats_final":
+        spec = AccelSpec("stats")
+    if spec is not None:
+        inner = _find_core_stateful(op)
+        if inner is not None:
+            inner.conf["_accel"] = spec
 
 CORE_OPS = frozenset(
     {
@@ -56,6 +84,7 @@ def _walk(op: Operator, plan: Plan) -> None:
         for s in op.down_streams():
             plan.producer[s.stream_id] = idx
     else:
+        _annotate_accel(op)
         for sub in op.substeps:
             _walk(sub, plan)
 

@@ -1,0 +1,419 @@
+"""Device-resident keyed aggregation state.
+
+Replaces per-key Python logic objects with slot-table device arrays
+for the recognized reduction kinds (see
+:mod:`bytewax_tpu.ops.segment`).  The host keeps the key→slot
+vocabulary; values fold in on device; snapshots `jax.device_get` only
+the slots awoken in the closing epoch, preserving the recovery
+contract of the host tier (states are interchangeable between tiers).
+"""
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bytewax_tpu.engine.arrays import ArrayBatch
+from bytewax_tpu.ops.segment import (
+    AGG_KINDS,
+    init_fields,
+    update_fields,
+    update_fields_packed,
+    update_fields_vocab,
+)
+
+__all__ = ["AccelSpec", "DeviceAggState", "NonNumericValues"]
+
+_MIN_CAPACITY = 1024
+
+
+class NonNumericValues(TypeError):
+    """Values are not device-foldable; the caller should fall back to
+    the host tier (distinct from malformed-batch errors, which must
+    surface)."""
+
+
+class AccelSpec:
+    """Annotation on a core ``stateful_batch`` op: lower it to a
+    device aggregation of this kind instead of per-key Python logics."""
+
+    def __init__(self, kind: str):
+        if kind not in AGG_KINDS:
+            msg = f"unknown aggregation kind {kind!r}"
+            raise ValueError(msg)
+        self.kind = kind
+
+    def __repr__(self) -> str:
+        return f"AccelSpec({self.kind!r})"
+
+
+def _final_of(kind: str, fields: Dict[str, np.ndarray], i: int):
+    if kind == "sum":
+        return fields["sum"][i].item()
+    if kind == "count":
+        return int(fields["count"][i].item())
+    if kind == "min":
+        return fields["min"][i].item()
+    if kind == "max":
+        return fields["max"][i].item()
+    if kind == "mean":
+        count = fields["count"][i].item()
+        return fields["sum"][i].item() / count if count else 0.0
+    if kind == "stats":
+        count = fields["count"][i].item()
+        mean = fields["sum"][i].item() / count if count else 0.0
+        return (
+            fields["min"][i].item(),
+            mean,
+            fields["max"][i].item(),
+            int(count),
+        )
+    raise AssertionError(kind)
+
+
+def _snap_of(kind: str, fields: Dict[str, np.ndarray], i: int):
+    # Single-field kinds snapshot the bare scalar so host-tier logics
+    # can resume from device snapshots and vice versa.
+    if kind in ("sum", "min", "max"):
+        return fields[next(iter(fields))][i].item()
+    if kind == "count":
+        return int(fields["count"][i].item())
+    if kind == "mean":
+        return (fields["sum"][i].item(), int(fields["count"][i].item()))
+    if kind == "stats":
+        return (
+            fields["min"][i].item(),
+            fields["max"][i].item(),
+            fields["sum"][i].item(),
+            int(fields["count"][i].item()),
+        )
+    raise AssertionError(kind)
+
+
+class DeviceAggState:
+    """Slot-table aggregation state for one stateful step.
+
+    The last slot of the table is scratch for masked (padding) rows;
+    keys occupy slots ``0..capacity-2``.  Tables double when full so
+    XLA recompiles only O(log n) shapes.
+    """
+
+    def __init__(self, kind: str, sharding: Optional[Any] = None):
+        self.kind_name = kind
+        self.kind = AGG_KINDS[kind]
+        self.sharding = sharding
+        self.capacity = _MIN_CAPACITY
+        self.key_to_slot: Dict[str, int] = {}
+        self.slot_keys: List[str] = []
+        self.dtype = jnp.float32
+        self._fields = None  # lazy until first update/load
+        # Dictionary-encoded fast path: external id -> slot table,
+        # mirrored on device so raw (id, value) columns are all the
+        # host ships per batch.
+        self._ext_vocab: Optional[np.ndarray] = None
+        self._ext_to_slot: Optional[np.ndarray] = None
+        self._vocab_ref: Any = None
+        self._dev_map = None
+
+    # -- slot management ---------------------------------------------------
+
+    def _ensure_fields(self) -> None:
+        if self._fields is None:
+            self._fields = init_fields(self.kind, self.capacity, self.dtype)
+            if self.sharding is not None:
+                self._fields = {
+                    k: jax.device_put(v, self.sharding)
+                    for k, v in self._fields.items()
+                }
+
+    def _grow_to(self, needed: int) -> None:
+        new_cap = self.capacity
+        while new_cap - 1 < needed:
+            new_cap *= 2
+        if new_cap == self.capacity:
+            return
+        # The scratch slot moves to the new last index; any device
+        # id→slot table pointing at the old scratch is stale.
+        self._dev_map = None
+        self._ensure_fields()
+        grown = {}
+        for name, (init, _op) in self.kind.fields.items():
+            old = self._fields[name]
+            # The old scratch slot becomes a real slot: clear it.
+            old = old.at[self.capacity - 1].set(init)
+            pad = jnp.full((new_cap - self.capacity,), init, dtype=old.dtype)
+            arr = jnp.concatenate([old, pad])
+            if self.sharding is not None:
+                arr = jax.device_put(arr, self.sharding)
+            grown[name] = arr
+        self._fields = grown
+        self.capacity = new_cap
+
+    def _slots_for(self, keys: np.ndarray) -> np.ndarray:
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        slot_of_uniq = np.empty(len(uniq), dtype=np.int32)
+        new_count = sum(1 for k in uniq if k not in self.key_to_slot)
+        if new_count:
+            self._grow_to(len(self.key_to_slot) + new_count + 1)
+        for j, k in enumerate(uniq):
+            k = str(k)
+            slot = self.key_to_slot.get(k)
+            if slot is None:
+                slot = len(self.slot_keys)
+                self.key_to_slot[k] = slot
+                self.slot_keys.append(k)
+            slot_of_uniq[j] = slot
+        return slot_of_uniq[inverse]
+
+    # -- updates -----------------------------------------------------------
+
+    def _pick_dtype(self, values: np.ndarray) -> np.ndarray:
+        """Choose the accumulator dtype; integer inputs that don't fit
+        32 bits fall back to the exact host tier.  Per-key integer
+        sums exceeding 2^31 are out of scope for the device tier —
+        use a plain Python reducer for bigint arithmetic."""
+        if np.issubdtype(values.dtype, np.integer):
+            if values.dtype.itemsize > 4:
+                if len(values) and (
+                    values.max() > np.iinfo(np.int32).max
+                    or values.min() < np.iinfo(np.int32).min
+                ):
+                    msg = (
+                        "device-accelerated reduction over integers "
+                        "wider than 32 bits is not exact; pass a plain "
+                        "Python reducer"
+                    )
+                    raise NonNumericValues(msg)
+                values = values.astype(np.int32)
+            if self._fields is None:
+                self.dtype = jnp.int32
+        return values
+
+    def update(self, keys: np.ndarray, values: np.ndarray) -> List[str]:
+        """Fold ``(key, value)`` rows in; returns the unique keys
+        touched (for epoch snapshot bookkeeping)."""
+        keys = np.asarray(keys)
+        values = np.asarray(values)
+        if values.dtype == object or values.dtype.kind in "US":
+            msg = (
+                "device-accelerated reduction requires numeric values; "
+                "pass a plain Python reducer for non-numeric data"
+            )
+            raise NonNumericValues(msg)
+        values = self._pick_dtype(values)
+        slot_ids = self._slots_for(keys)
+        self._ensure_fields()
+        self._scatter(slot_ids, values)
+        return [str(k) for k in np.unique(keys)]
+
+    def _scatter(self, slot_ids: np.ndarray, values: np.ndarray) -> None:
+        n = len(values)
+        # Pad to the next power of two so XLA sees few distinct
+        # shapes; padding rows target the scratch slot (capacity - 1).
+        padded = 1 << max(5, math.ceil(math.log2(max(n, 1))))
+        slots_p = np.full(padded, self.capacity - 1, dtype=np.int32)
+        slots_p[:n] = slot_ids
+        vals_p = np.zeros(padded, dtype=np.dtype(self.dtype))
+        vals_p[:n] = values
+        self._fields = update_fields(
+            self.kind,
+            self._fields,
+            jax.device_put(slots_p),
+            jax.device_put(vals_p),
+        )
+
+    def _fetch(self) -> Dict[str, np.ndarray]:
+        """One stacked device→host transfer for all fields (device
+        round-trips dominate over tunneled links)."""
+        names = list(self.kind.fields)
+        stacked = np.asarray(
+            jnp.stack([self._fields[name] for name in names])
+        )
+        return {name: stacked[i] for i, name in enumerate(names)}
+
+    def _sync_vocab(self, ids: np.ndarray, vocab: np.ndarray) -> np.ndarray:
+        """Assign slots for newly-seen external ids and refresh the
+        on-device id→slot table; returns the touched unique ids."""
+        if self._ext_vocab is None:
+            self._ext_vocab = np.asarray(vocab)
+            self._ext_to_slot = np.full(len(vocab), -1, dtype=np.int32)
+            self._vocab_ref = vocab
+        elif vocab is not self._vocab_ref:
+            # Vocabularies must be append-only extensions: id meanings
+            # can never change between batches.
+            prev = len(self._ext_to_slot)
+            if len(vocab) < prev or not np.array_equal(
+                vocab[:prev], self._ext_vocab[:prev]
+            ):
+                msg = (
+                    "key_vocab must be an append-only extension of the "
+                    "vocabulary used by earlier batches of this step"
+                )
+                raise TypeError(msg)
+            if len(vocab) > prev:
+                pad = np.full(len(vocab) - prev, -1, np.int32)
+                self._ext_vocab = np.asarray(vocab)
+                self._ext_to_slot = np.concatenate([self._ext_to_slot, pad])
+            self._vocab_ref = vocab
+        # bincount + nonzero beats np.unique's sort by ~20x here.
+        counts = np.bincount(ids, minlength=len(self._ext_to_slot))
+        uniq = np.nonzero(counts)[0]
+        new = uniq[self._ext_to_slot[uniq] < 0]
+        if len(new) or self._dev_map is None:
+            self._grow_to(len(self.key_to_slot) + len(new) + 1)
+            for ext in new.tolist():
+                key = str(self._ext_vocab[ext])
+                # Recovery resume may have assigned this key a slot
+                # already (by name); reuse it.
+                slot = self.key_to_slot.get(key)
+                if slot is None:
+                    slot = len(self.slot_keys)
+                    self.key_to_slot[key] = slot
+                    self.slot_keys.append(key)
+                self._ext_to_slot[ext] = slot
+            # Rebuild the device table: unseen ids and the padding
+            # sentinel (index len(vocab)) route to the scratch slot.
+            table = np.append(self._ext_to_slot, -1)
+            table = np.where(table < 0, self.capacity - 1, table).astype(
+                np.int32
+            )
+            self._dev_map = jax.device_put(table)
+        return uniq
+
+    def update_batch(self, batch: ArrayBatch) -> List[str]:
+        if "key_id" in batch.cols and batch.key_vocab is not None:
+            ids = batch.numpy("key_id")
+            values = batch.numpy("value")
+            quantized = (
+                batch.value_scale is not None
+                and values.dtype == np.int16
+            )
+            if batch.value_scale is not None and self.dtype != jnp.float32:
+                msg = (
+                    "fixed-point (value_scale) batches need a float "
+                    "accumulator, but earlier batches locked this "
+                    "step's state to an integer dtype"
+                )
+                raise TypeError(msg)
+            if batch.value_scale is not None and not quantized:
+                # Fixed-point values in a non-int16 carrier: dequantize
+                # host-side into the (float) accumulator dtype.
+                values = (values * batch.value_scale).astype(np.float32)
+            elif not quantized:
+                values = self._pick_dtype(values)
+            uniq = self._sync_vocab(ids, np.asarray(batch.key_vocab))
+            self._ensure_fields()
+            n = len(values)
+            sentinel = len(self._ext_to_slot)
+            padded = 1 << max(5, math.ceil(math.log2(max(n, 1))))
+            if quantized and sentinel < 2**15:
+                # Fixed-point fast path: one int16 [2, n] transfer.
+                packed = np.full((2, padded), sentinel, dtype=np.int16)
+                packed[0, :n] = ids
+                packed[1, :n] = values
+                packed[1, n:] = 0
+                self._fields = update_fields_packed(
+                    self.kind,
+                    self._fields,
+                    self._dev_map,
+                    jax.device_put(packed),
+                    jnp.float32(batch.value_scale),
+                )
+            else:
+                id_dtype = np.int16 if sentinel < 2**15 else np.int32
+                ids_p = np.full(padded, sentinel, dtype=id_dtype)
+                ids_p[:n] = ids
+                vals_p = np.zeros(padded, dtype=np.dtype(self.dtype))
+                vals_p[:n] = values
+                self._fields = update_fields_vocab(
+                    self.kind,
+                    self._fields,
+                    self._dev_map,
+                    jax.device_put(ids_p),
+                    jax.device_put(vals_p),
+                )
+            return [str(self._ext_vocab[e]) for e in uniq.tolist()]
+        if "key" in batch.cols:
+            values = batch.numpy("value")
+            if batch.value_scale is not None:
+                values = (values * batch.value_scale).astype(np.float32)
+            return self.update(batch.numpy("key"), values)
+        msg = (
+            "columnar batch feeding an accelerated keyed aggregation "
+            "needs a 'key' or dictionary-encoded 'key_id' column"
+        )
+        raise TypeError(msg)
+
+    # -- recovery ----------------------------------------------------------
+
+    def load(self, key: str, state: Any) -> None:
+        """Install a resumed snapshot for a key (host-tier format)."""
+        kind = self.kind_name
+        if kind in ("sum", "min", "max", "count"):
+            field_vals = {next(iter(self.kind.fields)): float(state)}
+            if kind == "count":
+                field_vals = {"count": float(state)}
+            if isinstance(state, int) and self._fields is None:
+                self.dtype = jnp.int32
+        elif kind == "mean":
+            total, count = state
+            field_vals = {"sum": float(total), "count": float(count)}
+        else:  # stats
+            mn, mx, total, count = state
+            field_vals = {
+                "min": float(mn),
+                "max": float(mx),
+                "sum": float(total),
+                "count": float(count),
+            }
+        self._grow_to(len(self.key_to_slot) + 2)
+        self._ensure_fields()
+        slot = self.key_to_slot.get(key)
+        if slot is None:
+            slot = len(self.slot_keys)
+            self.key_to_slot[key] = slot
+            self.slot_keys.append(key)
+        for name, val in field_vals.items():
+            self._fields[name] = (
+                self._fields[name].at[slot].set(jnp.asarray(val, self.dtype))
+            )
+
+    def snapshots_for(self, keys: List[str]) -> List[Tuple[str, Any]]:
+        """Host-format snapshots of specific keys (one device_get)."""
+        if self._fields is None or not keys:
+            return [(k, None) for k in keys]
+        host = self._fetch()
+        out = []
+        for key in keys:
+            slot = self.key_to_slot.get(key)
+            if slot is None:
+                out.append((key, None))
+            else:
+                out.append((key, _snap_of(self.kind_name, host, slot)))
+        return out
+
+    # -- finalization ------------------------------------------------------
+
+    def finalize(self) -> List[Tuple[str, Any]]:
+        """Emit ``(key, final_value)`` for every live key, sorted by
+        key (matching the host tier's EOF ordering), and clear."""
+        if not self.slot_keys:
+            return []
+        self._ensure_fields()
+        host = self._fetch()
+        out = [
+            (key, _final_of(self.kind_name, host, self.key_to_slot[key]))
+            for key in sorted(self.key_to_slot)
+        ]
+        self.key_to_slot.clear()
+        self.slot_keys.clear()
+        self._fields = None
+        self._ext_vocab = None
+        self._ext_to_slot = None
+        self._dev_map = None
+        return out
+
+    def keys(self) -> List[str]:
+        return list(self.slot_keys)

@@ -1,0 +1,49 @@
+"""Anomaly detector: per-key rolling z-score via ``stateful_map``
+(reference: ``examples/anomaly_detector.py``)."""
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.outputs import Sink
+
+__all__ = ["ZScoreState", "anomaly_flow"]
+
+
+@dataclass
+class ZScoreState:
+    count: int = 0
+    mean: float = 0.0
+    m2: float = 0.0  # Welford running variance numerator
+
+
+def _update(
+    state: Optional[ZScoreState], value: float, threshold: float
+) -> Tuple[ZScoreState, Tuple[float, float, bool]]:
+    if state is None:
+        state = ZScoreState()
+    if state.count >= 2 and state.m2 > 0:
+        std = (state.m2 / (state.count - 1)) ** 0.5
+        z = (value - state.mean) / std if std > 0 else 0.0
+    else:
+        z = 0.0
+    is_anomaly = abs(z) > threshold
+    # Welford online update.
+    state.count += 1
+    delta = value - state.mean
+    state.mean += delta / state.count
+    state.m2 += delta * (value - state.mean)
+    return state, (value, z, is_anomaly)
+
+
+def anomaly_flow(source, sink: Sink, threshold: float = 3.0) -> Dataflow:
+    """Items are ``(key, value)``; emits ``(key, (value, zscore,
+    is_anomaly))`` per item with per-key online mean/variance state."""
+    flow = Dataflow("anomaly_detector")
+    s = op.input("inp", flow, source)
+    scored = op.stateful_map(
+        "zscore", s, lambda st, v: _update(st, v, threshold)
+    )
+    op.output("out", scored, sink)
+    return flow

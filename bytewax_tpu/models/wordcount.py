@@ -1,0 +1,28 @@
+"""Word-count flow (reference: ``examples/wordcount.py``)."""
+
+import re
+from typing import Callable, Optional
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.outputs import Sink
+
+__all__ = ["wordcount_flow"]
+
+_TOKEN_RE = re.compile(r"[^\s!,.?\":;0-9]+")
+
+
+def wordcount_flow(
+    source,
+    sink: Sink,
+    tokenizer: Optional[Callable[[str], list]] = None,
+) -> Dataflow:
+    """lines → lowercase → tokenize → count per word (emit at EOF)."""
+    tokenize = tokenizer or _TOKEN_RE.findall
+    flow = Dataflow("wordcount")
+    s = op.input("inp", flow, source)
+    s = op.map("lower", s, str.lower)
+    s = op.flat_map("tokenize", s, tokenize)
+    counts = op.count_final("count", s, lambda word: word)
+    op.output("out", counts, sink)
+    return flow

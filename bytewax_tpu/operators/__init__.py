@@ -685,8 +685,10 @@ def count_final(
 
     Reference parity: ``operators/__init__.py:1221``.
     """
+    from bytewax_tpu.xla import SUM
+
     down = map("key", up, lambda x: (key(x), 1))
-    return reduce_final("sum", down, lambda s, x: s + x)
+    return reduce_final("sum", down, SUM)
 
 
 @operator
@@ -699,6 +701,10 @@ def max_final(
 
     Reference parity: ``operators/__init__.py:2624``.
     """
+    if by is _identity:
+        from bytewax_tpu.xla import MAX
+
+        return reduce_final("reduce_final", up, MAX)
     return reduce_final("reduce_final", up, lambda s, x: max(s, x, key=by))
 
 
@@ -712,6 +718,10 @@ def min_final(
 
     Reference parity: ``operators/__init__.py:2692``.
     """
+    if by is _identity:
+        from bytewax_tpu.xla import MIN
+
+        return reduce_final("reduce_final", up, MIN)
     return reduce_final("reduce_final", up, lambda s, x: min(s, x, key=by))
 
 
@@ -732,6 +742,11 @@ def reduce_final(
     """
 
     def pre_reducer(mixed_batch: List[Tuple[str, V]]) -> Iterable[Tuple[str, V]]:
+        from bytewax_tpu.engine.arrays import ArrayBatch
+
+        if isinstance(mixed_batch, ArrayBatch):
+            # Columnar batches pre-combine on device instead.
+            return mixed_batch
         states: Dict[str, V] = {}
         for k, v in mixed_batch:
             if k in states:

@@ -1,0 +1,120 @@
+"""The sharded streaming step: keyed exchange + scatter-combine over a
+device mesh.
+
+This is the multi-chip "training step" of the framework: a micro-batch
+of ``(key_id, value)`` rows, sharded over devices on the row axis, is
+exchanged over ICI so each device receives the rows whose keys it
+owns (``key_id % n_shards``), then folded into that device's block of
+the key-sharded state table.  One compiled program per micro-batch —
+no host hop, no RPC mesh — replacing the reference's
+``routed_exchange`` + per-key Python callbacks
+(``/root/reference/src/timely.rs:806-812``,
+``src/operators.rs:767-808``).
+"""
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bytewax_tpu.ops.segment import AGG_KINDS, AggKind
+from bytewax_tpu.parallel.exchange import bucket_by_shard
+from bytewax_tpu.parallel.mesh import SHARD_AXIS
+
+__all__ = ["init_sharded_fields", "make_sharded_step"]
+
+
+def init_sharded_fields(
+    kind: AggKind, mesh: Mesh, cap_per_shard: int, dtype=jnp.float32
+) -> Dict[str, jax.Array]:
+    """State table sharded over the mesh: ``n_shards * cap_per_shard``
+    slots, block ``d`` living on device ``d``."""
+    n_shards = mesh.shape[SHARD_AXIS]
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    return {
+        name: jax.device_put(
+            jnp.full((n_shards * cap_per_shard,), init, dtype=dtype),
+            sharding,
+        )
+        for name, (init, _op) in kind.fields.items()
+    }
+
+
+def make_sharded_step(
+    mesh: Mesh,
+    kind_name: str,
+    cap_per_shard: int,
+    exchange_capacity: int,
+):
+    """Build the jitted sharded update step.
+
+    Returned ``step(fields, key_ids, values, valid) -> fields`` expects
+    rows sharded on the leading axis over the mesh and the state
+    sharded per :func:`init_sharded_fields`.  Key ownership is
+    ``key_id % n_shards``; a key's slot within its owner is
+    ``key_id // n_shards``, scratch slot is the block's last.
+    """
+    kind = AGG_KINDS[kind_name]
+    n_shards = mesh.shape[SHARD_AXIS]
+
+    def body(fields, key_ids, values, valid):
+        # 1. Keyed exchange over ICI: ship each row to its owner.
+        # Values ride bitcast to int32 so key ids keep full precision
+        # (a float32 payload would corrupt ids above 2^24).
+        shard_ids = (key_ids % n_shards).astype(jnp.int32)
+        payload = jnp.stack(
+            [
+                key_ids.astype(jnp.int32),
+                jax.lax.bitcast_convert_type(
+                    values.astype(jnp.float32), jnp.int32
+                ),
+            ],
+            axis=1,
+        )
+        buckets, counts = bucket_by_shard(
+            shard_ids, payload, valid, n_shards, exchange_capacity
+        )
+        got = jax.lax.all_to_all(
+            buckets, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )
+        got_counts = jax.lax.all_to_all(
+            counts, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )
+        mask = (
+            jnp.arange(exchange_capacity)[None, :] < got_counts[:, None]
+        ).reshape(-1)
+        rows = got.reshape(-1, 2)
+        recv_ids = rows[:, 0]
+        recv_vals = jax.lax.bitcast_convert_type(rows[:, 1], jnp.float32)
+
+        # 2. Local scatter-combine into this device's state block.
+        local_slot = jnp.where(
+            mask, recv_ids // n_shards, cap_per_shard - 1
+        )
+        out = {}
+        for name, (init, op_name) in kind.fields.items():
+            arr = fields[name]
+            if name == "count":
+                contrib = jnp.where(mask, 1.0, 0.0).astype(arr.dtype)
+            else:
+                contrib = jnp.where(mask, recv_vals, init).astype(arr.dtype)
+            ref = arr.at[local_slot]
+            if op_name == "add":
+                zero = jnp.zeros((), dtype=arr.dtype)
+                out[name] = ref.add(jnp.where(mask, contrib, zero))
+            elif op_name == "min":
+                out[name] = ref.min(contrib)
+            else:
+                out[name] = ref.max(contrib)
+        return out
+
+    field_specs = {name: P(SHARD_AXIS) for name in kind.fields}
+    shard_fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(field_specs, P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=field_specs,
+    )
+    return jax.jit(shard_fn, donate_argnums=(0,))

@@ -1,0 +1,105 @@
+"""Keyed exchange over the device mesh.
+
+The reference ships ``(worker, (key, value))`` tuples over a TCP mesh
+with pickled payloads (``/root/reference/src/timely.rs:806-812``,
+``src/pyo3_extensions.rs:94-148``).  The TPU-native equivalent keeps
+the batch on device: rows are bucketed by target shard with a stable
+key hash and exchanged with ``jax.lax.all_to_all`` over ICI inside the
+compiled step.
+
+Buckets are fixed-capacity (static shapes for XLA); the capacity is a
+per-step micro-batch bound, not a global limit — the host driver sizes
+micro-batches so ``rows / n_shards`` fits with headroom.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bytewax_tpu.parallel.mesh import SHARD_AXIS
+
+__all__ = ["bucket_by_shard", "keyed_all_to_all"]
+
+
+def bucket_by_shard(
+    shard_ids: jax.Array,
+    values: jax.Array,
+    valid: jax.Array,
+    n_shards: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Group rows into fixed-capacity per-shard buckets.
+
+    :arg shard_ids: ``[n]`` int32 target shard per row.
+    :arg values: ``[n, ...]`` row payloads.
+    :arg valid: ``[n]`` bool mask of real (non-padding) rows.
+    :arg n_shards: Number of buckets.
+    :arg capacity: Rows per bucket; overflowing rows are dropped (the
+        host driver must size micro-batches to prevent this).
+    :returns: ``(buckets [n_shards, capacity, ...], counts
+        [n_shards])``; slots beyond the count are zero.
+    """
+    n = shard_ids.shape[0]
+    shard_ids = jnp.where(valid, shard_ids, n_shards)  # padding → overflow bin
+    # Stable position of each row within its bucket.
+    onehot = jax.nn.one_hot(shard_ids, n_shards + 1, dtype=jnp.int32)  # [n, S+1]
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # rank of row in its bucket
+    row_pos = jnp.take_along_axis(pos, shard_ids[:, None], axis=1)[:, 0]
+    counts = jnp.minimum(onehot.sum(axis=0)[:n_shards], capacity)
+
+    in_cap = row_pos < capacity
+    keep = valid & (shard_ids < n_shards) & in_cap
+    flat_idx = jnp.where(keep, shard_ids * capacity + row_pos, n_shards * capacity)
+
+    flat_shape = (n_shards * capacity + 1,) + values.shape[1:]
+    flat = jnp.zeros(flat_shape, dtype=values.dtype).at[flat_idx].set(values)
+    buckets = flat[:-1].reshape((n_shards, capacity) + values.shape[1:])
+    return buckets, counts
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "capacity"))
+def keyed_all_to_all(
+    mesh: Mesh,
+    capacity: int,
+    shard_ids: jax.Array,
+    values: jax.Array,
+    valid: jax.Array,
+):
+    """Exchange rows to their owning shard over ICI.
+
+    Each device buckets its local rows by target shard and the buckets
+    are exchanged with ``all_to_all``; afterwards device *d* holds all
+    rows whose ``shard_id == d`` (up to ``capacity`` per source
+    shard), plus a validity mask.
+
+    Runs as ``shard_map`` over the mesh; inputs are sharded on the
+    leading (row) axis.
+    """
+    n_shards = mesh.shape[SHARD_AXIS]
+
+    def body(shard_ids, values, valid):
+        buckets, counts = bucket_by_shard(
+            shard_ids, values, valid, n_shards, capacity
+        )
+        # [n_shards, capacity, ...] on each device → exchange along
+        # axis 0 so device d receives every source's bucket d.
+        got = jax.lax.all_to_all(
+            buckets, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )
+        got_counts = jax.lax.all_to_all(
+            counts, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )
+        mask = (
+            jnp.arange(capacity)[None, :] < got_counts[:, None]
+        )  # [n_shards, capacity]
+        return got.reshape((n_shards * capacity,) + got.shape[2:]), mask.reshape(-1)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )(shard_ids, values, valid)

@@ -6,13 +6,20 @@ tests run on a virtual 8-device CPU mesh."""
 import os
 
 # Force a deterministic virtual 8-device CPU mesh for all tests BEFORE
-# jax initializes; real TPU runs use bench.py / run.py directly.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# jax initializes (override any inherited platform setting, e.g. a
+# tunneled TPU); real TPU runs use bench.py / run.py directly.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# Site hooks may pre-register an accelerator backend regardless of the
+# env var; the config flag wins as long as no backend was touched yet.
+jax.config.update("jax_platforms", "cpu")
 
 from datetime import datetime, timezone  # noqa: E402
 
