@@ -1,0 +1,87 @@
+"""Connectors for writing local-first demo dataflows.
+
+Reference parity: ``/root/reference/pysrc/bytewax/connectors/demo.py``.
+"""
+
+import random
+from datetime import datetime, timedelta, timezone
+from typing import Any, List, Optional, Tuple
+
+from bytewax_tpu.inputs import FixedPartitionedSource, StatefulSourcePartition
+
+__all__ = ["RandomMetricSource"]
+
+
+class _RandomMetricPartition(
+    StatefulSourcePartition[Tuple[str, float], Tuple[int, float, Any]]
+):
+    def __init__(
+        self,
+        metric_name: str,
+        interval: timedelta,
+        count: int,
+        next_random: "random.Random",
+        resume_state: Optional[Tuple[int, float, Any]],
+    ):
+        self._metric_name = metric_name
+        self._interval = interval
+        self._count = count
+        self._rand = next_random
+        if resume_state:
+            emitted, value, rng_state = resume_state
+            # Continue the RNG sequence from the snapshot; rebuilding
+            # from the seed would replay already-applied deltas.
+            self._rand.setstate(rng_state)
+        else:
+            emitted, value = 0, 0.0
+        self._emitted = emitted
+        self._value = value
+        self._next_awake = datetime.now(timezone.utc)
+
+    def next_batch(self) -> List[Tuple[str, float]]:
+        if self._emitted >= self._count:
+            raise StopIteration()
+        self._value += self._rand.uniform(-1.0, 1.0)
+        self._emitted += 1
+        self._next_awake += self._interval
+        return [(self._metric_name, self._value)]
+
+    def next_awake(self) -> Optional[datetime]:
+        return self._next_awake
+
+    def snapshot(self) -> Tuple[int, float, Any]:
+        return (self._emitted, self._value, self._rand.getstate())
+
+
+class RandomMetricSource(FixedPartitionedSource):
+    """Demo source of randomly-walking ``(metric_name, value)`` pairs
+    at a fixed interval."""
+
+    def __init__(
+        self,
+        metric_name: str,
+        interval: timedelta = timedelta(seconds=0.7),
+        count: int = 100,
+        seed: Optional[int] = None,
+    ):
+        self._metric_name = metric_name
+        self._interval = interval
+        self._count = count
+        self._seed = seed
+
+    def list_parts(self) -> List[str]:
+        return [self._metric_name]
+
+    def build_part(
+        self,
+        step_id: str,
+        for_part: str,
+        resume_state: Optional[Tuple[int, float, Any]],
+    ) -> _RandomMetricPartition:
+        return _RandomMetricPartition(
+            self._metric_name,
+            self._interval,
+            self._count,
+            random.Random(self._seed),
+            resume_state,
+        )

@@ -1,0 +1,282 @@
+"""Execute a dataflow from the command line.
+
+Use it like:
+
+```console
+$ python -m bytewax_tpu.run my_flow:flow
+```
+
+CLI/env-var parity with the reference (``/root/reference/pysrc/bytewax/run.py``):
+Flask-style import strings (variable, or factory call with literal
+args), ``-w/-i/-a/-r/-s/-b`` flags each with a ``BYTEWAX_*`` env-var
+fallback, and k8s conventions (``BYTEWAX_POD_NAME`` /
+``BYTEWAX_STATEFULSET_NAME`` → process id, ``BYTEWAX_HOSTFILE_PATH`` →
+addresses).
+"""
+
+import argparse
+import ast
+import inspect
+import os
+import sys
+from datetime import timedelta
+from pathlib import Path
+from typing import Any, Optional, Tuple
+
+from bytewax_tpu.engine.driver import cluster_main, run_main
+from bytewax_tpu.recovery import RecoveryConfig
+
+__all__ = ["cli_main"]
+
+
+def _prepare_import(import_str: str) -> Tuple[str, str]:
+    """Resolve a ``module:attr`` import string; bare ``.py`` paths are
+    converted to module paths rooted at the CWD."""
+    if ":" in import_str:
+        module_str, _, dataflow_name = import_str.partition(":")
+    else:
+        module_str, dataflow_name = import_str, "flow"
+    path = Path(module_str)
+    if path.suffix == ".py" or path.is_file():
+        path = path.resolve()
+        module_name = path.stem
+        search_path = str(path.parent)
+        if search_path not in sys.path:
+            sys.path.insert(0, search_path)
+        return module_name, dataflow_name
+    return module_str, dataflow_name
+
+
+def _locate_dataflow(module_name: str, dataflow_name: str):
+    """Import a module and find the Dataflow in it: a variable name or
+    a zero-/literal-arg factory call (Flask-style)."""
+    from bytewax_tpu.dataflow import Dataflow
+
+    __import__(module_name)
+    module = sys.modules[module_name]
+
+    try:
+        expr = ast.parse(dataflow_name.strip(), mode="eval").body
+    except SyntaxError:
+        msg = (
+            f"failed to parse {dataflow_name!r} as an attribute name "
+            "or function call"
+        )
+        raise SyntaxError(msg) from None
+
+    if isinstance(expr, ast.Name):
+        name, args, kwargs = expr.id, [], {}
+    elif isinstance(expr, ast.Call):
+        if not isinstance(expr.func, ast.Name):
+            msg = f"function reference must be a simple name: {dataflow_name!r}"
+            raise TypeError(msg)
+        name = expr.func.id
+        try:
+            args = [ast.literal_eval(arg) for arg in expr.args]
+            kwargs = {
+                str(kw.arg): ast.literal_eval(kw.value)
+                for kw in expr.keywords
+            }
+        except ValueError:
+            msg = f"failed to parse arguments as literal values: {dataflow_name!r}"
+            raise ValueError(msg) from None
+    else:
+        msg = (
+            f"failed to parse {dataflow_name!r} as an attribute name "
+            "or function call"
+        )
+        raise ValueError(msg)
+
+    try:
+        attr = getattr(module, name)
+    except AttributeError as ex:
+        msg = f"failed to find attribute {name!r} in {module.__name__!r}"
+        raise AttributeError(msg) from ex
+
+    flow = attr(*args, **kwargs) if inspect.isfunction(attr) else attr
+    if isinstance(flow, Dataflow):
+        return flow
+    msg = (
+        "a valid dataflow was not obtained from "
+        f"'{module.__name__}:{dataflow_name}'"
+    )
+    raise RuntimeError(msg)
+
+
+class _EnvDefault(argparse.Action):
+    """argparse action falling back to an environment variable."""
+
+    def __init__(self, envvar, required=False, default=None, **kwargs):
+        if envvar and envvar in os.environ:
+            default = os.environ[envvar]
+            if kwargs.get("type") is not None and isinstance(default, str):
+                default = kwargs["type"](default)
+            required = False
+        super().__init__(default=default, required=required, **kwargs)
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        setattr(namespace, self.dest, values)
+
+
+def _parse_timedelta(s: str) -> timedelta:
+    return timedelta(seconds=float(s))
+
+
+def _create_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m bytewax_tpu.run",
+        description="Run a bytewax_tpu dataflow",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    parser.add_argument(
+        "import_str",
+        type=str,
+        help="Dataflow import string: <module>[:<variable_or_factory>], "
+        "e.g. src.flow, src.flow:flow, or src.flow:get_flow('arg')",
+    )
+    scaling = parser.add_argument_group(
+        "Scaling",
+        "How many workers (logical key-shard lanes) to run",
+    )
+    scaling.add_argument(
+        "-w",
+        "--workers-per-process",
+        type=int,
+        default=None,
+        help="Number of worker lanes for this process",
+        action=_EnvDefault,
+        envvar="BYTEWAX_WORKERS_PER_PROCESS",
+    )
+    scaling.add_argument(
+        "-i",
+        "--process-id",
+        type=int,
+        default=None,
+        help="Process id in the cluster",
+        action=_EnvDefault,
+        envvar="BYTEWAX_PROCESS_ID",
+    )
+    scaling.add_argument(
+        "-a",
+        "--addresses",
+        type=str,
+        default=None,
+        help="Addresses of all processes, separated by ';'",
+        action=_EnvDefault,
+        envvar="BYTEWAX_ADDRESSES",
+    )
+    recovery = parser.add_argument_group(
+        "Recovery", "See the bytewax_tpu.recovery module for more info"
+    )
+    recovery.add_argument(
+        "-r",
+        "--recovery-directory",
+        type=Path,
+        help="Directory of pre-initialized recovery partitions "
+        "(see `python -m bytewax_tpu.recovery`)",
+        action=_EnvDefault,
+        envvar="BYTEWAX_RECOVERY_DIRECTORY",
+    )
+    recovery.add_argument(
+        "-s",
+        "--snapshot-interval",
+        type=_parse_timedelta,
+        help="System time duration in seconds between state snapshots "
+        "(the epoch interval)",
+        action=_EnvDefault,
+        envvar="BYTEWAX_SNAPSHOT_INTERVAL",
+    )
+    recovery.add_argument(
+        "-b",
+        "--backup-interval",
+        type=_parse_timedelta,
+        help="System time duration in seconds to keep superseded "
+        "snapshots around; set to your backup cadence",
+        action=_EnvDefault,
+        envvar="BYTEWAX_RECOVERY_BACKUP_INTERVAL",
+    )
+    return parser
+
+
+def _parse_args(argv=None) -> argparse.Namespace:
+    parser = _create_arg_parser()
+    args = parser.parse_args(argv)
+
+    env = os.environ
+    # k8s/helm conventions: pod ordinal becomes the process id, and a
+    # hostfile provides the address list.
+    if args.process_id is None:
+        if "BYTEWAX_POD_NAME" in env and "BYTEWAX_STATEFULSET_NAME" in env:
+            args.process_id = int(
+                env["BYTEWAX_POD_NAME"].replace(
+                    env["BYTEWAX_STATEFULSET_NAME"] + "-", ""
+                )
+            )
+    if args.process_id is not None and args.addresses is None:
+        if "BYTEWAX_HOSTFILE_PATH" in env:
+            with open(env["BYTEWAX_HOSTFILE_PATH"]) as hostfile:
+                args.addresses = ";".join(
+                    addr.strip() for addr in hostfile if addr.strip()
+                )
+        else:
+            parser.error("the addresses option is required if a process_id is passed")
+
+    if args.recovery_directory is not None and (
+        args.snapshot_interval is None or args.backup_interval is None
+    ):
+        parser.error(
+            "when running with recovery, the `-s/--snapshot-interval` and "
+            "`-b/--backup-interval` values must be set"
+        )
+    return args
+
+
+def cli_main(
+    flow,
+    *,
+    workers_per_process: Optional[int] = None,
+    process_id: Optional[int] = None,
+    addresses: Optional[str] = None,
+    epoch_interval: Optional[timedelta] = None,
+    recovery_config: Optional[Any] = None,
+) -> None:
+    """Dispatch to ``run_main`` or ``cluster_main`` based on args."""
+    if process_id is not None or (workers_per_process or 0) > 1 or addresses:
+        addr_list = addresses.split(";") if addresses else []
+        cluster_main(
+            flow,
+            addr_list,
+            process_id or 0,
+            epoch_interval=epoch_interval,
+            recovery_config=recovery_config,
+            worker_count_per_proc=workers_per_process or 1,
+        )
+    else:
+        run_main(
+            flow,
+            epoch_interval=epoch_interval,
+            recovery_config=recovery_config,
+        )
+
+
+def _main() -> None:
+    args = _parse_args()
+    module_str, dataflow_name = _prepare_import(args.import_str)
+    flow = _locate_dataflow(module_str, dataflow_name)
+    recovery_config = None
+    if args.recovery_directory is not None:
+        recovery_config = RecoveryConfig(
+            args.recovery_directory, backup_interval=args.backup_interval
+        )
+    cli_main(
+        flow,
+        workers_per_process=args.workers_per_process,
+        process_id=args.process_id,
+        addresses=args.addresses,
+        epoch_interval=args.snapshot_interval,
+        recovery_config=recovery_config,
+    )
+
+
+if __name__ == "__main__":
+    _main()

@@ -1,0 +1,180 @@
+"""Render a dataflow graph as JSON or Mermaid.
+
+Reference parity: ``/root/reference/pysrc/bytewax/visualize.py``.
+Used by the dataflow webserver's ``GET /dataflow``.
+
+```console
+$ python -m bytewax_tpu.visualize my_flow:flow --format mermaid
+```
+"""
+
+import argparse
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List
+
+from bytewax_tpu.dataflow import Dataflow, Operator, Stream
+
+__all__ = [
+    "RenderedDataflow",
+    "RenderedOperator",
+    "RenderedPort",
+    "render_dataflow",
+    "to_json",
+    "to_mermaid",
+    "to_plan",
+]
+
+
+@dataclass(frozen=True)
+class RenderedPort:
+    """A port and the stream ids wired into/out of it."""
+
+    port_name: str
+    port_id: str
+    from_port_ids: List[str]
+    from_stream_ids: List[str]
+
+
+@dataclass(frozen=True)
+class RenderedOperator:
+    """One operator node in the rendered tree."""
+
+    op_type: str
+    step_name: str
+    step_id: str
+    inp_ports: List[RenderedPort]
+    out_ports: List[RenderedPort]
+    substeps: List["RenderedOperator"]
+
+
+@dataclass(frozen=True)
+class RenderedDataflow:
+    """Renderable facsimile of a dataflow."""
+
+    flow_id: str
+    substeps: List[RenderedOperator]
+
+
+def _render_op(op: Operator) -> RenderedOperator:
+    inp_ports = []
+    for name, val in op.ups.items():
+        streams = [val] if isinstance(val, Stream) else list(val)
+        inp_ports.append(
+            RenderedPort(
+                port_name=name,
+                port_id=f"{op.step_id}.{name}",
+                from_port_ids=[s.stream_id for s in streams],
+                from_stream_ids=[s.stream_id for s in streams],
+            )
+        )
+    out_ports = [
+        RenderedPort(
+            port_name=name,
+            port_id=s.stream_id,
+            from_port_ids=[],
+            from_stream_ids=[],
+        )
+        for name, s in op.downs.items()
+    ]
+    return RenderedOperator(
+        op_type=op.name,
+        step_name=op.step_name,
+        step_id=op.step_id,
+        inp_ports=inp_ports,
+        out_ports=out_ports,
+        substeps=[_render_op(sub) for sub in op.substeps],
+    )
+
+
+def render_dataflow(flow: Dataflow) -> RenderedDataflow:
+    """Convert a dataflow into the renderable tree."""
+    return RenderedDataflow(
+        flow_id=flow.flow_id,
+        substeps=[_render_op(op) for op in flow.substeps],
+    )
+
+
+def to_json(flow: Dataflow) -> str:
+    """Render a dataflow as JSON (served by ``GET /dataflow``)."""
+    return json.dumps(asdict(render_dataflow(flow)), indent=2)
+
+
+def to_mermaid(flow: Dataflow) -> str:
+    """Render the top level of a dataflow as a Mermaid graph."""
+    rendered = render_dataflow(flow)
+    top_ids = [op.step_id for op in rendered.substeps]
+
+    def owner_of(stream_id: str) -> str:
+        # A stream produced by a nested substep belongs to the
+        # top-level operator whose id is a dotted prefix of it.
+        for step_id in top_ids:
+            if stream_id == step_id or stream_id.startswith(step_id + "."):
+                return step_id
+        return stream_id.rsplit(".", 1)[0]
+
+    lines = ["flowchart TD", f'subgraph "{rendered.flow_id} (Dataflow)"']
+    for op in rendered.substeps:
+        lines.append(f'{op.step_id}["{op.op_type} ({op.step_id})"]')
+        for port in op.inp_ports:
+            for sid in port.from_stream_ids:
+                lines.append(f"{owner_of(sid)} --> {op.step_id}")
+    lines.append("end")
+    return "\n".join(lines)
+
+
+def to_plan(flow: Dataflow) -> Dict[str, Any]:
+    """Render the flattened core-operator plan (engine's view),
+    including XLA-tier lowering annotations."""
+    from bytewax_tpu.engine.flatten import flatten
+
+    plan = flatten(flow)
+    return {
+        "flow_id": flow.flow_id,
+        "core_ops": [
+            {
+                "step_id": op.step_id,
+                "op_type": op.name,
+                "ups": {
+                    name: [
+                        s.stream_id
+                        for s in ([v] if isinstance(v, Stream) else v)
+                    ]
+                    for name, v in op.ups.items()
+                },
+                "downs": {
+                    name: s.stream_id for name, s in op.downs.items()
+                },
+                "accel": repr(op.conf["_accel"]) if "_accel" in op.conf else None,
+            }
+            for op in plan.ops
+        ],
+    }
+
+
+def _main() -> None:
+    from bytewax_tpu.run import _locate_dataflow, _prepare_import
+
+    parser = argparse.ArgumentParser(
+        prog="python -m bytewax_tpu.visualize",
+        description="Render a dataflow graph",
+    )
+    parser.add_argument("import_str", type=str)
+    parser.add_argument(
+        "--format",
+        choices=["json", "mermaid", "plan"],
+        default="mermaid",
+    )
+    args = parser.parse_args()
+    module_str, dataflow_name = _prepare_import(args.import_str)
+    flow = _locate_dataflow(module_str, dataflow_name)
+    if args.format == "json":
+        print(to_json(flow))
+    elif args.format == "plan":
+        print(json.dumps(to_plan(flow), indent=2))
+    else:
+        print(to_mermaid(flow))
+
+
+if __name__ == "__main__":
+    _main()

@@ -1,0 +1,9 @@
+"""Word count over a text file (reference: examples/wordcount.py)."""
+
+from bytewax_tpu.connectors.files import FileSource
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.models.wordcount import wordcount_flow
+
+flow = wordcount_flow(
+    FileSource("examples/sample_data/wordcount.txt"), StdOutSink()
+)
