@@ -1,0 +1,75 @@
+"""Engine + custom metrics via Prometheus.
+
+Metric-name parity with the reference
+(``/root/reference/src/metrics/mod.rs``, ``src/operators.rs:154-167``):
+``item_inp_count`` / ``item_out_count`` counters labeled
+``{step_id, worker_index}`` and ``*_duration_seconds`` histograms with
+the same explicit buckets.  User dataflows can register their own
+metrics on the default ``prometheus_client`` registry; the dataflow
+API server exposes everything at ``GET /metrics``.
+"""
+
+from typing import Dict, Tuple
+
+from prometheus_client import REGISTRY, Counter, Histogram
+from prometheus_client.exposition import generate_latest
+
+__all__ = [
+    "DURATION_BUCKETS",
+    "generate_python_metrics",
+    "item_inp_count",
+    "item_out_count",
+    "snapshot_duration",
+    "step_duration",
+]
+
+#: Explicit histogram buckets, matching the reference
+#: (``src/metrics/mod.rs:37-41``).
+DURATION_BUCKETS = (
+    0.0005,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.075,
+    0.1,
+    0.25,
+    0.5,
+    0.75,
+    1.0,
+    2.5,
+    5.0,
+    7.5,
+    10.0,
+)
+
+item_inp_count = Counter(
+    "bytewax_item_inp_count",
+    "Number of items routed into a step",
+    ["step_id", "worker_index"],
+)
+
+item_out_count = Counter(
+    "bytewax_item_out_count",
+    "Number of items emitted by a step",
+    ["step_id", "worker_index"],
+)
+
+step_duration = Histogram(
+    "bytewax_step_duration_seconds",
+    "Time spent running user code in a step",
+    ["step_id"],
+    buckets=DURATION_BUCKETS,
+)
+
+snapshot_duration = Histogram(
+    "bytewax_snapshot_duration_seconds",
+    "Time spent snapshotting state at epoch close",
+    ["step_id"],
+    buckets=DURATION_BUCKETS,
+)
+
+
+def generate_python_metrics() -> str:
+    """Generate Prometheus text exposition for the Python registry."""
+    return generate_latest(REGISTRY).decode("utf-8")

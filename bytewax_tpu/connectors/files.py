@@ -139,7 +139,13 @@ class _CSVPartition(StatefulSourcePartition[Dict[str, str], int]):
         # need mid-file.
         lines = iter(self._f.readline, "")
         # The header is always re-read so field names survive resume.
-        header_reader = csv.reader(lines, **fmtparams)
+        # csv.reader rejects DictReader-only kwargs.
+        reader_params = {
+            k: v
+            for k, v in fmtparams.items()
+            if k not in ("restkey", "restval")
+        }
+        header_reader = csv.reader(lines, **reader_params)
         self._fields = next(header_reader)
         if resume_state is not None:
             self._f.seek(resume_state)

@@ -1,0 +1,402 @@
+"""Connectors for Kafka.
+
+API parity with the reference
+(``/root/reference/pysrc/bytewax/connectors/kafka/__init__.py``);
+implementation is our own.  Importing this module works without
+``confluent_kafka`` installed (message dataclasses and serde
+interfaces are pure Python); constructing a source/sink without the
+library raises a clear error.
+
+Use :class:`KafkaSource`/:class:`KafkaSink` directly for raw bytes, or
+the operator namespace in :mod:`bytewax_tpu.connectors.kafka.operators`
+for error-split streams and (de)serialization.
+"""
+
+import json
+from dataclasses import dataclass, field
+from typing import (
+    Dict,
+    Generic,
+    Iterable,
+    List,
+    Optional,
+    Tuple,
+    TypeVar,
+    Union,
+)
+
+from prometheus_client import Gauge
+
+from bytewax_tpu.inputs import FixedPartitionedSource, StatefulSourcePartition
+from bytewax_tpu.outputs import DynamicSink, StatelessSinkPartition
+
+K = TypeVar("K")
+V = TypeVar("V")
+K2 = TypeVar("K2")
+V2 = TypeVar("V2")
+
+__all__ = [
+    "KafkaError",
+    "KafkaSink",
+    "KafkaSinkMessage",
+    "KafkaSource",
+    "KafkaSourceMessage",
+]
+
+#: Start from the beginning of the topic (mirror of
+#: ``confluent_kafka.OFFSET_BEGINNING``).
+OFFSET_BEGINNING = -2
+#: Start from the end of the topic.
+OFFSET_END = -1
+
+_CONSUMER_LAG_GAUGE = Gauge(
+    "bytewax_kafka_consumer_lag",
+    "Difference between last offset on the broker and the current consumed offset",
+    ["step_id", "topic", "partition"],
+)
+
+
+def _require_confluent():
+    try:
+        import confluent_kafka  # noqa: F401
+
+        return confluent_kafka
+    except ImportError as ex:
+        msg = (
+            "Kafka connectors require the `confluent_kafka` package; "
+            "pip install bytewax-tpu[kafka]"
+        )
+        raise ImportError(msg) from ex
+
+
+@dataclass(frozen=True)
+class KafkaSourceMessage(Generic[K, V]):
+    """Message read from Kafka."""
+
+    key: K
+    value: V
+    topic: Optional[str] = field(default=None)
+    headers: List[Tuple[str, bytes]] = field(default_factory=list)
+    latency: Optional[float] = field(default=None)
+    offset: Optional[int] = field(default=None)
+    partition: Optional[int] = field(default=None)
+    timestamp: Optional[Tuple[int, int]] = field(default=None)
+
+    def to_sink(self) -> "KafkaSinkMessage[K, V]":
+        """Convert to a sink message, keeping key, value, topic,
+        headers."""
+        return KafkaSinkMessage(
+            key=self.key,
+            value=self.value,
+            topic=self.topic,
+            headers=self.headers,
+        )
+
+    def _with_key(self, key: K2) -> "KafkaSourceMessage[K2, V]":
+        return KafkaSourceMessage(
+            key=key,
+            value=self.value,
+            topic=self.topic,
+            headers=self.headers,
+            latency=self.latency,
+            offset=self.offset,
+            partition=self.partition,
+            timestamp=self.timestamp,
+        )
+
+    def _with_value(self, value: V2) -> "KafkaSourceMessage[K, V2]":
+        return KafkaSourceMessage(
+            key=self.key,
+            value=value,
+            topic=self.topic,
+            headers=self.headers,
+            latency=self.latency,
+            offset=self.offset,
+            partition=self.partition,
+            timestamp=self.timestamp,
+        )
+
+    def _with_key_and_value(
+        self, key: K2, value: V2
+    ) -> "KafkaSourceMessage[K2, V2]":
+        return self._with_key(key)._with_value(value)
+
+
+@dataclass(frozen=True)
+class KafkaError(Generic[K, V]):
+    """Error from a :class:`KafkaSource`."""
+
+    error: object
+    """Underlying `confluent_kafka.KafkaError`."""
+
+    msg: KafkaSourceMessage[K, V]
+    """Message attached to that error."""
+
+
+@dataclass(frozen=True)
+class KafkaSinkMessage(Generic[K, V]):
+    """Message to be written to Kafka."""
+
+    key: K
+    value: V
+    topic: Optional[str] = None
+    headers: List[Tuple[str, bytes]] = field(default_factory=list)
+    partition: Optional[int] = None
+    timestamp: int = 0
+
+    def _with_key(self, key: K2) -> "KafkaSinkMessage[K2, V]":
+        return KafkaSinkMessage(
+            key=key,
+            value=self.value,
+            topic=self.topic,
+            headers=self.headers,
+            partition=self.partition,
+            timestamp=self.timestamp,
+        )
+
+    def _with_value(self, value: V2) -> "KafkaSinkMessage[K, V2]":
+        return KafkaSinkMessage(
+            key=self.key,
+            value=value,
+            topic=self.topic,
+            headers=self.headers,
+            partition=self.partition,
+            timestamp=self.timestamp,
+        )
+
+    def _with_key_and_value(
+        self, key: K2, value: V2
+    ) -> "KafkaSinkMessage[K2, V2]":
+        return self._with_key(key)._with_value(value)
+
+
+_RawSourceItem = Union[
+    KafkaSourceMessage[Optional[bytes], Optional[bytes]],
+    KafkaError[Optional[bytes], Optional[bytes]],
+]
+
+
+class _KafkaSourcePartition(
+    StatefulSourcePartition[_RawSourceItem, Optional[int]]
+):
+    def __init__(
+        self,
+        step_id: str,
+        config: dict,
+        topic: str,
+        part_idx: int,
+        starting_offset: int,
+        resume_state: Optional[int],
+        batch_size: int,
+        raise_on_errors: bool,
+    ):
+        ck = _require_confluent()
+        self._offset = starting_offset if resume_state is None else resume_state
+        config.update({"stats_cb": self._process_stats})
+        consumer = ck.Consumer(config)
+        # assign (not subscribe): the recovery system is the consumer
+        # group; offsets resume from our snapshots.
+        consumer.assign([ck.TopicPartition(topic, part_idx, self._offset)])
+        self._consumer = consumer
+        self._topic = topic
+        self._part_idx = part_idx
+        self._batch_size = batch_size
+        self._eof = False
+        self._raise_on_errors = raise_on_errors
+        self._partition_eof_code = ck.KafkaError._PARTITION_EOF
+        self._lag_gauge = _CONSUMER_LAG_GAUGE.labels(
+            step_id, topic, str(part_idx)
+        )
+
+    def _process_stats(self, json_stats: str) -> None:
+        stats = json.loads(json_stats)
+        part = (
+            stats.get("topics", {})
+            .get(self._topic, {})
+            .get("partitions", {})
+            .get(str(self._part_idx))
+        )
+        if part is not None and self._offset > 0:
+            self._lag_gauge.set(part["ls_offset"] - self._offset)
+
+    def next_batch(self) -> List[_RawSourceItem]:
+        if self._eof:
+            raise StopIteration()
+        msgs = self._consumer.consume(self._batch_size, 0.001)
+        batch: List[_RawSourceItem] = []
+        last_offset = None
+        for msg in msgs:
+            error = msg.error()
+            if error is not None:
+                if error.code() == self._partition_eof_code:
+                    # Emit this batch first; EOF on the next poll.
+                    self._eof = True
+                    break
+                if self._raise_on_errors:
+                    err = (
+                        f"error consuming from Kafka topic "
+                        f"{self._topic!r}: {error}"
+                    )
+                    raise RuntimeError(err)
+            kafka_msg = KafkaSourceMessage(
+                key=msg.key(),
+                value=msg.value(),
+                topic=msg.topic(),
+                headers=msg.headers() or [],
+                latency=msg.latency(),
+                offset=msg.offset(),
+                partition=msg.partition(),
+                timestamp=msg.timestamp(),
+            )
+            if error is None:
+                batch.append(kafka_msg)
+            else:
+                batch.append(KafkaError(error, kafka_msg))
+            last_offset = msg.offset()
+        if last_offset is not None:
+            # Resume from the message after the last one read.
+            self._offset = last_offset + 1
+        return batch
+
+    def snapshot(self) -> Optional[int]:
+        return self._offset
+
+    def close(self) -> None:
+        self._consumer.close()
+
+
+class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
+    """Use a set of Kafka topics as an input source.
+
+    Kafka partitions are the unit of parallelism; offsets are
+    snapshotted into the recovery system (exactly-once capable).
+    Messages enter the dataflow as :class:`KafkaSourceMessage` (or
+    :class:`KafkaError` when ``raise_on_errors=False``).
+    """
+
+    def __init__(
+        self,
+        brokers: Iterable[str],
+        topics: Iterable[str],
+        tail: bool = True,
+        starting_offset: int = OFFSET_BEGINNING,
+        add_config: Optional[Dict[str, str]] = None,
+        batch_size: int = 1000,
+        raise_on_errors: bool = True,
+    ):
+        if isinstance(brokers, str):
+            msg = "brokers must be an iterable and not a string"
+            raise TypeError(msg)
+        if isinstance(topics, str):
+            msg = "topics must be an iterable and not a string"
+            raise TypeError(msg)
+        _require_confluent()
+        self._brokers = brokers
+        self._topics = topics
+        self._tail = tail
+        self._starting_offset = starting_offset
+        self._add_config = dict(add_config or {})
+        self._batch_size = batch_size
+        self._raise_on_errors = raise_on_errors
+
+    def list_parts(self) -> List[str]:
+        """Each Kafka partition of each topic is an input partition."""
+        from confluent_kafka.admin import AdminClient
+
+        config = {"bootstrap.servers": ",".join(self._brokers)}
+        config.update(self._add_config)
+        client = AdminClient(config)
+        client.poll(0)  # start auth callbacks
+        parts = []
+        cluster_meta = client.list_topics()
+        for topic in self._topics:
+            topic_meta = cluster_meta.topics.get(topic)
+            if topic_meta is None or not topic_meta.partitions:
+                msg = f"no partitions for topic {topic!r}"
+                raise RuntimeError(msg)
+            for i in topic_meta.partitions.keys():
+                parts.append(f"{i}-{topic}")
+        return parts
+
+    def build_part(
+        self, step_id: str, for_part: str, resume_state: Optional[int]
+    ) -> _KafkaSourcePartition:
+        idx, topic = for_part.split("-", 1)
+        if topic not in self._topics:
+            msg = "can't resume from a different set of Kafka topics"
+            raise ValueError(msg)
+        config = {
+            # The recovery system is the consumer group.
+            "group.id": "BYTEWAX_IGNORED",
+            "enable.auto.commit": "false",
+            "bootstrap.servers": ",".join(self._brokers),
+            "enable.partition.eof": str(not self._tail),
+            "statistics.interval.ms": 1000,
+        }
+        config.update(self._add_config)
+        return _KafkaSourcePartition(
+            step_id,
+            config,
+            topic,
+            int(idx),
+            self._starting_offset,
+            resume_state,
+            self._batch_size,
+            self._raise_on_errors,
+        )
+
+
+class _KafkaSinkPartition(
+    StatelessSinkPartition[KafkaSinkMessage[Optional[bytes], Optional[bytes]]]
+):
+    def __init__(self, producer, topic: Optional[str]):
+        self._producer = producer
+        self._topic = topic
+
+    def write_batch(
+        self, items: List[KafkaSinkMessage[Optional[bytes], Optional[bytes]]]
+    ) -> None:
+        for item in items:
+            topic = item.topic if item.topic is not None else self._topic
+            if topic is None:
+                msg = f"no topic to produce to for {item}"
+                raise RuntimeError(msg)
+            self._producer.produce(
+                topic,
+                item.value,
+                item.key,
+                headers=item.headers,
+            )
+            self._producer.poll(0)
+        self._producer.flush()
+
+    def close(self) -> None:
+        self._producer.flush()
+
+
+class KafkaSink(
+    DynamicSink[KafkaSinkMessage[Optional[bytes], Optional[bytes]]]
+):
+    """Use a single Kafka topic as an output sink; workers are the
+    unit of parallelism.  At-least-once: messages from the resume
+    epoch are duplicated right after resume."""
+
+    def __init__(
+        self,
+        brokers: Iterable[str],
+        topic: Optional[str],
+        add_config: Optional[Dict[str, str]] = None,
+    ):
+        _require_confluent()
+        self._brokers = brokers
+        self._topic = topic
+        self._add_config = dict(add_config or {})
+
+    def build(
+        self, step_id: str, worker_index: int, worker_count: int
+    ) -> _KafkaSinkPartition:
+        from confluent_kafka import Producer
+
+        config = {"bootstrap.servers": ",".join(self._brokers)}
+        config.update(self._add_config)
+        return _KafkaSinkPartition(Producer(config), self._topic)

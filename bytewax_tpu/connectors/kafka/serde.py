@@ -1,0 +1,104 @@
+"""Serializers and deserializers for Kafka messages.
+
+API parity with the reference
+(``/root/reference/pysrc/bytewax/connectors/kafka/serde.py``).  The
+Avro implementations require the ``fastavro`` package; the abstract
+interfaces are dependency-free.
+"""
+
+import io
+from abc import ABC, abstractmethod
+from typing import Any, Generic, TypeVar
+
+In = TypeVar("In")
+Out = TypeVar("Out")
+
+__all__ = [
+    "Deserializer",
+    "PlainAvroDeserializer",
+    "PlainAvroSerializer",
+    "SchemaDeserializer",
+    "SchemaSerializer",
+    "Serializer",
+]
+
+
+class SchemaSerializer(ABC, Generic[In, Out]):
+    """Serialize a value using a schema."""
+
+    @abstractmethod
+    def ser(self, obj: In) -> Out:
+        """Serialize the object."""
+        ...
+
+
+class SchemaDeserializer(ABC, Generic[In, Out]):
+    """Deserialize a value using a schema."""
+
+    @abstractmethod
+    def de(self, data: In) -> Out:
+        """Deserialize the data."""
+        ...
+
+
+class Serializer(SchemaSerializer[Any, bytes]):
+    """Serialize any object to bytes."""
+
+
+class Deserializer(SchemaDeserializer[bytes, Any]):
+    """Deserialize bytes to an object."""
+
+
+def _require_fastavro():
+    try:
+        import fastavro
+
+        return fastavro
+    except ImportError as ex:
+        msg = (
+            "Avro serde requires the `fastavro` package; install it to "
+            "use PlainAvroSerializer/PlainAvroDeserializer"
+        )
+        raise ImportError(msg) from ex
+
+
+class PlainAvroSerializer(Serializer):
+    """Serialize with plain Avro binary encoding (no schema-registry
+    framing; use the Confluent serializers for wire-format messages)."""
+
+    def __init__(self, schema: Any):
+        fastavro = _require_fastavro()
+        self._schema = fastavro.parse_schema(
+            schema if isinstance(schema, dict) else _load_schema(schema)
+        )
+        self._fastavro = fastavro
+
+    def ser(self, obj: Any) -> bytes:
+        buf = io.BytesIO()
+        self._fastavro.schemaless_writer(buf, self._schema, obj)
+        return buf.getvalue()
+
+
+class PlainAvroDeserializer(Deserializer):
+    """Deserialize plain Avro binary data (no schema-registry
+    framing)."""
+
+    def __init__(self, schema: Any):
+        fastavro = _require_fastavro()
+        self._schema = fastavro.parse_schema(
+            schema if isinstance(schema, dict) else _load_schema(schema)
+        )
+        self._fastavro = fastavro
+
+    def de(self, data: bytes) -> Any:
+        buf = io.BytesIO(data)
+        return self._fastavro.schemaless_reader(buf, self._schema)
+
+
+def _load_schema(schema: Any) -> dict:
+    import json
+
+    if isinstance(schema, str):
+        return json.loads(schema)
+    msg = f"unsupported schema type {type(schema)!r}"
+    raise TypeError(msg)

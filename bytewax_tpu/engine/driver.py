@@ -104,6 +104,28 @@ class _OpRt:
         self.queues: Dict[str, List[Entry]] = {
             port: [] for port in op.ups.keys()
         }
+        # Per-worker cached Prometheus counter children (metric-name
+        # parity with the reference: src/operators.rs:154-167).
+        self._m_inp: Dict[int, Any] = {}
+        self._m_out: Dict[int, Any] = {}
+
+    def _count_inp(self, w: int, n: int) -> None:
+        c = self._m_inp.get(w)
+        if c is None:
+            from bytewax_tpu._metrics import item_inp_count
+
+            c = item_inp_count.labels(self.op.step_id, str(w))
+            self._m_inp[w] = c
+        c.inc(n)
+
+    def _count_out(self, w: int, n: int) -> None:
+        c = self._m_out.get(w)
+        if c is None:
+            from bytewax_tpu._metrics import item_out_count
+
+            c = item_out_count.labels(self.op.step_id, str(w))
+            self._m_out[w] = c
+        c.inc(n)
 
     def queued(self) -> bool:
         return any(q for q in self.queues.values())
@@ -119,6 +141,8 @@ class _OpRt:
         for port, q in self.queues.items():
             if q:
                 entries, self.queues[port] = q, []
+                for w, items in entries:
+                    self._count_inp(w, len(items))
                 self.process(port, entries)
 
     def process(self, port: str, entries: List[Entry]) -> None:
@@ -131,8 +155,9 @@ class _OpRt:
         """All upstreams are EOF and queues are drained."""
 
     def emit(self, port: str, entry: Entry) -> None:
-        if not entry[1]:
+        if not len(entry[1]):
             return
+        self._count_out(entry[0], len(entry[1]))
         stream = self.op.downs[port]
         self.driver.route(stream.stream_id, entry)
 
@@ -529,7 +554,10 @@ class _OutputRt(_OpRt):
         self.pending_snaps: List[Tuple[str, Any]] = []
         if isinstance(sink, FixedPartitionedSink):
             self.stateful = True
-            self.part_names = sorted(set(sink.list_parts()))
+            # Keep the sink's declared order (dedup only): part_fn
+            # indexes into this list, so sorting would break the
+            # assign_file -> file_namer correspondence for >=10 parts.
+            self.part_names = list(dict.fromkeys(sink.list_parts()))
             if not self.part_names:
                 msg = f"sink of step {op.step_id!r} has no partitions"
                 raise ValueError(msg)
@@ -738,6 +766,10 @@ class _Driver:
         interval_s = self.epoch_interval.total_seconds()
         aborted = False
 
+        from bytewax_tpu.engine.webserver import maybe_start_server
+
+        api_server = maybe_start_server(self.plan.flow)
+
         try:
             while True:
                 self._progressed = False
@@ -793,6 +825,8 @@ class _Driver:
         except _Abort:
             aborted = True
         finally:
+            if api_server is not None:
+                api_server.shutdown()
             if self.store is not None:
                 self.store.close()
 

@@ -1,0 +1,84 @@
+"""Dataflow API webserver.
+
+Reference parity (``/root/reference/src/webserver/mod.rs``): when
+``BYTEWAX_DATAFLOW_API_ENABLED`` is set, the engine serves
+
+- ``GET /dataflow`` — the graph rendered as JSON (also dumped to
+  ``dataflow.json`` on startup, like the reference), and
+- ``GET /metrics`` — Prometheus text exposition (engine + user
+  metrics share one Python registry here, so no merge step is
+  needed).
+
+Port comes from ``BYTEWAX_DATAFLOW_API_PORT`` (default 3030).
+"""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+__all__ = ["maybe_start_server"]
+
+_DEFAULT_PORT = 3030
+
+
+class _Handler(BaseHTTPRequestHandler):
+    flow_json: str = "{}"
+
+    def do_GET(self) -> None:  # noqa: N802
+        if self.path == "/dataflow":
+            body = self.flow_json.encode()
+            ctype = "application/json"
+        elif self.path == "/metrics":
+            from bytewax_tpu._metrics import generate_python_metrics
+
+            body = generate_python_metrics().encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args) -> None:  # silence request logs
+        pass
+
+
+class _ApiServer:
+    def __init__(self, server: ThreadingHTTPServer, thread: threading.Thread):
+        self._server = server
+        self._thread = thread
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._thread.join(timeout=5)
+        self._server.server_close()
+
+
+def maybe_start_server(flow) -> Optional[_ApiServer]:
+    """Start the API server if ``BYTEWAX_DATAFLOW_API_ENABLED`` is
+    set; returns a handle to shut it down, else ``None``."""
+    if not os.environ.get("BYTEWAX_DATAFLOW_API_ENABLED"):
+        return None
+    from bytewax_tpu.visualize import to_json
+
+    flow_json = to_json(flow)
+    # Reference also dumps the graph to disk at startup
+    # (src/run.rs:36-57).
+    try:
+        with open("dataflow.json", "w") as f:
+            f.write(flow_json)
+    except OSError:
+        pass
+
+    port = int(os.environ.get("BYTEWAX_DATAFLOW_API_PORT", _DEFAULT_PORT))
+    handler = type("_BoundHandler", (_Handler,), {"flow_json": flow_json})
+    server = ThreadingHTTPServer(("0.0.0.0", port), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return _ApiServer(server, thread)

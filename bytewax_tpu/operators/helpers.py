@@ -1,0 +1,34 @@
+"""Helper functions for using operators.
+
+Reference parity: ``/root/reference/pysrc/bytewax/operators/helpers.py``.
+"""
+
+from typing import Callable, Dict, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+__all__ = ["map_dict_value"]
+
+
+def map_dict_value(
+    key: K, mapper: Callable[[V], V]
+) -> Callable[[Dict[K, V]], Dict[K, V]]:
+    """Build a mapper that transforms one value in a dict item,
+    leaving the rest untouched (a simple lens).
+
+    >>> mapper = map_dict_value("name", str.upper)
+    >>> mapper({"name": "ada", "id": 1})
+    {'name': 'ADA', 'id': 1}
+
+    :arg key: Dictionary key.
+    :arg mapper: Function to run on the value for that key.
+    :returns: A function suitable for
+        :func:`bytewax_tpu.operators.map`.
+    """
+
+    def shim_mapper(obj: Dict[K, V]) -> Dict[K, V]:
+        obj[key] = mapper(obj[key])
+        return obj
+
+    return shim_mapper

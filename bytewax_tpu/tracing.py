@@ -1,0 +1,151 @@
+"""Logging and tracing configuration.
+
+Surface parity with the reference (``/root/reference/src/tracing/``):
+``setup_tracing(tracing_config, log_level)`` returns a guard that
+keeps exporters alive.  The default backend logs spans via
+:mod:`logging`; :class:`OtlpTracingConfig` / :class:`JaegerConfig`
+export via the ``opentelemetry`` SDK when it is installed (it is an
+optional dependency — configuring an exporting backend without it
+raises at setup, never at import).
+"""
+
+import contextlib
+import logging
+import time
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+__all__ = [
+    "BytewaxTracer",
+    "JaegerConfig",
+    "OtlpTracingConfig",
+    "TracingConfig",
+    "setup_tracing",
+    "span",
+]
+
+logger = logging.getLogger("bytewax_tpu")
+
+
+@dataclass
+class TracingConfig:
+    """Base config class for tracing backends; logs spans locally."""
+
+
+@dataclass
+class OtlpTracingConfig(TracingConfig):
+    """Send traces to an OTLP-over-gRPC collector.
+
+    :arg service_name: Service name to report.
+    :arg url: Collector endpoint; defaults to grpc://127.0.0.1:4317.
+    :arg sampling_ratio: Fraction of traces to sample, 0.0..1.0.
+    """
+
+    service_name: str
+    url: str = "grpc://127.0.0.1:4317"
+    sampling_ratio: float = 1.0
+
+
+@dataclass
+class JaegerConfig(TracingConfig):
+    """Send traces to a Jaeger agent.
+
+    :arg service_name: Service name to report.
+    :arg endpoint: Agent address; defaults to 127.0.0.1:6831.
+    :arg sampling_ratio: Fraction of traces to sample, 0.0..1.0.
+    """
+
+    service_name: str
+    endpoint: str = "127.0.0.1:6831"
+    sampling_ratio: float = 1.0
+
+
+class BytewaxTracer:
+    """Guard returned by :func:`setup_tracing`; keeps the exporter
+    alive until dropped."""
+
+    def __init__(self, config: Optional[TracingConfig], provider=None):
+        self._config = config
+        self._provider = provider
+
+    def shutdown(self) -> None:
+        if self._provider is not None:
+            self._provider.shutdown()
+            self._provider = None
+
+
+_tracer: Optional[BytewaxTracer] = None
+
+
+def setup_tracing(
+    tracing_config: Optional[TracingConfig] = None,
+    log_level: Optional[str] = None,
+) -> BytewaxTracer:
+    """Set up logging and tracing; call once, keep the returned guard
+    alive for the duration of the dataflow.
+
+    :arg tracing_config: Backend config; ``None`` logs locally.
+    :arg log_level: One of DEBUG/INFO/WARN/ERROR; defaults to ERROR
+        (reference default: ``src/tracing/mod.rs``).
+    """
+    global _tracer
+    level = getattr(logging, (log_level or "ERROR").upper(), logging.ERROR)
+    logging.basicConfig()
+    logger.setLevel(level)
+
+    provider = None
+    if isinstance(tracing_config, (OtlpTracingConfig, JaegerConfig)):
+        try:
+            from opentelemetry import trace as ot_trace
+            from opentelemetry.sdk.resources import Resource
+            from opentelemetry.sdk.trace import TracerProvider
+            from opentelemetry.sdk.trace.export import BatchSpanProcessor
+        except ImportError as ex:
+            msg = (
+                "exporting traces requires the `opentelemetry-sdk` "
+                "package; install it or use the default local-logging "
+                "tracing config"
+            )
+            raise ImportError(msg) from ex
+        resource = Resource.create(
+            {"service.name": tracing_config.service_name}
+        )
+        provider = TracerProvider(resource=resource)
+        if isinstance(tracing_config, OtlpTracingConfig):
+            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+                OTLPSpanExporter,
+            )
+
+            exporter = OTLPSpanExporter(endpoint=tracing_config.url)
+        else:
+            from opentelemetry.exporter.jaeger.thrift import JaegerExporter
+
+            host, _, port = tracing_config.endpoint.partition(":")
+            exporter = JaegerExporter(
+                agent_host_name=host, agent_port=int(port or 6831)
+            )
+        provider.add_span_processor(BatchSpanProcessor(exporter))
+        ot_trace.set_tracer_provider(provider)
+
+    _tracer = BytewaxTracer(tracing_config, provider)
+    return _tracer
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs) -> Iterator[None]:
+    """Trace a span: exported via the configured backend, or logged at
+    DEBUG locally."""
+    if _tracer is not None and _tracer._provider is not None:
+        from opentelemetry import trace as ot_trace
+
+        tracer = ot_trace.get_tracer("bytewax_tpu")
+        with tracer.start_as_current_span(name, attributes=attrs):
+            yield
+        return
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.debug(
+            "span %s %s took %.6fs", name, attrs, time.perf_counter() - start
+        )

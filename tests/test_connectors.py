@@ -94,34 +94,50 @@ def test_dir_sink_routes_by_key(tmp_path):
     assert (d / "part_1").read_text() == "2\n"
 
 
-def test_demo_source_resume_continues_rng(tmp_path):
+def test_demo_source_resume_continues_rng():
+    # Snapshot mid-stream and rebuild: the resumed partition must
+    # continue the RNG sequence, matching an uninterrupted run.
     from bytewax_tpu.connectors.demo import RandomMetricSource
 
-    db = tmp_path / "db"
-    db.mkdir()
-    init_db_dir(db, 1)
-    rc = RecoveryConfig(db)
+    src = RandomMetricSource("m", interval=ZERO_TD, count=6, seed=123)
 
-    def run_with_abort():
-        out = []
-        inp_src = RandomMetricSource(
-            "m", interval=ZERO_TD, count=6, seed=123
-        )
-        flow = Dataflow("test_df")
-        s = op.input("inp", flow, inp_src)
-        op.output("out", s, TestingSink(out))
-        run_main(flow, epoch_interval=ZERO_TD, recovery_config=rc)
-        return out
+    full_part = src.build_part("s", "m", None)
+    full = [full_part.next_batch()[0][1] for _ in range(6)]
 
-    first = run_with_abort()   # runs to EOF (count exhausted)
-    assert len(first) == 6
+    part = src.build_part("s", "m", None)
+    first_half = [part.next_batch()[0][1] for _ in range(3)]
+    snap = part.snapshot()
 
-    # Uninterrupted reference run with the same seed.
-    ref = []
-    flow = Dataflow("ref_df")
-    s = op.input(
-        "inp", flow, RandomMetricSource("m", interval=ZERO_TD, count=6, seed=123)
+    resumed = src.build_part("s", "m", snap)
+    second_half = [resumed.next_batch()[0][1] for _ in range(3)]
+
+    assert first_half + second_half == full
+
+
+def test_dir_sink_ten_plus_files(tmp_path):
+    # >=10 files: assign_file index must map to the matching
+    # file_namer index despite lexicographic name ordering.
+    d = tmp_path / "outdir"
+    d.mkdir()
+    inp = [(str(i), f"v{i}") for i in range(12)]
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    op.output(
+        "out",
+        s,
+        DirSink(d, file_count=12, assign_file=lambda k: int(k)),
     )
-    op.output("out", ref and None or s, TestingSink(ref))
     run_main(flow)
-    assert [v for _k, v in first] == [v for _k, v in ref]
+    for i in range(12):
+        assert (d / f"part_{i}").read_text() == f"v{i}\n", i
+
+
+def test_csv_source_dictreader_kwargs(tmp_path):
+    path = tmp_path / "in.csv"
+    path.write_text("a,b\n1,2,3\n")
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, CSVSource(path, restkey="extra", restval=""))
+    op.output("out", s, TestingSink(out))
+    run_main(flow)
+    assert out == [{"a": "1", "b": "2", "extra": ["3"]}]

@@ -1,0 +1,119 @@
+"""Kafka connector tests: dataclasses + import gating work without
+confluent_kafka; live-broker tests gated by TEST_KAFKA_BROKER (model:
+``/root/reference/pytests/connectors/test_kafka.py:27-30``)."""
+
+import os
+
+import pytest
+
+from bytewax_tpu.connectors.kafka import (
+    KafkaError,
+    KafkaSinkMessage,
+    KafkaSourceMessage,
+)
+
+HAS_CONFLUENT = True
+try:
+    import confluent_kafka  # noqa: F401
+except ImportError:
+    HAS_CONFLUENT = False
+
+BROKER = os.environ.get("TEST_KAFKA_BROKER")
+
+
+def test_source_message_to_sink():
+    src = KafkaSourceMessage(
+        key=b"k", value=b"v", topic="t", offset=3, partition=0
+    )
+    sink = src.to_sink()
+    assert sink == KafkaSinkMessage(key=b"k", value=b"v", topic="t")
+
+
+def test_message_with_key_value():
+    src = KafkaSourceMessage(key=b"k", value=b"v", offset=7)
+    changed = src._with_key_and_value("K", "V")
+    assert changed.key == "K"
+    assert changed.value == "V"
+    assert changed.offset == 7
+
+
+@pytest.mark.skipif(HAS_CONFLUENT, reason="confluent_kafka installed")
+def test_source_requires_confluent():
+    from bytewax_tpu.connectors.kafka import KafkaSource
+
+    with pytest.raises(ImportError, match="confluent_kafka"):
+        KafkaSource(["localhost:9092"], ["topic"])
+
+
+def test_error_split_operator_graph():
+    # The kop.input operator graph builds without a broker (the
+    # source itself is only constructed, not polled, at graph time) —
+    # but constructing KafkaSource requires the lib, so gate.
+    if not HAS_CONFLUENT:
+        pytest.skip("needs confluent_kafka")
+
+
+def test_serde_avro_gated():
+    from bytewax_tpu.connectors.kafka.serde import PlainAvroSerializer
+
+    try:
+        import fastavro  # noqa: F401
+
+        has_fastavro = True
+    except ImportError:
+        has_fastavro = False
+
+    schema = {
+        "type": "record",
+        "name": "T",
+        "fields": [{"name": "x", "type": "long"}],
+    }
+    if has_fastavro:
+        from bytewax_tpu.connectors.kafka.serde import PlainAvroDeserializer
+
+        ser = PlainAvroSerializer(schema)
+        de = PlainAvroDeserializer(schema)
+        assert de.de(ser.ser({"x": 42})) == {"x": 42}
+    else:
+        with pytest.raises(ImportError, match="fastavro"):
+            PlainAvroSerializer(schema)
+
+
+@pytest.mark.skipif(
+    not (HAS_CONFLUENT and BROKER), reason="needs TEST_KAFKA_BROKER"
+)
+def test_kafka_roundtrip_live():
+    # Live-broker roundtrip, mirroring the reference's gated test.
+    import uuid
+    from confluent_kafka.admin import AdminClient, NewTopic
+
+    import bytewax_tpu.connectors.kafka.operators as kop
+    import bytewax_tpu.operators as op
+    from bytewax_tpu.connectors.kafka import KafkaSink, KafkaSource
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+    topic = f"pytest_{uuid.uuid4()}"
+    admin = AdminClient({"bootstrap.servers": BROKER})
+    admin.create_topics([NewTopic(topic, 3)])[topic].result()
+    try:
+        flow = Dataflow("producer")
+        s = op.input(
+            "inp",
+            flow,
+            TestingSource(
+                [KafkaSinkMessage(key=None, value=b"x", topic=topic)]
+            ),
+        )
+        op.output("out", s, KafkaSink([BROKER], None))
+        run_main(flow)
+
+        out = []
+        flow2 = Dataflow("consumer")
+        src = KafkaSource([BROKER], [topic], tail=False)
+        s2 = op.input("inp", flow2, src)
+        op.output("out", s2, TestingSink(out))
+        run_main(flow2)
+        assert [m.value for m in out] == [b"x"]
+    finally:
+        admin.delete_topics([topic])

@@ -1,0 +1,83 @@
+"""Native C++ IO tests."""
+
+import numpy as np
+import pytest
+
+from bytewax_tpu import native
+
+
+@pytest.fixture(scope="module")
+def parser():
+    if not native.is_available():
+        pytest.skip("native toolchain unavailable")
+    return native.BrcParser()
+
+
+def test_brc_parse(parser):
+    ids, temps = parser.parse(b"oslo;-3.5\nrome;18.2\noslo;0.0\n")
+    assert temps.tolist() == [-35, 182, 0]
+    vocab = parser.vocab()
+    assert vocab[ids].tolist() == ["oslo", "rome", "oslo"]
+
+
+def test_brc_vocab_stable_across_chunks(parser):
+    ids1, _ = parser.parse(b"oslo;1.0\n")
+    ids2, _ = parser.parse(b"oslo;2.0\n")
+    assert ids1[0] == ids2[0]
+
+
+def test_brc_malformed():
+    if not native.is_available():
+        pytest.skip("native toolchain unavailable")
+    p = native.BrcParser()
+    with pytest.raises(ValueError, match="malformed"):
+        p.parse(b"oslo;abc\n")
+
+
+def test_split_point(parser):
+    assert parser.split_point(b"a;1.0\nb;2") == 6
+    assert parser.split_point(b"no-newline") == 0
+
+
+def test_brc_file_source_end_to_end(tmp_path):
+    if not native.is_available():
+        pytest.skip("native toolchain unavailable")
+    import bytewax_tpu.operators as op
+    from bytewax_tpu import xla
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.models.brc import BrcFileSource
+    from bytewax_tpu.testing import TestingSink, run_main
+
+    path = tmp_path / "measurements.txt"
+    rng = np.random.RandomState(0)
+    lines = []
+    for _ in range(5000):
+        station = f"st{rng.randint(20)}"
+        temp = rng.randint(-999, 999) / 10
+        lines.append(f"{station};{temp:.1f}")
+    path.write_text("\n".join(lines) + "\n")
+
+    out = []
+    flow = Dataflow("brc_native")
+    s = op.input(
+        "inp", flow, BrcFileSource(path, part_count=3, chunk_bytes=4096)
+    )
+    stats = xla.stats_final("stats", s)
+    op.output("out", stats, TestingSink(out))
+    run_main(flow)
+
+    # Oracle: plain Python aggregation over the same file.
+    expect = {}
+    for line in lines:
+        k, v = line.split(";")
+        v = float(v)
+        mn, mx, tot, ct = expect.get(k, (float("inf"), float("-inf"), 0.0, 0))
+        expect[k] = (min(mn, v), max(mx, v), tot + v, ct + 1)
+
+    got = dict(out)
+    assert set(got) == set(expect)
+    for k, (mn, mx, tot, ct) in expect.items():
+        gmn, gmean, gmx, gct = got[k]
+        assert gct == ct, k
+        assert abs(gmn - mn) < 1e-4 and abs(gmx - mx) < 1e-4
+        assert abs(gmean - tot / ct) < 1e-3
