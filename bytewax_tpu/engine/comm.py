@@ -1,0 +1,169 @@
+"""Inter-process communication for multi-process clusters.
+
+The reference forms a full TCP mesh between processes and pickles
+payloads at process boundaries
+(``/root/reference/src/run.rs:257-271``,
+``src/pyo3_extensions.rs:94-148``).  Same wire model here: every
+process listens on its address and dials every other; frames are
+length-prefixed pickles.  This mesh carries *host-side* keyed exchange
+and control-plane traffic (epoch barriers, EOF coordination); device
+math stays on each process's chips — on a TPU pod the heavy exchange
+rides ICI inside the compiled step instead (see
+``bytewax_tpu/parallel/exchange.py``).
+"""
+
+import pickle
+import selectors
+import socket
+import struct
+import time
+from typing import Any, List, Optional, Tuple
+
+__all__ = ["Comm"]
+
+_LEN = struct.Struct("<Q")
+_DIAL_TIMEOUT_S = 30.0
+
+
+class Comm:
+    """Full mesh between cluster processes.
+
+    Handshake: every process listens on ``addresses[proc_id]``; lower
+    ids dial higher ids (one socket per pair) and introduce themselves
+    with their proc id.
+    """
+
+    def __init__(self, addresses: List[str], proc_id: int):
+        self.proc_id = proc_id
+        self.proc_count = len(addresses)
+        self._socks: dict = {}
+        self._rx_buf: dict = {}
+        self._closed: set = set()
+        self._sel = selectors.DefaultSelector()
+
+        host, _, port = addresses[proc_id].rpartition(":")
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((host or "0.0.0.0", int(port)))
+        listener.listen(self.proc_count)
+
+        # Dial every higher-id peer; accept from every lower-id peer.
+        expect_accepts = proc_id
+        deadline = time.monotonic() + _DIAL_TIMEOUT_S
+        for peer in range(proc_id + 1, self.proc_count):
+            phost, _, pport = addresses[peer].rpartition(":")
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            while True:
+                try:
+                    sock.connect((phost or "127.0.0.1", int(pport)))
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        msg = f"could not dial cluster peer {addresses[peer]!r}"
+                        raise ConnectionError(msg) from None
+                    time.sleep(0.05)
+            sock.sendall(_LEN.pack(proc_id))
+            self._register(peer, sock)
+        while expect_accepts > 0:
+            listener.settimeout(max(0.0, deadline - time.monotonic()))
+            sock, _addr = listener.accept()
+            raw = self._read_exact(sock, _LEN.size)
+            peer = _LEN.unpack(raw)[0]
+            self._register(peer, sock)
+            expect_accepts -= 1
+        listener.close()
+
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            chunk = sock.recv(n)
+            if not chunk:
+                raise ConnectionError("cluster peer closed connection")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _register(self, peer: int, sock: socket.socket) -> None:
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._socks[peer] = sock
+        self._rx_buf[peer] = bytearray()
+        self._sel.register(sock, selectors.EVENT_READ, peer)
+
+    def send(self, dest: int, msg: Any) -> None:
+        """Framed send that drains incoming bytes while its own send
+        buffer is full — two peers shipping large batches to each
+        other must not deadlock in blocking sends."""
+        payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        data = memoryview(_LEN.pack(len(payload)) + payload)
+        sock = self._socks[dest]
+        while data:
+            try:
+                sent = sock.send(data)
+                data = data[sent:]
+            except BlockingIOError:
+                # Our send buffer is full; free the pipeline by
+                # buffering whatever peers are sending us (parsed
+                # later by recv_ready).
+                self._drain_into_buffers(0.01)
+
+    def broadcast(self, msg: Any) -> None:
+        for peer in self._socks:
+            self.send(peer, msg)
+
+    def _drain_into_buffers(self, timeout: float) -> None:
+        """Read available bytes from all peers into rx buffers without
+        parsing (safe to call mid-send)."""
+        for key, _events in self._sel.select(timeout):
+            peer = key.data
+            sock = key.fileobj
+            try:
+                while True:
+                    chunk = sock.recv(1 << 20)
+                    if not chunk:
+                        try:
+                            self._sel.unregister(sock)
+                        except (KeyError, ValueError):
+                            pass
+                        self._closed.add(peer)
+                        break
+                    self._rx_buf[peer].extend(chunk)
+                    if len(chunk) < (1 << 20):
+                        break
+            except BlockingIOError:
+                pass
+
+    def recv_ready(self, timeout: float = 0.0) -> List[Tuple[int, Any]]:
+        """Drain all complete frames currently available.
+
+        A closed peer's already-buffered frames (e.g. its final
+        close/abort broadcast) are delivered before the disconnect is
+        raised on a later call.
+        """
+        self._drain_into_buffers(timeout)
+        out: List[Tuple[int, Any]] = []
+        for peer, buf in self._rx_buf.items():
+            while len(buf) >= _LEN.size:
+                (length,) = _LEN.unpack(buf[: _LEN.size])
+                if len(buf) < _LEN.size + length:
+                    break
+                frame = bytes(buf[_LEN.size : _LEN.size + length])
+                del buf[: _LEN.size + length]
+                out.append((peer, pickle.loads(frame)))
+        if not out and self._closed:
+            # A peer died mid-run with nothing left to deliver (a
+            # normal shutdown never pumps after its final close).
+            peer = next(iter(self._closed))
+            raise ConnectionError(f"cluster peer {peer} closed connection")
+        return out
+
+    def close(self) -> None:
+        for sock in self._socks.values():
+            try:
+                self._sel.unregister(sock)
+            except (KeyError, ValueError):
+                pass
+            sock.close()
+        self._sel.close()
+        self._socks.clear()

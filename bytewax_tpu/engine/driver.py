@@ -181,22 +181,29 @@ class _InputRt(_OpRt):
         self.next_awake: Dict[str, Optional[datetime]] = {}
         self.pending_snaps: List[Tuple[str, Any]] = []
         if isinstance(source, FixedPartitionedSource):
+            # All processes see the same sorted name set, so the
+            # partition→worker assignment is globally consistent;
+            # each process builds only the partitions it owns
+            # (the reference's assign_primaries: src/timely.rs:572-707).
             names = sorted(set(source.list_parts()))
             for i, name in enumerate(names):
+                w = i % driver.worker_count
+                if not driver.is_local(w):
+                    continue
                 resume = driver.resume_state(op.step_id, name)
                 try:
                     part = source.build_part(op.step_id, name, resume)
                 except BaseException as ex:  # noqa: BLE001
                     _reraise(op.step_id, "`build_part`", ex)
                 self.parts[name] = part
-                self.part_worker[name] = i % driver.worker_count
+                self.part_worker[name] = w
                 # Respect the partition's initial schedule (e.g.
                 # SimplePollingSource align_to), like the reference
                 # does right after build_part (src/inputs.rs:354-362).
                 self.next_awake[name] = part.next_awake()
             self.stateful = True
         elif isinstance(source, DynamicSource):
-            for w in range(driver.worker_count):
+            for w in range(driver.local_lo, driver.local_hi):
                 name = f"worker-{w}"
                 try:
                     part = source.build(op.step_id, w, driver.worker_count)
@@ -323,7 +330,8 @@ class _RedistributeRt(_OpRt):
         self._rr = 0
 
     def process(self, port: str, entries: List[Entry]) -> None:
-        w_count = self.driver.worker_count
+        driver = self.driver
+        w_count = driver.worker_count
         buckets: Dict[int, List[Any]] = {}
         for _w, items in entries:
             if isinstance(items, ArrayBatch):
@@ -331,8 +339,13 @@ class _RedistributeRt(_OpRt):
             for item in items:
                 buckets.setdefault(self._rr % w_count, []).append(item)
                 self._rr += 1
+        stream_id = self.op.downs["down"].stream_id
         for w, items in buckets.items():
-            self.emit("down", (w, items))
+            if driver.is_local(w):
+                self.emit("down", (w, items))
+            else:
+                self._count_out(w, len(items))
+                driver.ship_route(stream_id, (w, items))
 
 
 class _InspectDebugRt(_OpRt):
@@ -373,7 +386,11 @@ class _StatefulBatchRt(_OpRt):
         spec = op.conf.get("_accel")
         if isinstance(spec, AccelSpec) and driver.accel:
             self.agg = DeviceAggState(spec.kind)
-        resumed = driver.resume_states(op.step_id)
+        resumed = {
+            key: state
+            for key, state in driver.resume_states(op.step_id).items()
+            if driver.is_local(_route_hash(key) % driver.worker_count)
+        }
         if self.agg is not None:
             for key, state in resumed.items():
                 self.agg.load(key, state)
@@ -429,7 +446,32 @@ class _StatefulBatchRt(_OpRt):
         for w, items in out.items():
             self.emit("down", (w, items))
 
+    def _split_remote(self, entries: List[Entry]) -> List[Entry]:
+        """In a cluster, re-group each delivery's rows by the home
+        worker of their key and ship non-local groups to their owner
+        (the reference's routed_exchange, src/timely.rs:806-812);
+        returns the locally-owned remainder."""
+        driver = self.driver
+        if driver.comm is None:
+            return entries
+        w_count = driver.worker_count
+        local: List[Entry] = []
+        for _w, items in entries:
+            if isinstance(items, ArrayBatch):
+                items = items.to_pylist()
+            buckets: Dict[int, List[Any]] = {}
+            for item in items:
+                k, _v = _extract_kv(item, self.op.step_id)
+                buckets.setdefault(_route_hash(k) % w_count, []).append(item)
+            for w, group in buckets.items():
+                if driver.is_local(w):
+                    local.append((w, group))
+                else:
+                    driver.ship_deliver(self.idx, "up", (w, group))
+        return local
+
     def process(self, port: str, entries: List[Entry]) -> None:
+        entries = self._split_remote(entries)
         if self.agg is not None:
             self._process_accel(entries)
             return
@@ -562,7 +604,13 @@ class _OutputRt(_OpRt):
                 msg = f"sink of step {op.step_id!r} has no partitions"
                 raise ValueError(msg)
             self.part_fn = sink.part_fn
+            self.part_owner = {
+                name: i % driver.worker_count
+                for i, name in enumerate(self.part_names)
+            }
             for name in self.part_names:
+                if not driver.is_local(self.part_owner[name]):
+                    continue
                 resume = driver.resume_state(op.step_id, name)
                 try:
                     self.parts[name] = sink.build_part(
@@ -572,7 +620,7 @@ class _OutputRt(_OpRt):
                     _reraise(op.step_id, "`build_part`", ex)
         elif isinstance(sink, DynamicSink):
             self.stateful = False
-            for w in range(driver.worker_count):
+            for w in range(driver.local_lo, driver.local_hi):
                 try:
                     self.parts[f"worker-{w}"] = sink.build(
                         op.step_id, w, driver.worker_count
@@ -588,18 +636,29 @@ class _OutputRt(_OpRt):
 
     def process(self, port: str, entries: List[Entry]) -> None:
         if self.stateful:
+            driver = self.driver
             count = len(self.part_names)
             for _w, items in entries:
                 if isinstance(items, ArrayBatch):
                     items = items.to_pylist()
                 buckets: Dict[str, List[Any]] = {}
+                ship: Dict[int, List[Any]] = {}
                 for item in items:
                     k, v = _extract_kv(item, self.op.step_id)
                     try:
                         idx = self.part_fn(k) % count
                     except BaseException as ex:  # noqa: BLE001
                         _reraise(self.op.step_id, "`part_fn`", ex)
-                    buckets.setdefault(self.part_names[idx], []).append(v)
+                    name = self.part_names[idx]
+                    owner = self.part_owner[name]
+                    if driver.is_local(owner):
+                        buckets.setdefault(name, []).append(v)
+                    else:
+                        # Ship the original (key, value) item to the
+                        # partition's owner; it re-runs part_fn there.
+                        ship.setdefault(owner, []).append(item)
+                for owner, group in ship.items():
+                    driver.ship_deliver(self.idx, "up", (owner, group))
                 for name, values in buckets.items():
                     try:
                         self.parts[name].write_batch(values)
@@ -658,9 +717,32 @@ class _Driver:
         worker_count: int,
         epoch_interval: Optional[timedelta],
         recovery_config: Optional[Any],
+        addresses: Optional[List[str]] = None,
+        proc_id: int = 0,
     ):
         self.plan: Plan = flatten(flow)
-        self.worker_count = worker_count
+        # ``worker_count`` is per process; lanes are globally
+        # numbered so keyed routing is identical on every process.
+        self.wpp = worker_count
+        self.proc_id = proc_id
+        self.proc_count = len(addresses) if addresses else 1
+        if not 0 <= proc_id < self.proc_count:
+            msg = (
+                f"process id {proc_id} is out of range for a cluster "
+                f"of {self.proc_count} address(es)"
+            )
+            raise ValueError(msg)
+        self.worker_count = worker_count * self.proc_count
+        self.local_lo = proc_id * worker_count
+        self.local_hi = self.local_lo + worker_count
+        self.comm = None
+        if self.proc_count > 1:
+            from bytewax_tpu.engine.comm import Comm
+
+            self.comm = Comm(addresses, proc_id)
+        self.sent = [0] * self.proc_count
+        self.rcvd = [0] * self.proc_count
+        worker_count = self.worker_count
         self.epoch_interval = (
             epoch_interval
             if epoch_interval is not None
@@ -709,6 +791,28 @@ class _Driver:
 
         self.rts: List[_OpRt] = []
 
+    # -- cluster topology --------------------------------------------------
+
+    def is_local(self, w: int) -> bool:
+        return self.local_lo <= w < self.local_hi
+
+    def owner_proc(self, w: int) -> int:
+        return w // self.wpp
+
+    def ship_deliver(self, op_idx: int, port: str, entry: Entry) -> None:
+        """Send an entry to the process owning its worker lane; it is
+        injected into the same op's input queue there."""
+        dest = self.owner_proc(entry[0])
+        self.sent[dest] += 1
+        self.comm.send(dest, ("deliver", op_idx, port, entry))
+
+    def ship_route(self, stream_id: str, entry: Entry) -> None:
+        """Send an entry to its lane's owner, routed to the stream's
+        consumers there."""
+        dest = self.owner_proc(entry[0])
+        self.sent[dest] += 1
+        self.comm.send(dest, ("route", stream_id, entry))
+
     def resume_state(self, step_id: str, state_key: str) -> Optional[Any]:
         ser = self._loads.get((step_id, state_key))
         return pickle.loads(ser) if ser is not None else None
@@ -725,7 +829,7 @@ class _Driver:
             self.rts[ci].queues[port].append(entry)
         self._progressed = True
 
-    def _close_epoch(self) -> None:
+    def _close_epoch(self, workers: Optional[range] = None) -> None:
         if self.store is not None:
             snaps: List[Tuple[str, str, Optional[bytes]]] = []
             for rt in self.rts:
@@ -738,6 +842,12 @@ class _Driver:
                 commit_epoch = None
             else:
                 commit_epoch = self.epoch - self._commit_delay
+                if self.comm is not None:
+                    # Peers write their frontier for this epoch in
+                    # separate transactions after the coordinator's; a
+                    # crash in that window must not have GC'd past
+                    # their previous frontier.
+                    commit_epoch -= 1
                 commit_epoch = commit_epoch if commit_epoch > 0 else None
             self.store.write_epoch(
                 self.resume.ex_num,
@@ -745,26 +855,148 @@ class _Driver:
                 self.epoch,
                 snaps,
                 commit_epoch,
+                workers=workers,
+                # In a cluster only the coordinator commits/GCs, after
+                # its own frontier write.
+                do_commit=self.proc_id == 0,
             )
         else:
             for rt in self.rts:
                 rt.epoch_snaps()  # still clears awoken sets
         self.epoch += 1
 
+    def _pump(self, timeout: float = 0.0) -> None:
+        """Receive cluster messages: inject shipped data, apply
+        control decisions."""
+        for _src, msg in self.comm.recv_ready(timeout):
+            kind = msg[0]
+            if kind == "deliver":
+                _kind, op_idx, port, entry = msg
+                self.rcvd[_src] += 1
+                self.rts[op_idx].queues[port].append(entry)
+                self._progressed = True
+            elif kind == "route":
+                _kind, stream_id, entry = msg
+                self.rcvd[_src] += 1
+                self.route(stream_id, entry)
+            elif kind == "report_msg":
+                self._reports[_src] = msg[1]
+            elif kind == "hold":
+                self._holding = True
+                self._gen = msg[1]
+            elif kind == "eof_step":
+                self._apply_eof_step(msg[1])
+                self._gen = msg[2]
+            elif kind == "close_epoch":
+                self._pending_close = msg[1:]  # (epoch, final)
+            elif kind == "abort":
+                raise _Abort()
+            else:  # pragma: no cover
+                raise AssertionError(f"unknown ctrl message {msg!r}")
+
+    def _apply_eof_step(self, k: int) -> None:
+        rt = self.rts[k]
+        if not rt.eof:
+            rt.drain()
+            if rt.op.up_streams():
+                rt.on_upstream_eof()
+                rt.drain()
+            rt.eof = True
+        self._eof_k = k + 1
+        self._progressed = True
+
+    def _local_report(self, want_close: bool) -> tuple:
+        drained = all(not rt.queued() for rt in self.rts)
+        sources_eof = all(
+            rt.eof for rt in self.rts if isinstance(rt, _InputRt)
+        )
+        return (
+            want_close,
+            sources_eof,
+            drained,
+            self._eof_k,
+            tuple(self.sent),
+            tuple(self.rcvd),
+            self._gen,
+        )
+
+    def _coord_decide(self) -> None:
+        """Proc 0: act when every process is drained and the global
+        sent/received message matrix matches (no data in flight).
+
+        Reports are generation-tagged: only reports issued after the
+        current hold/eof_step broadcast count, so a pair of mutually
+        stale-but-consistent reports (both predating an in-flight
+        send) can never satisfy the barrier.
+        """
+        reports = self._reports
+        if len(reports) < self.proc_count:
+            return
+        all_sources_eof = all(r[1] for r in reports.values())
+        any_want_close = any(r[0] for r in reports.values())
+        if not self._holding:
+            if any_want_close or all_sources_eof:
+                # Quiesce sources/timers; everything after this
+                # broadcast reports with the new generation.
+                self._gen += 1
+                self.comm.broadcast(("hold", self._gen))
+                self._holding = True
+            return
+        if not all(
+            r[2] and r[6] == self._gen for r in reports.values()
+        ):
+            return
+        for i in range(self.proc_count):
+            for j in range(self.proc_count):
+                if i == j:
+                    continue
+                if reports[i][4][j] != reports[j][5][i]:
+                    return  # data still in flight
+        min_eof_k = min(r[3] for r in reports.values())
+        if all_sources_eof:
+            if min_eof_k < len(self.rts):
+                # Advance the EOF ladder one (topologically ordered)
+                # op at a time so eof emissions fully propagate —
+                # including across processes — before downstream ops
+                # see EOF.
+                self._gen += 1
+                self.comm.broadcast(("eof_step", min_eof_k, self._gen))
+                self._apply_eof_step(min_eof_k)
+                self._reports = {self.proc_id: self._local_report(False)}
+            else:
+                self.comm.broadcast(("close_epoch", self.epoch, True))
+                self._pending_close = (self.epoch, True)
+        elif any_want_close:
+            self.comm.broadcast(("close_epoch", self.epoch, False))
+            self._pending_close = (self.epoch, False)
+
     def run(self) -> None:
         # Build runtimes (applies resume state).
-        for op in self.plan.ops:
-            self.rts.append(_RT_FOR[op.name](op, self))
+        for i, op in enumerate(self.plan.ops):
+            rt = _RT_FOR[op.name](op, self)
+            rt.idx = i
+            self.rts.append(rt)
 
+        local_workers = range(self.local_lo, self.local_hi)
         if self.store is not None:
             self.store.write_ex_started(
-                self.resume.ex_num, self.worker_count, self.resume.resume_epoch
+                self.resume.ex_num,
+                self.worker_count,
+                self.resume.resume_epoch,
+                workers=local_workers,
             )
 
         inputs = [rt for rt in self.rts if isinstance(rt, _InputRt)]
         epoch_started = time.monotonic()
         interval_s = self.epoch_interval.total_seconds()
         aborted = False
+        clustered = self.comm is not None
+        self._holding = False
+        self._pending_close: Optional[tuple] = None
+        self._eof_k = 0
+        self._gen = 0
+        self._reports: Dict[int, tuple] = {}
+        self._last_report: Optional[tuple] = None
 
         from bytewax_tpu.engine.webserver import maybe_start_server
 
@@ -775,32 +1007,66 @@ class _Driver:
                 self._progressed = False
                 now = _now()
 
-                for rt in inputs:
-                    if not rt.eof and rt.poll(now):
-                        self._progressed = True
+                if clustered and self._pending_close is not None:
+                    _epoch, final = self._pending_close
+                    self._pending_close = None
+                    self._close_epoch(workers=local_workers)
+                    self._holding = False
+                    epoch_started = time.monotonic()
+                    self._reports = {}
+                    self._last_report = None
+                    if final:
+                        break
+
+                if clustered:
+                    self._pump()
+
+                if not (clustered and self._holding):
+                    for rt in inputs:
+                        if not rt.eof and rt.poll(now):
+                            self._progressed = True
 
                 for rt in self.rts:
                     rt.drain()
-                    rt.advance(now)
-                    if not rt.eof and not rt.queued() and not isinstance(
-                        rt, _InputRt
+                    if not (clustered and self._holding):
+                        rt.advance(now)
+                    if (
+                        not clustered
+                        and not rt.eof
+                        and not rt.queued()
+                        and not isinstance(rt, _InputRt)
                     ):
                         if rt.op.up_streams() and rt.ups_eof():
                             rt.on_upstream_eof()
                             rt.drain()
                             rt.eof = True
 
-                all_eof = all(rt.eof for rt in self.rts)
                 elapsed = time.monotonic() - epoch_started
 
-                if all_eof:
-                    self._close_epoch()
-                    break
-                if elapsed >= interval_s and (
-                    interval_s > 0 or self._progressed
-                ):
-                    self._close_epoch()
-                    epoch_started = time.monotonic()
+                if not clustered:
+                    if all(rt.eof for rt in self.rts):
+                        self._close_epoch()
+                        break
+                    if elapsed >= interval_s and (
+                        interval_s > 0 or self._progressed
+                    ):
+                        self._close_epoch()
+                        epoch_started = time.monotonic()
+                else:
+                    want_close = elapsed >= interval_s and (
+                        interval_s > 0 or self._progressed or self._holding
+                    )
+                    report = self._local_report(want_close)
+                    if self.proc_id == 0:
+                        self._reports[0] = report
+                        self._coord_decide()
+                    elif report != self._last_report:
+                        self.comm.send(0, ("report_msg", report))
+                        self._last_report = report
+                    # A pending close (set by a pumped message or by
+                    # _coord_decide) is handled at the top of the next
+                    # iteration, before any further pump — peers may
+                    # already have closed their sockets by then.
 
                 if not self._progressed:
                     waits = []
@@ -820,13 +1086,31 @@ class _Driver:
                     if interval_s > 0:
                         waits.append(interval_s - elapsed)
                     wait = min(waits) if waits else 0.001
-                    if wait > 0:
-                        time.sleep(min(wait, 0.05))
+                    wait = min(max(wait, 0.0), 0.05)
+                    if clustered:
+                        if wait > 0 and self._pending_close is None:
+                            self._pump(timeout=wait)
+                    elif wait > 0:
+                        time.sleep(wait)
         except _Abort:
             aborted = True
+            if clustered:
+                try:
+                    self.comm.broadcast(("abort",))
+                except Exception:  # noqa: BLE001
+                    pass
+        except BaseException:
+            if clustered:
+                try:
+                    self.comm.broadcast(("abort",))
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
         finally:
             if api_server is not None:
                 api_server.shutdown()
+            if clustered:
+                self.comm.close()
             if self.store is not None:
                 self.store.close()
 
@@ -873,26 +1157,18 @@ def cluster_main(
 
     Entry-point parity with the reference's ``cluster_main``
     (``src/run.rs:239-351``).  With an empty ``addresses`` list this
-    runs all ``worker_count_per_proc`` worker lanes in-process (this is
-    how multi-worker semantics are unit tested, mirroring the
-    reference's in-process Timely cluster).  Multi-process clusters
-    are launched via ``python -m bytewax_tpu.run``.
+    runs all ``worker_count_per_proc`` worker lanes in-process (this
+    is how multi-worker semantics are unit tested, mirroring the
+    reference's in-process Timely cluster).  With multiple addresses
+    the processes form a TCP mesh for keyed exchange and epoch/EOF
+    coordination (see :mod:`bytewax_tpu.engine.comm`); launch every
+    process with the same flow and its own ``proc_id``.
     """
-    if addresses and len(addresses) > 1:
-        from bytewax_tpu.engine.cluster import cluster_proc_main
-
-        cluster_proc_main(
-            flow,
-            addresses,
-            proc_id,
-            epoch_interval=epoch_interval,
-            recovery_config=recovery_config,
-            worker_count_per_proc=worker_count_per_proc,
-        )
-        return
     _Driver(
         flow,
         worker_count=worker_count_per_proc,
         epoch_interval=epoch_interval,
         recovery_config=recovery_config,
+        addresses=addresses if addresses and len(addresses) > 1 else None,
+        proc_id=proc_id,
     ).run()

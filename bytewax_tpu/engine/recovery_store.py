@@ -276,10 +276,18 @@ class RecoveryStore:
     # -- write path --------------------------------------------------------
 
     def write_ex_started(
-        self, ex_num: int, worker_count: int, resume_epoch: int
+        self,
+        ex_num: int,
+        worker_count: int,
+        resume_epoch: int,
+        workers: Optional[range] = None,
     ) -> None:
-        """Record that an execution started, before any epoch closes."""
-        for worker_index in range(worker_count):
+        """Record that an execution started, before any epoch closes.
+        In a cluster each process writes rows only for its own
+        workers."""
+        for worker_index in workers if workers is not None else range(
+            worker_count
+        ):
             con = self._part_for_worker(worker_index)
             con.execute(
                 "INSERT OR REPLACE INTO exs "
@@ -295,12 +303,19 @@ class RecoveryStore:
         epoch: int,
         snaps: List[Tuple[str, str, Optional[bytes]]],
         commit_epoch: Optional[int],
+        workers: Optional[range] = None,
+        do_commit: bool = True,
     ) -> None:
-        """Durably close an epoch: write snapshots, advance all worker
+        """Durably close an epoch: write snapshots, advance worker
         frontiers to ``epoch + 1``, then advance the commit watermark
-        and garbage collect superseded snapshots."""
-        for con in self._cons.values():
-            con.execute("BEGIN")
+        and garbage collect superseded snapshots.  In a cluster each
+        process writes its own workers' frontiers and only the
+        coordinator commits/GCs."""
+        # Acquire write locks upfront in a fixed partition order so
+        # concurrent cluster processes serialize instead of
+        # deadlocking across the multi-file transaction.
+        for _idx, con in sorted(self._cons.items()):
+            con.execute("BEGIN IMMEDIATE")
         try:
             for step_id, state_key, ser_change in snaps:
                 con = self._part_for_key(step_id, state_key)
@@ -310,14 +325,16 @@ class RecoveryStore:
                     "VALUES (?, ?, ?, ?)",
                     (step_id, state_key, epoch, ser_change),
                 )
-            for worker_index in range(worker_count):
+            for worker_index in workers if workers is not None else range(
+                worker_count
+            ):
                 con = self._part_for_worker(worker_index)
                 con.execute(
                     "INSERT OR REPLACE INTO fronts (ex_num, worker_index, epoch) "
                     "VALUES (?, ?, ?)",
                     (ex_num, worker_index, epoch + 1),
                 )
-            if commit_epoch is not None and commit_epoch > 0:
+            if do_commit and commit_epoch is not None and commit_epoch > 0:
                 for con in self._cons.values():
                     con.execute(
                         "INSERT OR REPLACE INTO commits (epoch) VALUES (?)",

@@ -187,3 +187,80 @@ def poll_next_batch(
             raise TimeoutError()
         batch = list(part.next_batch())
     return batch
+
+
+def _cluster_test_main() -> None:
+    """``python -m bytewax_tpu.testing``: spawn a localhost cluster of
+    subprocesses running the given flow (reference parity:
+    ``pysrc/bytewax/testing.py:311-343``)."""
+    import argparse
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    from bytewax_tpu.run import _create_arg_parser
+
+    parser = _create_arg_parser()
+    parser.prog = "python -m bytewax_tpu.testing"
+    parser.add_argument(
+        "-p",
+        "--processes",
+        type=int,
+        default=1,
+        help="Number of local processes to spawn",
+    )
+    args = parser.parse_args()
+
+    if args.processes == 1 and (args.workers_per_process or 1) == 1:
+        from bytewax_tpu.run import _main as run_main_cli
+
+        passthrough = [sys.argv[0], args.import_str]
+        if args.recovery_directory is not None:
+            passthrough += ["-r", str(args.recovery_directory)]
+        if args.snapshot_interval is not None:
+            passthrough += ["-s", str(args.snapshot_interval.total_seconds())]
+        if args.backup_interval is not None:
+            passthrough += ["-b", str(args.backup_interval.total_seconds())]
+        sys.argv = passthrough
+        run_main_cli()
+        return
+
+    addresses = []
+    for _ in range(args.processes):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            addresses.append(f"127.0.0.1:{s.getsockname()[1]}")
+
+    procs = []
+    for proc_id in range(args.processes):
+        env = dict(os.environ)
+        env["BYTEWAX_ADDRESSES"] = ";".join(addresses)
+        env["BYTEWAX_PROCESS_ID"] = str(proc_id)
+        if args.workers_per_process:
+            env["BYTEWAX_WORKERS_PER_PROCESS"] = str(args.workers_per_process)
+        cmd = [sys.executable, "-m", "bytewax_tpu.run", args.import_str]
+        if args.recovery_directory is not None:
+            cmd += ["-r", str(args.recovery_directory)]
+        if args.snapshot_interval is not None:
+            cmd += ["-s", str(args.snapshot_interval.total_seconds())]
+        if args.backup_interval is not None:
+            cmd += ["-b", str(args.backup_interval.total_seconds())]
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    exit_code = 0
+    try:
+        for proc in procs:
+            proc.wait()
+            exit_code = exit_code or proc.returncode
+    except KeyboardInterrupt:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            proc.wait()
+        exit_code = 130
+    sys.exit(exit_code)
+
+
+if __name__ == "__main__":
+    _cluster_test_main()

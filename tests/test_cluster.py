@@ -1,0 +1,206 @@
+"""Multi-process cluster execution tests (model:
+``/root/reference/pytests/test_execution.py`` — real subprocesses
+forming a localhost TCP mesh)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+_FLOW_TEMPLATE = '''
+import os
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+
+
+class _Part(StatelessSourcePartition):
+    def __init__(self, worker_index):
+        self._items = [
+            (f"key-{{i}}", 1) for i in range(worker_index * 8, worker_index * 8 + 8)
+        ] * 3
+        self._done = False
+
+    def next_batch(self):
+        if self._done:
+            raise StopIteration()
+        self._done = True
+        return self._items
+
+
+class PerWorkerSource(DynamicSource):
+    def build(self, step_id, worker_index, worker_count):
+        return _Part(worker_index)
+
+
+flow = Dataflow("cluster_df")
+s = op.input("inp", flow, PerWorkerSource())
+summed = op.reduce_final("sum", s, lambda a, b: a + b)
+fmt = op.map_value("fmt", summed, str)
+op.output("out", fmt, FileSink({out_path!r}))
+'''
+
+
+def _write_flow(tmp_path: Path) -> Path:
+    out_path = str(tmp_path / "out.txt")
+    flow_py = tmp_path / "cluster_flow.py"
+    flow_py.write_text(_FLOW_TEMPLATE.format(out_path=out_path))
+    return flow_py
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    env["BYTEWAX_TPU_PLATFORM"] = "cpu"
+    env["BYTEWAX_TPU_ACCEL"] = "0"  # keep subprocess startup light
+    return env
+
+
+@pytest.mark.parametrize("procs,wpp", [(2, 1), (2, 2)])
+def test_cluster_keyed_exchange(tmp_path, procs, wpp):
+    flow_py = _write_flow(tmp_path)
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.testing",
+            f"{flow_py}:flow",
+            "-p",
+            str(procs),
+            "-w",
+            str(wpp),
+        ],
+        env=_env(),
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    out = (tmp_path / "out.txt").read_text().splitlines()
+    # Each worker lane emits 8 unique keys 3 times; every key must be
+    # summed exactly once (to "3"), wherever its home lane lives.
+    assert sorted(out) == ["3"] * 8 * procs * wpp
+
+
+def test_cluster_sigint_clean_shutdown(tmp_path):
+    # An infinite source; SIGINT must terminate all processes.
+    flow_py = tmp_path / "infinite_flow.py"
+    flow_py.write_text(
+        """
+import time
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+
+
+class _Tick(StatelessSourcePartition):
+    def next_batch(self):
+        time.sleep(0.01)
+        return ["tick"]
+
+
+class TickSource(DynamicSource):
+    def build(self, step_id, worker_index, worker_count):
+        return _Tick()
+
+
+flow = Dataflow("inf_df")
+s = op.input("inp", flow, TickSource())
+s = op.filter("drop", s, lambda _x: False)
+op.output("out", s, StdOutSink())
+"""
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.testing",
+            f"{flow_py}:flow",
+            "-p",
+            "2",
+            "-w",
+            "1",
+        ],
+        env=_env(),
+        cwd=tmp_path,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    time.sleep(8)  # let the cluster form and run
+    assert proc.poll() is None, "cluster exited prematurely"
+    os.killpg(proc.pid, signal.SIGINT)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        raise AssertionError("cluster did not shut down on SIGINT")
+
+
+def test_cluster_recovery_continuation(tmp_path):
+    # Two executions of a 2-proc cluster with a shared recovery store:
+    # the second resumes after the EOF sentinel.
+    flow_py = tmp_path / "rec_flow.py"
+    out_path = str(tmp_path / "out.txt")
+    flow_py.write_text(
+        f'''
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.testing import TestingSource
+
+inp = ["a", "b", TestingSource.EOF(), "c", "d"]
+flow = Dataflow("rec_df")
+s = op.input("inp", flow, TestingSource(inp))
+s = op.key_on("key", s, lambda x: x)
+op.output("out", s, FileSink({out_path!r}))
+'''
+    )
+    db = tmp_path / "db"
+    db.mkdir()
+    subprocess.run(
+        [sys.executable, "-m", "bytewax_tpu.recovery", str(db), "2"],
+        env=_env(),
+        check=True,
+        timeout=60,
+    )
+
+    def run_cluster():
+        return subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "bytewax_tpu.testing",
+                f"{flow_py}:flow",
+                "-p",
+                "2",
+                "-r",
+                str(db),
+                "-s",
+                "0",
+                "-b",
+                "0",
+            ],
+            env=_env(),
+            cwd=tmp_path,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    res = run_cluster()
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert sorted(Path(out_path).read_text().split()) == ["a", "b"]
+
+    res = run_cluster()
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert sorted(Path(out_path).read_text().split()) == ["a", "b", "c", "d"]
