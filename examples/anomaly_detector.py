@@ -1,0 +1,35 @@
+"""Per-key rolling z-score anomaly detection
+(reference: examples/anomaly_detector.py)."""
+
+from datetime import timedelta
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.demo import RandomMetricSource
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+
+
+def _fmt(kv):
+    key, (value, z, is_anomaly) = kv
+    flag = " ANOMALY" if is_anomaly else ""
+    return f"{key}: value={value:+.3f} z={z:+.2f}{flag}"
+
+
+def get_flow():
+    from bytewax_tpu.models.anomaly import _update
+
+    flow = Dataflow("anomaly_detector")
+    s = op.input(
+        "inp",
+        flow,
+        RandomMetricSource(
+            "system_metric", interval=timedelta(0), count=200, seed=42
+        ),
+    )
+    scored = op.stateful_map("zscore", s, lambda st, v: _update(st, v, 2.5))
+    pretty = op.map("fmt", scored, _fmt)
+    op.output("out", pretty, StdOutSink())
+    return flow
+
+
+flow = get_flow()

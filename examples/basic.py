@@ -1,0 +1,11 @@
+"""Simplest possible dataflow (reference: examples/basic.py)."""
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSource
+
+flow = Dataflow("basic")
+stream = op.input("inp", flow, TestingSource(range(10)))
+stream = op.map("times_two", stream, lambda x: x * 2)
+op.output("out", stream, StdOutSink())

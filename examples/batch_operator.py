@@ -1,0 +1,17 @@
+"""Collect items into batches by size or timeout
+(reference: examples/batch_operator.py)."""
+
+from datetime import timedelta
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSource
+
+flow = Dataflow("batch")
+s = op.input("inp", flow, TestingSource(range(10)))
+keyed = op.key_on("key", s, lambda _x: "ALL")
+batched = op.collect(
+    "collect", keyed, timeout=timedelta(seconds=10), max_size=3
+)
+op.output("out", batched, StdOutSink())

@@ -1,0 +1,17 @@
+"""Event-time windowing benchmark
+(reference: examples/benchmark_windowing.py)."""
+
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.models.windowing_bench import (
+    make_input,
+    windowing_bench_flow,
+)
+from bytewax_tpu.testing import TestingSource
+
+BATCH_SIZE = 100_000
+BATCH_COUNT = 10
+
+flow = windowing_bench_flow(
+    TestingSource(make_input(BATCH_SIZE, BATCH_COUNT), BATCH_COUNT),
+    StdOutSink(),
+)

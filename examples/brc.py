@@ -1,0 +1,54 @@
+"""1BRC: per-station min/mean/max over a measurements file, parsed by
+the native C++ parser and folded on device
+(reference: examples/1brc.py).
+
+Generate data first:
+    python examples/brc.py --generate 10000000 measurements.txt
+Run:
+    python -m bytewax_tpu.run examples/brc.py:flow
+"""
+
+import os
+import sys
+
+import bytewax_tpu.operators as op
+from bytewax_tpu import xla
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.models.brc import BrcFileSource
+
+PATH = os.environ.get("BRC_PATH", "measurements.txt")
+
+
+def get_flow():
+    flow = Dataflow("brc")
+    s = op.input("inp", flow, BrcFileSource(PATH, part_count=4))
+    stats = xla.stats_final("stats", s)
+    fmt = op.map(
+        "fmt",
+        stats,
+        lambda kv: f"{kv[0]}={kv[1][0]:.1f}/{kv[1][1]:.1f}/{kv[1][2]:.1f}",
+    )
+    op.output("out", fmt, StdOutSink())
+    return flow
+
+
+if __name__ == "__main__" and len(sys.argv) > 2 and sys.argv[1] == "--generate":
+    import numpy as np
+
+    n = int(sys.argv[2])
+    out = sys.argv[3] if len(sys.argv) > 3 else PATH
+    rng = np.random.RandomState(0)
+    stations = [f"station_{i:04d}" for i in range(413)]
+    with open(out, "w") as f:
+        for start in range(0, n, 1_000_000):
+            m = min(1_000_000, n - start)
+            ids = rng.randint(0, 413, size=m)
+            temps = rng.randint(-999, 999, size=m)
+            f.writelines(
+                f"{stations[i]};{t / 10:.1f}\n"
+                for i, t in zip(ids.tolist(), temps.tolist())
+            )
+    print(f"wrote {n} rows to {out}")
+else:
+    flow = get_flow()

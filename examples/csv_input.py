@@ -1,0 +1,10 @@
+"""CSV file input (reference: examples/csv_input.py)."""
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.files import CSVSource
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+
+flow = Dataflow("csv_input")
+s = op.input("inp", flow, CSVSource("examples/sample_data/metrics.csv"))
+op.output("out", s, StdOutSink())

@@ -1,0 +1,42 @@
+"""Event-time session windows over out-of-order data
+(reference: examples/event_time_processing.py)."""
+
+from datetime import datetime, timedelta, timezone
+
+import bytewax_tpu.operators as op
+import bytewax_tpu.operators.windowing as w
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.operators.windowing import EventClock, SessionWindower
+from bytewax_tpu.testing import TestingSource
+
+START = datetime(2023, 1, 1, tzinfo=timezone.utc)
+
+events = [
+    {"user": "a", "at": START + timedelta(seconds=s), "what": what}
+    for s, what in [
+        (0, "login"),
+        (2, "search"),
+        (5, "click"),  # session 1
+        (40, "login"),
+        (41, "buy"),  # session 2 after a gap
+    ]
+]
+
+clock = EventClock(
+    ts_getter=lambda e: e["at"], wait_for_system_duration=timedelta(seconds=1)
+)
+
+flow = Dataflow("event_time")
+s = op.input("inp", flow, TestingSource(events))
+keyed = op.key_on("user", s, lambda e: e["user"])
+wo = w.collect_window(
+    "sessions", keyed, clock, SessionWindower(gap=timedelta(seconds=10))
+)
+pretty = op.map(
+    "fmt",
+    wo.down,
+    lambda kv: f"user {kv[0]} session {kv[1][0]}: "
+    + " -> ".join(e["what"] for e in kv[1][1]),
+)
+op.output("out", pretty, StdOutSink())

@@ -1,0 +1,20 @@
+"""Keyed join of two streams (reference: examples/join.py)."""
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSource
+
+flow = Dataflow("join")
+names = op.input(
+    "names",
+    flow,
+    TestingSource([("1", "Ada"), ("2", "Grace"), ("3", "Edsger")]),
+)
+emails = op.input(
+    "emails",
+    flow,
+    TestingSource([("1", "ada@eng"), ("2", "grace@navy"), ("4", "x@y")]),
+)
+joined = op.join("join", names, emails)
+op.output("out", joined, StdOutSink())

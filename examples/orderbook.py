@@ -1,0 +1,51 @@
+"""Track top-of-book per symbol with stateful logic
+(reference: examples/orderbook.py, simplified feed)."""
+
+from dataclasses import dataclass
+from typing import Optional
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSource
+
+
+@dataclass
+class OrderBook:
+    bid: Optional[float] = None
+    ask: Optional[float] = None
+
+    def update(self, side: str, price: float) -> "OrderBook":
+        if side == "bid" and (self.bid is None or price > self.bid):
+            self.bid = price
+        elif side == "ask" and (self.ask is None or price < self.ask):
+            self.ask = price
+        return self
+
+    @property
+    def spread(self) -> Optional[float]:
+        if self.bid is not None and self.ask is not None:
+            return self.ask - self.bid
+        return None
+
+
+feed = [
+    ("BTC", ("bid", 100.0)),
+    ("BTC", ("ask", 101.5)),
+    ("ETH", ("bid", 10.0)),
+    ("BTC", ("bid", 100.5)),
+    ("ETH", ("ask", 10.2)),
+]
+
+
+def keep_book(book, update):
+    book = book or OrderBook()
+    side, price = update
+    book.update(side, price)
+    return (book, (book.bid, book.ask, book.spread))
+
+
+flow = Dataflow("orderbook")
+s = op.input("inp", flow, TestingSource(feed))
+books = op.stateful_map("book", s, keep_book)
+op.output("out", books, StdOutSink())

@@ -1,0 +1,19 @@
+"""Using functools.partial to configure mappers
+(reference: examples/partials.py)."""
+
+from functools import partial
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSource
+
+
+def scale(factor: float, x: float) -> float:
+    return x * factor
+
+
+flow = Dataflow("partials")
+s = op.input("inp", flow, TestingSource([1.0, 2.0, 3.0]))
+s = op.map("scale", s, partial(scale, 10.0))
+op.output("out", s, StdOutSink())

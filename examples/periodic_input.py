@@ -1,0 +1,26 @@
+"""Polling source example (reference: examples/periodic_input.py)."""
+
+from datetime import datetime, timedelta, timezone
+from typing import Optional
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.inputs import SimplePollingSource
+
+
+class CounterSource(SimplePollingSource):
+    def __init__(self):
+        super().__init__(interval=timedelta(seconds=0.2))
+        self._n = 0
+
+    def next_item(self) -> Optional[str]:
+        self._n += 1
+        if self._n > 10:
+            raise StopIteration()
+        return f"tick {self._n} at {datetime.now(timezone.utc):%H:%M:%S.%f}"
+
+
+flow = Dataflow("periodic")
+s = op.input("inp", flow, CounterSource())
+op.output("out", s, StdOutSink())

@@ -1,0 +1,15 @@
+"""Branch a stream and process sides differently
+(reference: examples/poll_and_split.py shape)."""
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSource
+
+flow = Dataflow("split")
+s = op.input("inp", flow, TestingSource(range(10)))
+b = op.branch("evens_odds", s, lambda x: x % 2 == 0)
+evens = op.map("half", b.trues, lambda x: x // 2)
+odds = op.map("triple", b.falses, lambda x: x * 3)
+merged = op.merge("merge", evens, odds)
+op.output("out", merged, StdOutSink())

@@ -1,0 +1,52 @@
+"""Sessionize search logs and compute per-session click-through rate
+(reference: examples/search_session.py)."""
+
+from dataclasses import dataclass
+from datetime import datetime, timedelta, timezone
+from typing import List
+
+import bytewax_tpu.operators as op
+import bytewax_tpu.operators.windowing as w
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.operators.windowing import EventClock, SessionWindower
+from bytewax_tpu.testing import TestingSource
+
+START = datetime(2023, 1, 1, tzinfo=timezone.utc)
+
+
+@dataclass
+class Event:
+    user: str
+    at: datetime
+    kind: str  # "search" | "click"
+
+
+events = [
+    Event("a", START + timedelta(seconds=0), "search"),
+    Event("a", START + timedelta(seconds=2), "click"),
+    Event("a", START + timedelta(seconds=3), "click"),
+    Event("a", START + timedelta(minutes=5), "search"),  # new session
+    Event("b", START + timedelta(seconds=1), "search"),
+]
+
+
+def ctr(session: List[Event]) -> str:
+    searches = sum(1 for e in session if e.kind == "search")
+    clicks = sum(1 for e in session if e.kind == "click")
+    rate = clicks / searches if searches else 0.0
+    return f"{searches} searches, {clicks} clicks -> CTR {rate:.2f}"
+
+
+clock = EventClock(
+    ts_getter=lambda e: e.at, wait_for_system_duration=timedelta(seconds=1)
+)
+
+flow = Dataflow("search_session")
+s = op.input("inp", flow, TestingSource(events))
+keyed = op.key_on("user", s, lambda e: e.user)
+wo = w.collect_window(
+    "sessions", keyed, clock, SessionWindower(gap=timedelta(minutes=1))
+)
+pretty = op.map("ctr", wo.down, lambda kv: f"user {kv[0]}: {ctr(kv[1][1])}")
+op.output("out", pretty, StdOutSink())

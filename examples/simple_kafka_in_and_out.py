@@ -1,0 +1,21 @@
+"""Kafka passthrough (reference: examples/simple_kafka_in_and_out.py).
+
+Requires a running broker and `confluent_kafka` installed:
+    BROKERS=localhost:9092 IN_TOPIC=in OUT_TOPIC=out \
+        python -m bytewax_tpu.run examples/simple_kafka_in_and_out.py:flow
+"""
+
+import os
+
+import bytewax_tpu.connectors.kafka.operators as kop
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+
+BROKERS = os.environ.get("BROKERS", "localhost:9092").split(";")
+IN_TOPIC = os.environ.get("IN_TOPIC", "in_topic")
+OUT_TOPIC = os.environ.get("OUT_TOPIC", "out_topic")
+
+flow = Dataflow("kafka_in_out")
+kin = kop.input("inp", flow, brokers=BROKERS, topics=[IN_TOPIC])
+op.inspect("errors", kin.errs).then(op.raises, "crash-on-err")
+kop.output("out", kin.oks, brokers=BROKERS, topic=OUT_TOPIC)

@@ -1,0 +1,10 @@
+"""Word count with the XLA-accelerated counter
+(count_final lowers to a device scatter-combine)."""
+
+from bytewax_tpu.connectors.files import FileSource
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.models.wordcount import wordcount_flow
+
+flow = wordcount_flow(
+    FileSource("examples/sample_data/wordcount.txt"), StdOutSink()
+)
