@@ -61,6 +61,28 @@ class ArrayBatch:
         per-row dicts.
         """
         names = set(self.cols)
+        if names == {"key", "ts"}:
+            # Columnar windowed-event batches degrade to (key,
+            # timestamp) items so the host tier (and cluster
+            # exchange) key them correctly; ts getters must accept
+            # datetime values in columnar flows.
+            from datetime import timezone
+
+            keys = np.asarray(self.cols["key"]).tolist()
+            ts = np.asarray(self.cols["ts"])
+            if np.issubdtype(ts.dtype, np.datetime64):
+                stamps = [
+                    t.replace(tzinfo=timezone.utc)
+                    for t in ts.astype("datetime64[us]").tolist()
+                ]
+            else:
+                from datetime import datetime
+
+                stamps = [
+                    datetime.fromtimestamp(t / 1e6, tz=timezone.utc)
+                    for t in ts.astype(np.float64).tolist()
+                ]
+            return list(zip(keys, stamps))
         if names == {"key_id", "value"} and self.key_vocab is not None:
             vocab = np.asarray(self.key_vocab)
             keys = vocab[np.asarray(self.cols["key_id"])].tolist()

@@ -383,9 +383,18 @@ class _StatefulBatchRt(_OpRt):
         # per-key Python logics (annotated by the flatten-time
         # lowering pass; same snapshots, same EOF emission order).
         self.agg: Optional[DeviceAggState] = None
+        self.wagg = None
         spec = op.conf.get("_accel")
-        if isinstance(spec, AccelSpec) and driver.accel:
-            self.agg = DeviceAggState(spec.kind)
+        if driver.accel:
+            from bytewax_tpu.engine.window_accel import (
+                DeviceWindowAggState,
+                WindowAccelSpec,
+            )
+
+            if isinstance(spec, AccelSpec):
+                self.agg = DeviceAggState(spec.kind)
+            elif isinstance(spec, WindowAccelSpec):
+                self.wagg = DeviceWindowAggState(spec)
         resumed = {
             key: state
             for key, state in driver.resume_states(op.step_id).items()
@@ -394,6 +403,9 @@ class _StatefulBatchRt(_OpRt):
         if self.agg is not None:
             for key, state in resumed.items():
                 self.agg.load(key, state)
+        elif self.wagg is not None:
+            for key, state in resumed.items():
+                self.wagg.load(key, state)
         else:
             # Eagerly rebuild logics for every resumed key so
             # EOF-driven emission (fold_final etc.) fires even with no
@@ -470,8 +482,47 @@ class _StatefulBatchRt(_OpRt):
                     driver.ship_deliver(self.idx, "up", (w, group))
         return local
 
+    def _emit_window_events(self, events: List[Tuple[str, Any]]) -> None:
+        out: Dict[int, List[Any]] = {}
+        w_count = self.driver.worker_count
+        for key, ev in events:
+            out.setdefault(_route_hash(key) % w_count, []).append((key, ev))
+            self.awoken.add(key)
+        self._flush(out)
+
+    def _process_window_accel(self, entries: List[Entry]) -> None:
+        assert self.wagg is not None
+        for _w, items in entries:
+            if isinstance(items, ArrayBatch) and "ts" in items.cols:
+                try:
+                    events = self.wagg.on_batch_columnar(items)
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(
+                        self.op.step_id, "the device window fold", ex
+                    )
+                self._emit_window_events(events)
+                continue
+            if isinstance(items, ArrayBatch):
+                items = items.to_pylist()
+            keys: List[str] = []
+            values: List[Any] = []
+            for item in items:
+                k, v = _extract_kv(item, self.op.step_id)
+                keys.append(k)
+                values.append(v)
+            if not keys:
+                continue
+            try:
+                events = self.wagg.on_batch(keys, values)
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(self.op.step_id, "the device window fold", ex)
+            self._emit_window_events(events)
+
     def process(self, port: str, entries: List[Entry]) -> None:
         entries = self._split_remote(entries)
+        if self.wagg is not None:
+            self._process_window_accel(entries)
+            return
         if self.agg is not None:
             self._process_accel(entries)
             return
@@ -526,6 +577,15 @@ class _StatefulBatchRt(_OpRt):
             self.awoken.update(touched)
 
     def advance(self, now: datetime) -> None:
+        if self.wagg is not None:
+            at = self.wagg.notify_at()
+            if at is not None and at <= now:
+                try:
+                    events = self.wagg.on_notify()
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(self.op.step_id, "the device window fold", ex)
+                self._emit_window_events(events)
+            return
         due = sorted(
             (key for key, at in self.sched.items() if at <= now)
         )
@@ -546,6 +606,13 @@ class _StatefulBatchRt(_OpRt):
         self._flush(out)
 
     def on_upstream_eof(self) -> None:
+        if self.wagg is not None:
+            try:
+                events = self.wagg.on_eof()
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(self.op.step_id, "the device window fold", ex)
+            self._emit_window_events(events)
+            return
         if self.agg is not None:
             out: Dict[int, List[Any]] = {}
             w_count = self.driver.worker_count
@@ -567,9 +634,18 @@ class _StatefulBatchRt(_OpRt):
         self._flush(out)
 
     def next_notify_at(self) -> Optional[datetime]:
+        if self.wagg is not None:
+            return self.wagg.notify_at()
         return min(self.sched.values()) if self.sched else None
 
     def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
+        if self.wagg is not None:
+            snaps = self.wagg.snapshots_for(
+                sorted(self.awoken | self.wagg.touched)
+            )
+            self.awoken.clear()
+            self.wagg.touched.clear()
+            return snaps
         if self.agg is not None:
             snaps = self.agg.snapshots_for(sorted(self.awoken))
             self.awoken.clear()

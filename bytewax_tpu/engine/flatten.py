@@ -25,20 +25,66 @@ def _find_core_stateful(op: Operator) -> Optional[Operator]:
 
 def _annotate_accel(op: Operator) -> None:
     """Lowering pass: recognize aggregation shapes and annotate their
-    core ``stateful_batch`` with a device :class:`AccelSpec` so the
-    driver folds them on device instead of per-key Python logics."""
+    core ``stateful_batch`` with a device spec so the driver folds
+    them on device instead of per-key Python logics."""
     from bytewax_tpu.engine.xla import AccelSpec
     from bytewax_tpu.xla import Reducer
 
-    spec: Optional[AccelSpec] = None
+    spec = None
     if op.name == "reduce_final" and isinstance(op.conf.get("reducer"), Reducer):
         spec = AccelSpec(op.conf["reducer"].kind)
     elif op.name == "stats_final":
         spec = AccelSpec("stats")
+    elif op.name == "count_window":
+        spec = _window_accel_spec(op)
     if spec is not None:
         inner = _find_core_stateful(op)
         if inner is not None:
             inner.conf["_accel"] = spec
+
+
+def _window_accel_spec(op: Operator):
+    """Device lowering for windowed counting over EventClock +
+    tumbling/sliding windows.
+
+    Counting is the one windowed fold where acceleration is always
+    sound: the timestamp comes from the full item and the folded
+    "value" is a constant 1 (numeric folds of the values themselves
+    would need the values to be both numeric and timestamp-bearing,
+    which this API cannot promise statically — those stay on the host
+    tier).  Sessions and custom/fake clocks also stay host-side.
+    """
+    from bytewax_tpu.engine.window_accel import WindowAccelSpec
+    from bytewax_tpu.operators import _get_system_utc, _identity
+    from bytewax_tpu.operators.windowing import (
+        EventClock,
+        SlidingWindower,
+        TumblingWindower,
+    )
+
+    kind = "count"
+    clock = op.conf.get("clock")
+    windower = op.conf.get("windower")
+    if not isinstance(clock, EventClock):
+        return None
+    if clock.now_getter is not _get_system_utc or clock.to_system_utc is not _identity:
+        # Custom/fake clocks (tests) need the host tier's exact
+        # per-item semantics.
+        return None
+    if isinstance(windower, TumblingWindower):
+        length, offset = windower.length, windower.length
+    elif isinstance(windower, SlidingWindower):
+        length, offset = windower.length, windower.offset
+    else:
+        return None
+    return WindowAccelSpec(
+        kind,
+        clock.ts_getter,
+        windower.align_to,
+        length,
+        offset,
+        clock.wait_for_system_duration,
+    )
 
 CORE_OPS = frozenset(
     {

@@ -1,0 +1,397 @@
+"""Device-accelerated windowed aggregation.
+
+Lowers numeric ``fold_window``/``reduce_window``/``count_window`` over
+``EventClock`` + tumbling/sliding windows to the device tier: window-id
+assignment, per-key watermarks, and lateness are vectorized numpy on
+the host (float64 time math keeps full precision); the per-(key,
+window) fold is one scatter-combine into a device slot table (see
+``bytewax_tpu/ops/segment.py``).  The host tier's `_WindowLogic`
+(``bytewax_tpu/operators/windowing.py``) remains the oracle and
+handles everything else (sessions, non-numeric folds, SystemClock).
+
+Snapshots are emitted in the host tier's ``_WindowSnapshot`` format,
+so recovery is interchangeable between tiers.
+
+Semantics note: lateness is judged against the key's watermark as of
+the *end* of each delivered batch (the host tier judges per item);
+for commutative folds this only affects which side of the late stream
+borderline items land on within a single batch.
+"""
+
+from datetime import datetime, timedelta, timezone
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bytewax_tpu.engine.xla import DeviceAggState
+
+__all__ = ["DeviceWindowAggState", "WindowAccelSpec"]
+
+_US = 1_000_000.0
+
+
+def _to_us(dt: datetime) -> float:
+    return dt.timestamp() * _US
+
+
+class _LateTs:
+    """Late-value view for columnar batches: row index → timestamp."""
+
+    def __init__(self, ts_us: np.ndarray):
+        self._ts_us = ts_us
+
+    def __getitem__(self, row: int) -> datetime:
+        return datetime.fromtimestamp(
+            self._ts_us[row] / _US, tz=timezone.utc
+        )
+
+
+class WindowAccelSpec:
+    """Flatten-time annotation: lower this windowed fold to device."""
+
+    def __init__(
+        self,
+        kind: str,
+        ts_getter: Callable[[Any], datetime],
+        align_to: datetime,
+        length: timedelta,
+        offset: timedelta,
+        wait: timedelta,
+    ):
+        self.kind = kind
+        self.ts_getter = ts_getter
+        self.align_us = _to_us(align_to)
+        self.length_us = length.total_seconds() * _US
+        self.offset_us = offset.total_seconds() * _US
+        self.wait_us = wait.total_seconds() * _US
+
+    def __repr__(self) -> str:
+        return f"WindowAccelSpec({self.kind!r})"
+
+
+class DeviceWindowAggState:
+    """All keys' open windows for one windowed-fold step.
+
+    Host numpy state: per-key watermark bases (EventClock semantics:
+    watermark = max event ts − wait + system time since that event,
+    ``windowing.py:_EventClockLogic``) and the open-window table
+    mapping ``(key, window_id)`` to a device slot.
+    """
+
+    def __init__(self, spec: WindowAccelSpec):
+        self.spec = spec
+        self.agg = DeviceAggState(spec.kind)
+        # windows_per_ts is static for a sliding windower.
+        self.expand = max(1, int(np.ceil(spec.length_us / spec.offset_us)))
+        # Per-key clock state, indexed by key id.
+        self.keys: List[str] = []
+        self.key_ids: Dict[str, int] = {}
+        self.base_us = np.empty(0, dtype=np.float64)  # watermark base
+        self.sys_at_base = np.empty(0, dtype=np.float64)
+        # Open windows: composite "k\x00wid" -> True (slot table lives
+        # in self.agg keyed by the same composite).
+        self.open_close_us: Dict[Tuple[int, int], float] = {}
+        #: Keys touched since the last epoch snapshot.
+        self.touched: set = set()
+
+    # -- clock -------------------------------------------------------------
+
+    def _key_ids_for(self, keys: List[str]) -> np.ndarray:
+        out = np.empty(len(keys), dtype=np.int64)
+        for i, k in enumerate(keys):
+            kid = self.key_ids.get(k)
+            if kid is None:
+                kid = len(self.keys)
+                self.key_ids[k] = kid
+                self.keys.append(k)
+            out[i] = kid
+        if len(self.keys) > len(self.base_us):
+            grow = len(self.keys) - len(self.base_us)
+            now_us = datetime.now(timezone.utc).timestamp() * _US
+            self.base_us = np.concatenate(
+                [self.base_us, np.full(grow, -np.inf)]
+            )
+            self.sys_at_base = np.concatenate(
+                [self.sys_at_base, np.full(grow, now_us)]
+            )
+        return out
+
+    def _watermarks(self, kids: np.ndarray, now_us: float) -> np.ndarray:
+        return self.base_us[kids] + (now_us - self.sys_at_base[kids])
+
+    # -- processing --------------------------------------------------------
+
+    def on_batch_columnar(self, batch) -> List[Tuple[str, Tuple[int, str, Any]]]:
+        """Columnar fast path: a batch with a ``"key"`` column and a
+        ``"ts"`` column (``np.datetime64`` or int64 microseconds since
+        the epoch) counts into windows with no per-row Python.  Late
+        rows are reported with their timestamp as the value."""
+        keys_col = batch.numpy("key")
+        uniq_keys, inverse = np.unique(keys_col, return_inverse=True)
+        kid_of_uniq = self._key_ids_for([str(k) for k in uniq_keys])
+        kids = kid_of_uniq[inverse]
+        ts_col = batch.numpy("ts")
+        if np.issubdtype(ts_col.dtype, np.datetime64):
+            ts_us = ts_col.astype("datetime64[us]").astype(np.int64).astype(
+                np.float64
+            )
+        else:
+            ts_us = ts_col.astype(np.float64)
+        return self._ingest(kids, ts_us, _LateTs(ts_us))
+
+    def on_batch(
+        self, keys: List[str], values: List[Any]
+    ) -> List[Tuple[str, Tuple[int, str, Any]]]:
+        """Fold a batch; returns window events tagged like the host
+        tier's ``_WindowLogic`` ("E" emit / "L" late / "M" meta)."""
+        spec = self.spec
+        kids = self._key_ids_for(keys)
+        ts_us = np.fromiter(
+            (_to_us(spec.ts_getter(v)) for v in values),
+            dtype=np.float64,
+            count=len(values),
+        )
+        return self._ingest(kids, ts_us, values)
+
+    def _ingest(
+        self, kids: np.ndarray, ts_us: np.ndarray, values
+    ) -> List[Tuple[str, Tuple[int, str, Any]]]:
+        spec = self.spec
+        now_us = datetime.now(timezone.utc).timestamp() * _US
+        self.touched.update(
+            self.keys[int(k)] for k in np.unique(kids)
+        )
+
+        # Per-row watermark exactly as the host tier computes it
+        # per item (post-item): the running per-key prefix max of
+        # (ts - wait), floored by the carried base advanced with
+        # system time.  Vectorized with one accumulate per key in
+        # the batch.
+        eff = ts_us - spec.wait_us
+        wm_rows = np.empty(len(ts_us), dtype=np.float64)
+        for kid in np.unique(kids):
+            rows = kids == kid
+            carry = self.base_us[kid] + (now_us - self.sys_at_base[kid])
+            prefix = np.maximum.accumulate(eff[rows])
+            wm_rows[rows] = np.maximum(prefix, carry)
+            new_base = prefix[-1]
+            if new_base > self.base_us[kid]:
+                self.base_us[kid] = new_base
+                self.sys_at_base[kid] = now_us
+        late_mask = ts_us < wm_rows
+
+        events: List[Tuple[str, Tuple[int, str, Any]]] = []
+        if late_mask.any():
+            late_rows = np.nonzero(late_mask)[0]
+            wid_hi = np.floor(
+                (ts_us[late_rows] - spec.align_us) / spec.offset_us
+            ).astype(np.int64)
+            for i, row in zip(range(len(late_rows)), late_rows):
+                key = self.keys[int(kids[row])]
+                ts_row = ts_us[row]
+                for wid in range(
+                    int(wid_hi[i]) - self.expand + 1, int(wid_hi[i]) + 1
+                ):
+                    # Same in-window bound as the on-time path; for
+                    # offsets that don't divide length, not every wid
+                    # in the static range contains the timestamp.
+                    if (
+                        ts_row
+                        < spec.align_us
+                        + wid * spec.offset_us
+                        + spec.length_us
+                    ):
+                        events.append((key, (wid, "L", values[row])))
+
+        ok = ~late_mask
+        if ok.any():
+            kids_ok = kids[ok]
+            ts_ok = ts_us[ok]
+            if spec.kind == "count":
+                vals_ok = np.ones(int(ok.sum()), dtype=np.float64)
+            else:
+                vals_ok = np.asarray(values, dtype=np.float64)[ok]
+            hi = np.floor(
+                (ts_ok - spec.align_us) / spec.offset_us
+            ).astype(np.int64)
+            if len(hi) and int(np.abs(hi).max()) >= (1 << 31) - self.expand:
+                msg = (
+                    "window ids exceed the composite encoding range; "
+                    "move align_to closer to the event times or use a "
+                    "larger window offset"
+                )
+                raise ValueError(msg)
+
+            # Expand each row into the (static count of) windows that
+            # contain it, all vectorized.
+            e = np.arange(self.expand, dtype=np.int64)
+            wids = hi[:, None] - e[None, :]  # [n, expand]
+            in_window = (
+                ts_ok[:, None]
+                < spec.align_us + wids * spec.offset_us + spec.length_us
+            )
+            kid_rep = np.broadcast_to(kids_ok[:, None], wids.shape)[in_window]
+            wid_flat = wids[in_window]
+            val_rep = np.broadcast_to(vals_ok[:, None], wids.shape)[in_window]
+
+            # Composite (key, window) ids; python work only per NEW
+            # composite, per-row mapping is pure numpy.
+            comp = (kid_rep << 32) + (wid_flat + (1 << 31))
+            uniq, inverse = np.unique(comp, return_inverse=True)
+            slot_of_uniq = np.empty(len(uniq), dtype=np.int32)
+            for j, c in enumerate(uniq.tolist()):
+                kid = c >> 32
+                wid = (c & ((1 << 32) - 1)) - (1 << 31)
+                slot_of_uniq[j] = self.agg.alloc(
+                    f"{self.keys[kid]}\x00{wid}"
+                )
+                if (kid, wid) not in self.open_close_us:
+                    self.open_close_us[(kid, wid)] = (
+                        spec.align_us
+                        + wid * spec.offset_us
+                        + spec.length_us
+                    )
+            if len(comp):
+                self.agg.update_slots(slot_of_uniq[inverse], val_rep)
+
+        events.extend(self._close_due(now_us))
+        return events
+
+    def _close_due(self, now_us: float) -> List[Tuple[str, Tuple[int, str, Any]]]:
+        if not self.open_close_us:
+            return []
+        due = []
+        for (kid, wid), close_us in self.open_close_us.items():
+            wm = self.base_us[kid] + (now_us - self.sys_at_base[kid])
+            if close_us <= wm:
+                due.append((kid, wid, close_us))
+        if not due:
+            return []
+        events = []
+        snaps = self.agg.snapshots_for(
+            [f"{self.keys[kid]}\x00{wid}" for kid, wid, _ in due]
+        )
+        from bytewax_tpu.operators.windowing import WindowMetadata
+
+        for (kid, wid, close_us), (_ck, snap) in zip(due, snaps):
+            key = self.keys[kid]
+            value = self._finalize_one(snap)
+            del self.open_close_us[(kid, wid)]
+            self.agg.discard(f"{key}\x00{wid}")
+            events.append((key, (wid, "E", value)))
+            open_dt = datetime.fromtimestamp(
+                (close_us - self.spec.length_us) / _US, tz=timezone.utc
+            )
+            close_dt = datetime.fromtimestamp(close_us / _US, tz=timezone.utc)
+            events.append(
+                (key, (wid, "M", WindowMetadata(open_dt, close_dt)))
+            )
+        return events
+
+    def _finalize_one(self, snap: Any) -> Any:
+        kind = self.spec.kind
+        if snap is None:
+            return 0 if kind == "count" else None
+        if kind == "count":
+            return int(snap)
+        if kind == "mean":
+            total, count = snap
+            return total / count if count else 0.0
+        return snap
+
+    def on_notify(self) -> List[Tuple[str, Tuple[int, str, Any]]]:
+        now_us = datetime.now(timezone.utc).timestamp() * _US
+        return self._close_due(now_us)
+
+    def on_eof(self) -> List[Tuple[str, Tuple[int, str, Any]]]:
+        return self._close_due(np.inf)
+
+    def notify_at(self) -> Optional[datetime]:
+        """System time of the earliest window close: the instant the
+        key's watermark reaches the close time."""
+        best: Optional[float] = None
+        for (kid, wid), close_us in self.open_close_us.items():
+            if not np.isfinite(self.base_us[kid]):
+                continue
+            at = self.sys_at_base[kid] + (close_us - self.base_us[kid])
+            if best is None or at < best:
+                best = at
+        if best is None:
+            return None
+        return datetime.fromtimestamp(best / _US, tz=timezone.utc)
+
+    # -- recovery ----------------------------------------------------------
+
+    def snapshots_for(self, keys: List[str]):
+        """Host-tier ``_WindowSnapshot``-compatible snapshots; a key
+        with no open windows snapshots as a discard (the host tier
+        discards empty window logics the same way)."""
+        from bytewax_tpu.operators.windowing import (
+            WindowMetadata,
+            _EventClockState,
+            _SlidingWindowerState,
+            _WindowSnapshot,
+        )
+
+        out = []
+        for key in keys:
+            kid = self.key_ids.get(key)
+            if kid is None or not any(
+                k2 == kid for (k2, _w) in self.open_close_us
+            ):
+                out.append((key, None))
+                continue
+            opened = {}
+            comps = []
+            wids = []
+            for (k2, wid), close_us in self.open_close_us.items():
+                if k2 == kid:
+                    open_dt = datetime.fromtimestamp(
+                        (close_us - self.spec.length_us) / _US,
+                        tz=timezone.utc,
+                    )
+                    close_dt = datetime.fromtimestamp(
+                        close_us / _US, tz=timezone.utc
+                    )
+                    opened[wid] = WindowMetadata(open_dt, close_dt)
+                    comps.append(f"{key}\x00{wid}")
+                    wids.append(wid)
+            states = dict(
+                zip(wids, (s for _c, s in self.agg.snapshots_for(comps)))
+            )
+            base = self.base_us[kid]
+            clock_state = _EventClockState(
+                system_time_of_max_event=datetime.fromtimestamp(
+                    self.sys_at_base[kid] / _US, tz=timezone.utc
+                ),
+                watermark_base=(
+                    datetime.fromtimestamp(base / _US, tz=timezone.utc)
+                    if np.isfinite(base)
+                    else datetime.min.replace(tzinfo=timezone.utc)
+                ),
+            )
+            out.append(
+                (
+                    key,
+                    _WindowSnapshot(
+                        clock_state,
+                        _SlidingWindowerState(opened=opened),
+                        states,
+                        [],
+                    ),
+                )
+            )
+        return out
+
+    def load(self, key: str, snap: Any) -> None:
+        """Resume from a host-tier ``_WindowSnapshot``."""
+        kids = self._key_ids_for([key])
+        kid = int(kids[0])
+        cs = snap.clock_state
+        if cs is not None:
+            self.base_us[kid] = _to_us(cs.watermark_base)
+            self.sys_at_base[kid] = _to_us(cs.system_time_of_max_event)
+        for wid, meta in snap.windower_state.opened.items():
+            self.open_close_us[(kid, wid)] = _to_us(meta.close_time)
+        for wid, state in snap.logic_states.items():
+            self.agg.load(f"{key}\x00{wid}", state)

@@ -106,7 +106,9 @@ class DeviceAggState:
         self.sharding = sharding
         self.capacity = _MIN_CAPACITY
         self.key_to_slot: Dict[str, int] = {}
-        self.slot_keys: List[str] = []
+        self.slot_keys: List[Optional[str]] = []
+        self._free: List[int] = []
+        self._pending_reset: List[int] = []
         self.dtype = jnp.float32
         self._fields = None  # lazy until first update/load
         # Dictionary-encoded fast path: external id -> slot table,
@@ -127,6 +129,9 @@ class DeviceAggState:
                     k: jax.device_put(v, self.sharding)
                     for k, v in self._fields.items()
                 }
+            self._pending_reset.clear()
+        else:
+            self._apply_resets()
 
     def _grow_to(self, needed: int) -> None:
         new_cap = self.capacity
@@ -151,21 +156,60 @@ class DeviceAggState:
         self._fields = grown
         self.capacity = new_cap
 
+    def alloc(self, key: str) -> int:
+        """Assign (or return) the slot for a key, reusing freed slots."""
+        slot = self.key_to_slot.get(key)
+        if slot is not None:
+            return slot
+        if self._free:
+            slot = self._free.pop()
+            self._pending_reset.append(slot)
+            self.slot_keys[slot] = key
+        else:
+            self._grow_to(len(self.slot_keys) + 2)
+            slot = len(self.slot_keys)
+            self.slot_keys.append(key)
+        self.key_to_slot[key] = slot
+        return slot
+
+    def discard(self, key: str) -> None:
+        """Release a key's slot for reuse (its state is reset when the
+        slot is reallocated)."""
+        slot = self.key_to_slot.pop(key, None)
+        if slot is not None:
+            self.slot_keys[slot] = None  # type: ignore[call-overload]
+            self._free.append(slot)
+
+    def _apply_resets(self) -> None:
+        if self._fields is None:
+            self._pending_reset.clear()
+            return
+        if not self._pending_reset:
+            return
+        # Pad to a power of two (repeating the first slot — set is
+        # idempotent) so XLA sees few distinct shapes.
+        n = len(self._pending_reset)
+        padded = 1 << max(3, math.ceil(math.log2(n)))
+        slots_np = np.full(padded, self._pending_reset[0], dtype=np.int32)
+        slots_np[:n] = self._pending_reset
+        slots = jnp.asarray(slots_np)
+        for name, (init, _op) in self.kind.fields.items():
+            self._fields[name] = self._fields[name].at[slots].set(init)
+        self._pending_reset.clear()
+
     def _slots_for(self, keys: np.ndarray) -> np.ndarray:
         uniq, inverse = np.unique(keys, return_inverse=True)
         slot_of_uniq = np.empty(len(uniq), dtype=np.int32)
-        new_count = sum(1 for k in uniq if k not in self.key_to_slot)
-        if new_count:
-            self._grow_to(len(self.key_to_slot) + new_count + 1)
         for j, k in enumerate(uniq):
-            k = str(k)
-            slot = self.key_to_slot.get(k)
-            if slot is None:
-                slot = len(self.slot_keys)
-                self.key_to_slot[k] = slot
-                self.slot_keys.append(k)
-            slot_of_uniq[j] = slot
+            slot_of_uniq[j] = self.alloc(str(k))
         return slot_of_uniq[inverse]
+
+    def update_slots(self, slot_ids: np.ndarray, values: np.ndarray) -> None:
+        """Fold rows into pre-allocated slots (fast path for callers
+        managing their own key→slot mapping via :meth:`alloc`)."""
+        self._pick_dtype(values)
+        self._ensure_fields()
+        self._scatter(slot_ids.astype(np.int32), values)
 
     # -- updates -----------------------------------------------------------
 
@@ -262,17 +306,10 @@ class DeviceAggState:
         uniq = np.nonzero(counts)[0]
         new = uniq[self._ext_to_slot[uniq] < 0]
         if len(new) or self._dev_map is None:
-            self._grow_to(len(self.key_to_slot) + len(new) + 1)
             for ext in new.tolist():
                 key = str(self._ext_vocab[ext])
-                # Recovery resume may have assigned this key a slot
-                # already (by name); reuse it.
-                slot = self.key_to_slot.get(key)
-                if slot is None:
-                    slot = len(self.slot_keys)
-                    self.key_to_slot[key] = slot
-                    self.slot_keys.append(key)
-                self._ext_to_slot[ext] = slot
+                # alloc reuses a recovery-resumed slot if one exists.
+                self._ext_to_slot[ext] = self.alloc(key)
             # Rebuild the device table: unseen ids and the padding
             # sentinel (index len(vocab)) route to the scratch slot.
             table = np.append(self._ext_to_slot, -1)
@@ -416,4 +453,4 @@ class DeviceAggState:
         return out
 
     def keys(self) -> List[str]:
-        return list(self.slot_keys)
+        return [k for k in self.slot_keys if k is not None]

@@ -1061,9 +1061,21 @@ def count_window(
 ) -> WindowOut[X, int]:
     """Count occurrences of items per key per window.
 
+    Columnar batches carrying ``"key"`` + ``"ts"`` columns pass
+    through keying untouched and count on device with no per-row
+    Python (see ``bytewax_tpu/engine/window_accel.py``).
+
     Reference parity: ``windowing.py:1579``.
     """
-    keyed = op.key_on("keyed", up, key)
+
+    def shim_keyed(xs):
+        from bytewax_tpu.engine.arrays import ArrayBatch
+
+        if isinstance(xs, ArrayBatch):
+            return xs  # already keyed (columnar)
+        return [(key(x), x) for x in xs]
+
+    keyed = op.flat_map_batch("keyed", up, shim_keyed)
     return fold_window(
         "fold_window",
         keyed,
