@@ -1103,9 +1103,12 @@ class _Driver:
                             self._progressed = True
 
                 for rt in self.rts:
-                    rt.drain()
+                    # Due timers fire before newly-arrived data (the
+                    # reference's activate_after wakeups run as soon
+                    # as due, ahead of later input).
                     if not (clustered and self._holding):
                         rt.advance(now)
+                    rt.drain()
                     if (
                         not clustered
                         and not rt.eof

@@ -1,0 +1,158 @@
+"""Remaining operator semantics (models:
+``/root/reference/pytests/operators/test_collect.py``,
+``test_enrich_cached.py``; inputs helper tests)."""
+
+import time
+from datetime import datetime, timedelta, timezone
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.operators import TTLCache
+from bytewax_tpu.testing import (
+    TestingSink,
+    TestingSource,
+    TimeTestingGetter,
+    run_main,
+)
+
+
+def test_collect_max_size(entry_point):
+    inp = [("k", i) for i in range(7)]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    c = op.collect("collect", s, timeout=timedelta(seconds=10), max_size=3)
+    op.output("out", c, TestingSink(out))
+    entry_point(flow)
+    # Size-triggered flushes of 3, then the remainder at EOF.
+    assert out == [
+        ("k", [0, 1, 2]),
+        ("k", [3, 4, 5]),
+        ("k", [6]),
+    ]
+
+
+def test_collect_timeout():
+    # A mid-stream pause longer than the timeout flushes the batch.
+    inp = [
+        ("k", 0),
+        ("k", 1),
+        TestingSource.PAUSE(for_duration=timedelta(seconds=1.2)),
+        ("k", 2),
+    ]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    c = op.collect("collect", s, timeout=timedelta(seconds=0.5), max_size=10)
+    op.output("out", c, TestingSink(out))
+    run_main(flow)
+    assert out == [("k", [0, 1]), ("k", [2])]
+
+
+def test_enrich_cached_caches_within_ttl():
+    calls = []
+
+    def getter(k):
+        calls.append(k)
+        return k.upper()
+
+    fake = TimeTestingGetter(datetime(2022, 1, 1, tzinfo=timezone.utc))
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(["a", "a", "b"]))
+    e = op.enrich_cached(
+        "enrich",
+        s,
+        getter,
+        lambda cache, x: (x, cache.get(x)),
+        ttl=timedelta(minutes=1),
+        _now_getter=fake.get,
+    )
+    op.output("out", e, TestingSink(out))
+    run_main(flow)
+    assert out == [("a", "A"), ("a", "A"), ("b", "B")]
+    assert calls == ["a", "b"]  # second "a" served from cache
+
+
+def test_ttl_cache_expiry():
+    calls = []
+    fake = TimeTestingGetter(datetime(2022, 1, 1, tzinfo=timezone.utc))
+    cache = TTLCache(lambda k: calls.append(k) or len(calls), fake.get, timedelta(seconds=30))
+    assert cache.get("x") == 1
+    assert cache.get("x") == 1
+    fake.advance(timedelta(seconds=31))
+    assert cache.get("x") == 2  # expired, re-fetched
+    cache.remove("x")
+    assert cache.get("x") == 3
+
+
+def test_pause_sentinel_delays_items():
+    inp = [1, TestingSource.PAUSE(for_duration=timedelta(seconds=0.5)), 2]
+    stamps = []
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.map("stamp", s, lambda x: (stamps.append(time.monotonic()), x)[1])
+    op.output("out", s, TestingSink(out))
+    run_main(flow)
+    assert out == [1, 2]
+    assert stamps[1] - stamps[0] >= 0.45
+
+
+def test_batch_helpers():
+    from bytewax_tpu.inputs import batch, batch_getter, batch_getter_ex
+
+    assert list(batch(iter(range(5)), 2)) == [[0, 1], [2, 3], [4]]
+
+    items = iter([1, 2, None, 3])
+    g = batch_getter(lambda: next(items), 10)
+    assert next(g) == [1, 2]
+
+    items2 = iter([1, 2])
+
+    def getter_ex():
+        try:
+            return next(items2)
+        except StopIteration:
+            raise IndexError() from None
+
+    g2 = batch_getter_ex(getter_ex, 10)
+    assert next(g2) == [1, 2]
+
+
+def test_batch_async():
+    import asyncio
+
+    from bytewax_tpu.inputs import batch_async
+
+    async def agen():
+        for i in range(5):
+            yield i
+
+    batches = list(batch_async(agen(), timeout=timedelta(seconds=1), batch_size=2))
+    assert [b for b in batches if b] == [[0, 1], [2, 3], [4]]
+
+
+def test_then_returns_chainable_windowout():
+    # `.then` through an operator returning a dataclass bundle.
+    from datetime import datetime, timezone
+
+    import bytewax_tpu.operators.windowing as w
+    from bytewax_tpu.operators.windowing import EventClock, TumblingWindower
+
+    align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    out = []
+    flow = Dataflow("test_df")
+    wo = (
+        op.input("inp", flow, TestingSource([align]))
+        .then(op.key_on, "key", lambda _x: "ALL")
+        .then(
+            w.collect_window,
+            "cw",
+            EventClock(ts_getter=lambda x: x, wait_for_system_duration=timedelta(0)),
+            TumblingWindower(length=timedelta(minutes=1), align_to=align),
+        )
+    )
+    op.output("out", wo.down, TestingSink(out))
+    run_main(flow)
+    assert len(out) == 1

@@ -1,0 +1,177 @@
+"""Stateful logic lifecycle state machine (model:
+``/root/reference/pytests/operators/test_stateful.py``): every hook
+emits its state transition; class flags control retention."""
+
+from datetime import datetime, timedelta, timezone
+from typing import Any, List, Optional, Tuple
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.operators import StatefulLogic
+from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+ZERO_TD = timedelta(seconds=0)
+
+
+class BaseTestLogic(StatefulLogic):
+    item_triggers_notify = False
+    after_item = StatefulLogic.RETAIN
+    after_notify = StatefulLogic.RETAIN
+    after_eof = StatefulLogic.RETAIN
+
+    def __init__(self, state: Any):
+        self._notify_at: Optional[datetime] = None
+        self._state = state if state is not None else "NEW"
+
+    def on_item(self, value: Any) -> Tuple[List[Any], bool]:
+        if self.item_triggers_notify:
+            self._notify_at = datetime.now(timezone.utc)
+        old_state = self._state
+        self._state = "ITEM"
+        return ([(old_state, self._state)], self.after_item)
+
+    def on_notify(self) -> Tuple[List[Any], bool]:
+        self._notify_at = None
+        old_state = self._state
+        self._state = "NOTIFY"
+        return ([(old_state, self._state)], self.after_notify)
+
+    def on_eof(self) -> Tuple[List[Any], bool]:
+        old_state = self._state
+        self._state = "EOF"
+        return ([(old_state, self._state)], self.after_eof)
+
+    def notify_at(self) -> Optional[datetime]:
+        return self._notify_at
+
+    def snapshot(self) -> Any:
+        return self._state
+
+
+def _run(logic_cls, inp):
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.key_on("key", s, lambda _x: "ALL")
+    s = op.stateful("stateful", s, logic_cls)
+    op.output("out", s, TestingSink(out))
+    run_main(flow, epoch_interval=ZERO_TD)
+    return out
+
+
+def test_stateful_on_item_discard():
+    class TestLogic(BaseTestLogic):
+        after_item = StatefulLogic.DISCARD
+
+    out = _run(TestLogic, [1, 2, TestingSource.ABORT()])
+    # Discard after each item: the logic is rebuilt fresh every time.
+    assert out == [
+        ("ALL", ("NEW", "ITEM")),
+        ("ALL", ("NEW", "ITEM")),
+    ]
+
+
+def test_stateful_on_item_retain():
+    class TestLogic(BaseTestLogic):
+        after_item = StatefulLogic.RETAIN
+
+    out = _run(TestLogic, [1, 2, TestingSource.ABORT()])
+    assert out == [
+        ("ALL", ("NEW", "ITEM")),
+        ("ALL", ("ITEM", "ITEM")),
+    ]
+
+
+def test_stateful_on_notify_discard():
+    class TestLogic(BaseTestLogic):
+        item_triggers_notify = True
+        after_notify = StatefulLogic.DISCARD
+
+    out = _run(TestLogic, [1, 2, TestingSource.ABORT()])
+    assert out == [
+        ("ALL", ("NEW", "ITEM")),
+        ("ALL", ("ITEM", "NOTIFY")),
+        ("ALL", ("NEW", "ITEM")),
+        ("ALL", ("ITEM", "NOTIFY")),
+    ]
+
+
+def test_stateful_on_notify_retain():
+    class TestLogic(BaseTestLogic):
+        item_triggers_notify = True
+        after_notify = StatefulLogic.RETAIN
+
+    out = _run(TestLogic, [1, 2, TestingSource.ABORT()])
+    assert out == [
+        ("ALL", ("NEW", "ITEM")),
+        ("ALL", ("ITEM", "NOTIFY")),
+        ("ALL", ("NOTIFY", "ITEM")),
+        ("ALL", ("ITEM", "NOTIFY")),
+    ]
+
+
+def _run_with_recovery(logic_cls, inp, recovery_config):
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.key_on("key", s, lambda _x: "ALL")
+    s = op.stateful("stateful", s, logic_cls)
+    op.output("out", s, TestingSink(out))
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    return out
+
+
+def test_stateful_on_eof_discard(recovery_config):
+    # Reference pattern (test_stateful.py:151-170): a recovery
+    # continuation past EOF() proves the discard was durable — the
+    # resumed item sees a fresh logic.
+    class TestLogic(BaseTestLogic):
+        after_eof = StatefulLogic.DISCARD
+
+    inp = [1, TestingSource.EOF(), 2, TestingSource.ABORT()]
+    out = _run_with_recovery(TestLogic, inp, recovery_config)
+    assert out == [
+        ("ALL", ("NEW", "ITEM")),
+        ("ALL", ("ITEM", "EOF")),
+    ]
+    out2 = _run_with_recovery(TestLogic, inp, recovery_config)
+    assert out2 == [("ALL", ("NEW", "ITEM"))]
+
+
+def test_stateful_on_eof_retain(recovery_config):
+    # The continuation's item must see the state retained across EOF.
+    class TestLogic(BaseTestLogic):
+        after_eof = StatefulLogic.RETAIN
+
+    inp = [1, TestingSource.EOF(), 2, TestingSource.ABORT()]
+    out = _run_with_recovery(TestLogic, inp, recovery_config)
+    assert out == [
+        ("ALL", ("NEW", "ITEM")),
+        ("ALL", ("ITEM", "EOF")),
+    ]
+    out2 = _run_with_recovery(TestLogic, inp, recovery_config)
+    assert out2 == [("ALL", ("EOF", "ITEM"))]
+
+
+def test_stateful_resume_state_passed_to_builder(recovery_config):
+    class TestLogic(BaseTestLogic):
+        after_item = StatefulLogic.RETAIN
+
+    inp = [1, TestingSource.ABORT(), 2]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.key_on("key", s, lambda _x: "ALL")
+    s = op.stateful("stateful", s, TestLogic)
+    op.output("out", s, TestingSink(out))
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    assert out == [("ALL", ("NEW", "ITEM"))]
+
+    out.clear()
+    run_main(flow, epoch_interval=ZERO_TD, recovery_config=recovery_config)
+    # The snapshotted state "ITEM" is passed to the rebuilt logic;
+    # this run exhausts the input so EOF also fires.
+    assert out == [
+        ("ALL", ("ITEM", "ITEM")),
+        ("ALL", ("ITEM", "EOF")),
+    ]
