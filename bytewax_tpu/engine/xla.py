@@ -261,7 +261,9 @@ class DeviceAggState:
         slots_p[:n] = slot_ids
         vals_p = np.zeros(padded, dtype=np.dtype(self.dtype))
         vals_p[:n] = values
-        self._fields = update_fields(
+        from bytewax_tpu.ops.pallas_fold import maybe_update_fields
+
+        self._fields = maybe_update_fields(
             self.kind,
             self._fields,
             jax.device_put(slots_p),

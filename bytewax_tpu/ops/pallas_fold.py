@@ -1,0 +1,164 @@
+"""Pallas TPU kernel for the keyed segment fold.
+
+The default device fold is an XLA scatter-combine
+(``ops/segment.py``), which XLA lowers well but serializes on slot
+collisions.  This kernel instead reduces each row tile against the
+whole slot table with a masked VPU reduction (one-hot compare +
+reduce) — collision-free, VMEM-resident, and tiled to the (8, 128)
+VPU lanes — then combines tiles into the accumulator across grid
+steps.  See ``/opt/skills/guides/pallas_guide.md`` for the kernel
+idioms used.
+
+Enable with ``BYTEWAX_TPU_PALLAS=1`` (falls back to interpret mode on
+CPU, so tests exercise the same kernel).  Best for slot tables up to a
+few thousand keys, where ``TILE × capacity`` masks fit comfortably in
+VMEM.
+"""
+
+import functools
+import os
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from bytewax_tpu.ops.segment import AggKind
+
+__all__ = ["enabled", "fold_partials", "update_fields_pallas"]
+
+_TILE = 512
+#: Max slot-table size for the one-hot strategy (TILE×CAP f32 mask in
+#: VMEM: 512×4096×4B = 8MB, within a v5e core's 16MB less headroom).
+_MAX_CAP = 4096
+
+
+def enabled() -> bool:
+    return os.environ.get("BYTEWAX_TPU_PALLAS") == "1"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fold_kernel(op_name: str, init: float, slots_ref, vals_ref, out_ref):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[:, :] = jnp.full_like(out_ref, init)
+
+    slots = slots_ref[:, :]  # [1, TILE] int32
+    vals = vals_ref[:, :]  # [1, TILE] f32
+    cap = out_ref.shape[1]
+    # [TILE, cap] one-hot mask: row r contributes to column slots[r].
+    hit = slots.reshape(_TILE, 1) == jax.lax.broadcasted_iota(
+        jnp.int32, (_TILE, cap), 1
+    )
+    contrib = vals.reshape(_TILE, 1)
+    if op_name == "add":
+        tile_part = jnp.sum(jnp.where(hit, contrib, 0.0), axis=0)
+        out_ref[0, :] += tile_part
+    elif op_name == "min":
+        tile_part = jnp.min(
+            jnp.where(hit, contrib, jnp.inf), axis=0
+        )
+        out_ref[0, :] = jnp.minimum(out_ref[0, :], tile_part)
+    else:  # max
+        tile_part = jnp.max(
+            jnp.where(hit, contrib, -jnp.inf), axis=0
+        )
+        out_ref[0, :] = jnp.maximum(out_ref[0, :], tile_part)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("op_name", "init", "capacity")
+)
+def fold_partials(
+    op_name: str,
+    init: float,
+    capacity: int,
+    slots: jax.Array,
+    values: jax.Array,
+) -> jax.Array:
+    """Reduce ``(slot, value)`` rows into per-slot partials of shape
+    ``[capacity]`` with the Pallas kernel.
+
+    ``slots``/``values`` must be padded to a multiple of the tile with
+    padding rows pointing at ``capacity - 1`` (the scratch slot).
+    """
+    n = slots.shape[0]
+    assert n % _TILE == 0, "pad rows to the kernel tile"
+    grid = n // _TILE
+    out = pl.pallas_call(
+        functools.partial(_fold_kernel, op_name, init),
+        out_shape=jax.ShapeDtypeStruct((1, capacity), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, _TILE), lambda i: (0, i)),
+            pl.BlockSpec((1, _TILE), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, capacity), lambda i: (0, 0)),
+        interpret=_interpret(),
+    )(
+        slots.reshape(1, n).astype(jnp.int32),
+        values.reshape(1, n).astype(jnp.float32),
+    )
+    return out[0]
+
+
+@functools.partial(jax.jit, static_argnames=("kind",), donate_argnums=(1,))
+def update_fields_pallas(
+    kind: AggKind,
+    state: Dict[str, jax.Array],
+    slot_ids: jax.Array,
+    values: jax.Array,
+) -> Dict[str, jax.Array]:
+    """Drop-in alternative to ``segment.update_fields`` built on the
+    Pallas fold.  Padding rows must target the scratch slot
+    (``capacity - 1``), which is reset to the identity afterwards."""
+    capacity = next(iter(state.values())).shape[0]
+    n = slot_ids.shape[0]
+    pad = (-n) % _TILE
+    if pad:
+        scratch = jnp.full((pad,), capacity - 1, dtype=slot_ids.dtype)
+        slot_ids = jnp.concatenate([slot_ids, scratch])
+        values = jnp.concatenate(
+            [values, jnp.zeros((pad,), dtype=values.dtype)]
+        )
+    out = {}
+    for name, (init, op_name) in kind.fields.items():
+        contrib = (
+            jnp.ones_like(values, dtype=jnp.float32)
+            if name == "count"
+            else values.astype(jnp.float32)
+        )
+        partial = fold_partials(op_name, init, capacity, slot_ids, contrib)
+        arr = state[name]
+        if op_name == "add":
+            merged = arr + partial.astype(arr.dtype)
+        elif op_name == "min":
+            merged = jnp.minimum(arr, partial.astype(arr.dtype))
+        else:
+            merged = jnp.maximum(arr, partial.astype(arr.dtype))
+        # The scratch slot absorbed padding rows; restore identity.
+        out[name] = merged.at[capacity - 1].set(
+            jnp.asarray(init, dtype=merged.dtype)
+        )
+    return out
+
+
+def fits(capacity: int) -> bool:
+    return capacity <= _MAX_CAP
+
+
+def maybe_update_fields(kind, state, slot_ids, values):
+    """Dispatch to the Pallas kernel when enabled and the table fits,
+    else the XLA scatter path."""
+    from bytewax_tpu.ops.segment import update_fields
+
+    capacity = next(iter(state.values())).shape[0]
+    if enabled() and fits(capacity):
+        return update_fields_pallas(kind, state, slot_ids, values)
+    return update_fields(kind, state, slot_ids, values)
