@@ -4,15 +4,16 @@ The default device fold is an XLA scatter-combine
 (``ops/segment.py``), which XLA lowers well but serializes on slot
 collisions.  This kernel instead reduces each row tile against the
 whole slot table with a masked VPU reduction (one-hot compare +
-reduce) — collision-free, VMEM-resident, and tiled to the (8, 128)
-VPU lanes — then combines tiles into the accumulator across grid
-steps.  See ``/opt/skills/guides/pallas_guide.md`` for the kernel
-idioms used.
+reduce) — collision-free, VMEM-resident, and tiled to the VPU lanes —
+computing every aggregation field of the kind in one pass over a
+single mask, then combines tiles into the accumulator across grid
+steps.
 
-Enable with ``BYTEWAX_TPU_PALLAS=1`` (falls back to interpret mode on
-CPU, so tests exercise the same kernel).  Best for slot tables up to a
-few thousand keys, where ``TILE × capacity`` masks fit comfortably in
-VMEM.
+Enable with ``BYTEWAX_TPU_PALLAS=1`` (on non-TPU backends the same
+kernel runs in interpret mode, so tests exercise it).  Scope: float32
+accumulators with slot tables up to a few thousand keys (the
+``TILE × capacity`` mask must fit in VMEM); integer states and the
+dictionary-encoded/packed wire paths keep the exact XLA scatter.
 """
 
 import functools
@@ -21,12 +22,11 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 
 from bytewax_tpu.ops.segment import AggKind
 
-__all__ = ["enabled", "fold_partials", "update_fields_pallas"]
+__all__ = ["enabled", "fits", "maybe_update_fields", "update_fields_pallas"]
 
 _TILE = 512
 #: Max slot-table size for the one-hot strategy (TILE×CAP f32 mask in
@@ -42,70 +42,36 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fold_kernel(op_name: str, init: float, slots_ref, vals_ref, out_ref):
+def _fold_kernel(field_ops, slots_ref, vals_ref, out_ref):
+    """``field_ops`` is a static tuple of (field_index, op_name,
+    init, is_count); the one-hot mask is built once and reused for
+    every field."""
     step = pl.program_id(0)
 
     @pl.when(step == 0)
     def _init():
-        out_ref[:, :] = jnp.full_like(out_ref, init)
+        for idx, _op, init, _is_count in field_ops:
+            out_ref[idx, :] = jnp.full_like(out_ref[idx, :], init)
 
     slots = slots_ref[:, :]  # [1, TILE] int32
     vals = vals_ref[:, :]  # [1, TILE] f32
     cap = out_ref.shape[1]
-    # [TILE, cap] one-hot mask: row r contributes to column slots[r].
     hit = slots.reshape(_TILE, 1) == jax.lax.broadcasted_iota(
         jnp.int32, (_TILE, cap), 1
     )
     contrib = vals.reshape(_TILE, 1)
-    if op_name == "add":
-        tile_part = jnp.sum(jnp.where(hit, contrib, 0.0), axis=0)
-        out_ref[0, :] += tile_part
-    elif op_name == "min":
-        tile_part = jnp.min(
-            jnp.where(hit, contrib, jnp.inf), axis=0
-        )
-        out_ref[0, :] = jnp.minimum(out_ref[0, :], tile_part)
-    else:  # max
-        tile_part = jnp.max(
-            jnp.where(hit, contrib, -jnp.inf), axis=0
-        )
-        out_ref[0, :] = jnp.maximum(out_ref[0, :], tile_part)
-
-
-@functools.partial(
-    jax.jit, static_argnames=("op_name", "init", "capacity")
-)
-def fold_partials(
-    op_name: str,
-    init: float,
-    capacity: int,
-    slots: jax.Array,
-    values: jax.Array,
-) -> jax.Array:
-    """Reduce ``(slot, value)`` rows into per-slot partials of shape
-    ``[capacity]`` with the Pallas kernel.
-
-    ``slots``/``values`` must be padded to a multiple of the tile with
-    padding rows pointing at ``capacity - 1`` (the scratch slot).
-    """
-    n = slots.shape[0]
-    assert n % _TILE == 0, "pad rows to the kernel tile"
-    grid = n // _TILE
-    out = pl.pallas_call(
-        functools.partial(_fold_kernel, op_name, init),
-        out_shape=jax.ShapeDtypeStruct((1, capacity), jnp.float32),
-        grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((1, _TILE), lambda i: (0, i)),
-            pl.BlockSpec((1, _TILE), lambda i: (0, i)),
-        ],
-        out_specs=pl.BlockSpec((1, capacity), lambda i: (0, 0)),
-        interpret=_interpret(),
-    )(
-        slots.reshape(1, n).astype(jnp.int32),
-        values.reshape(1, n).astype(jnp.float32),
-    )
-    return out[0]
+    ones = jnp.ones((_TILE, 1), dtype=jnp.float32)
+    for idx, op_name, _init, is_count in field_ops:
+        c = ones if is_count else contrib
+        if op_name == "add":
+            part = jnp.sum(jnp.where(hit, c, 0.0), axis=0)
+            out_ref[idx, :] += part
+        elif op_name == "min":
+            part = jnp.min(jnp.where(hit, c, jnp.inf), axis=0)
+            out_ref[idx, :] = jnp.minimum(out_ref[idx, :], part)
+        else:  # max
+            part = jnp.max(jnp.where(hit, c, -jnp.inf), axis=0)
+            out_ref[idx, :] = jnp.maximum(out_ref[idx, :], part)
 
 
 @functools.partial(jax.jit, static_argnames=("kind",), donate_argnums=(1,))
@@ -116,8 +82,9 @@ def update_fields_pallas(
     values: jax.Array,
 ) -> Dict[str, jax.Array]:
     """Drop-in alternative to ``segment.update_fields`` built on the
-    Pallas fold.  Padding rows must target the scratch slot
-    (``capacity - 1``), which is reset to the identity afterwards."""
+    Pallas fold (float32 accumulators only).  Padding rows must target
+    the scratch slot (``capacity - 1``), which is reset to the
+    identity afterwards."""
     capacity = next(iter(state.values())).shape[0]
     n = slot_ids.shape[0]
     pad = (-n) % _TILE
@@ -127,15 +94,36 @@ def update_fields_pallas(
         values = jnp.concatenate(
             [values, jnp.zeros((pad,), dtype=values.dtype)]
         )
+    n_padded = slot_ids.shape[0]
+    grid = n_padded // _TILE
+
+    names = list(kind.fields)
+    field_ops = tuple(
+        (i, kind.fields[name][1], float(kind.fields[name][0]), name == "count")
+        for i, name in enumerate(names)
+    )
+    partials = pl.pallas_call(
+        functools.partial(_fold_kernel, field_ops),
+        out_shape=jax.ShapeDtypeStruct((len(names), capacity), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, _TILE), lambda i: (0, i)),
+            pl.BlockSpec((1, _TILE), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (len(names), capacity), lambda i: (0, 0)
+        ),
+        interpret=_interpret(),
+    )(
+        slot_ids.reshape(1, n_padded).astype(jnp.int32),
+        values.reshape(1, n_padded).astype(jnp.float32),
+    )
+
     out = {}
-    for name, (init, op_name) in kind.fields.items():
-        contrib = (
-            jnp.ones_like(values, dtype=jnp.float32)
-            if name == "count"
-            else values.astype(jnp.float32)
-        )
-        partial = fold_partials(op_name, init, capacity, slot_ids, contrib)
+    for i, name in enumerate(names):
+        init, op_name = kind.fields[name]
         arr = state[name]
+        partial = partials[i]
         if op_name == "add":
             merged = arr + partial.astype(arr.dtype)
         elif op_name == "min":
@@ -154,11 +142,16 @@ def fits(capacity: int) -> bool:
 
 
 def maybe_update_fields(kind, state, slot_ids, values):
-    """Dispatch to the Pallas kernel when enabled and the table fits,
-    else the XLA scatter path."""
+    """Dispatch to the Pallas kernel when enabled, the table fits, and
+    the accumulator is float32 (integer folds stay on the exact XLA
+    scatter — the f32 mask path would round values above 2^24)."""
     from bytewax_tpu.ops.segment import update_fields
 
-    capacity = next(iter(state.values())).shape[0]
-    if enabled() and fits(capacity):
+    first = next(iter(state.values()))
+    if (
+        enabled()
+        and fits(first.shape[0])
+        and first.dtype == jnp.float32
+    ):
         return update_fields_pallas(kind, state, slot_ids, values)
     return update_fields(kind, state, slot_ids, values)

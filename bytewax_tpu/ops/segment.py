@@ -61,10 +61,23 @@ AGG_KINDS: Dict[str, AggKind] = {
 }
 
 
+def identity_for(init: float, dtype) -> jax.Array:
+    """The fold identity as a value of the accumulator dtype
+    (±inf saturates to the integer min/max for integer dtypes)."""
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        if init == float("inf"):
+            return jnp.asarray(info.max, dtype=dtype)
+        if init == float("-inf"):
+            return jnp.asarray(info.min, dtype=dtype)
+        return jnp.asarray(int(init), dtype=dtype)
+    return jnp.asarray(init, dtype=dtype)
+
+
 def init_fields(kind: AggKind, capacity: int, dtype=jnp.float32):
     """Fresh state arrays for ``capacity`` slots."""
     return {
-        name: jnp.full((capacity,), init, dtype=dtype)
+        name: jnp.full((capacity,), identity_for(init, dtype), dtype=dtype)
         for name, (init, _op) in kind.fields.items()
     }
 
@@ -88,13 +101,17 @@ def update_fields(
     out = {}
     for name, (init, op_name) in kind.fields.items():
         arr = state[name]
+        # Identities in the accumulator dtype: a weak-float identity
+        # would promote integer values through f32 and round them.
+        ident = identity_for(init, arr.dtype)
+        zero = jnp.zeros((), dtype=arr.dtype)
         if name == "count":
-            contrib = jnp.where(valid, 1.0, 0.0).astype(arr.dtype)
+            one = jnp.ones((), dtype=arr.dtype)
+            contrib = jnp.where(valid, one, zero)
         else:
-            contrib = jnp.where(valid, values, init).astype(arr.dtype)
+            contrib = jnp.where(valid, values.astype(arr.dtype), ident)
         ref = arr.at[slot_ids]
         if op_name == "add":
-            zero = jnp.zeros((), dtype=arr.dtype)
             out[name] = ref.add(jnp.where(valid, contrib, zero))
         elif op_name == "min":
             out[name] = ref.min(contrib)

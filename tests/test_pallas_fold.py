@@ -53,3 +53,16 @@ def test_pallas_engine_end_to_end(monkeypatch):
     op.output("out", s, TestingSink(out))
     run_main(flow)
     assert sorted(out) == [("apple", 2), ("banana", 3)]
+
+
+def test_pallas_int_state_falls_back_to_exact_scatter(monkeypatch):
+    # Integer accumulators must keep exact scatter semantics even with
+    # the Pallas kernel enabled (f32 masks round above 2^24).
+    monkeypatch.setenv("BYTEWAX_TPU_PALLAS", "1")
+    from bytewax_tpu.engine.xla import DeviceAggState
+
+    agg = DeviceAggState("sum")
+    big = 20_000_001  # not representable in f32
+    agg.update(np.array(["k"]), np.array([big], dtype=np.int32))
+    agg.update(np.array(["k"]), np.array([big], dtype=np.int32))
+    assert dict(agg.finalize())["k"] == 2 * big
