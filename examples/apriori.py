@@ -1,0 +1,32 @@
+"""Frequent-itemset counting over basket streams
+(reference: examples/apriori.py shape): count single items and pairs
+with the device-accelerated counter."""
+
+from itertools import combinations
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSource
+
+baskets = [
+    ["milk", "bread"],
+    ["milk", "eggs"],
+    ["bread", "eggs", "milk"],
+    ["eggs"],
+]
+
+
+def itemsets(basket):
+    items = sorted(set(basket))
+    for item in items:
+        yield (item,)
+    yield from combinations(items, 2)
+
+
+flow = Dataflow("apriori")
+s = op.input("inp", flow, TestingSource(baskets))
+sets_ = op.flat_map("itemsets", s, itemsets)
+counts = op.count_final("count", sets_, lambda iset: "+".join(iset))
+frequent = op.filter("frequent", counts, lambda kv: kv[1] >= 2)
+op.output("out", frequent, StdOutSink())

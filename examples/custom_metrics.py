@@ -1,0 +1,27 @@
+"""Register custom Prometheus metrics from user code
+(reference: examples/custom_metrics.py). With
+BYTEWAX_DATAFLOW_API_ENABLED=1 they appear at GET /metrics."""
+
+from prometheus_client import Histogram
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSource
+
+value_hist = Histogram(
+    "example_value",
+    "Distribution of input values",
+    buckets=(1, 2, 5, 10),
+)
+
+
+def observe(x):
+    value_hist.observe(x)
+    return x
+
+
+flow = Dataflow("custom_metrics")
+s = op.input("inp", flow, TestingSource([1, 3, 7, 12]))
+s = op.map("observe", s, observe)
+op.output("out", s, StdOutSink())

@@ -1,0 +1,18 @@
+"""Configure logging/tracing (reference: examples/tracing.py).
+
+Point OtlpTracingConfig at a collector to export spans; without one,
+spans log locally at DEBUG.
+"""
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.connectors.stdio import StdOutSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSource
+from bytewax_tpu.tracing import setup_tracing
+
+tracer = setup_tracing(log_level="DEBUG")
+
+flow = Dataflow("tracing_example")
+s = op.input("inp", flow, TestingSource(range(5)))
+s = op.map("double", s, lambda x: x * 2)
+op.output("out", s, StdOutSink())
