@@ -492,8 +492,15 @@ class _StatefulBatchRt(_OpRt):
 
     def _process_window_accel(self, entries: List[Entry]) -> None:
         assert self.wagg is not None
-        for _w, items in entries:
-            if isinstance(items, ArrayBatch) and "ts" in items.cols:
+        for i, (_w, items) in enumerate(entries):
+            if (
+                isinstance(items, ArrayBatch)
+                and "ts" in items.cols
+                and (
+                    self.wagg.spec.kind == "count"
+                    or "value" in items.cols
+                )
+            ):
                 try:
                     events = self.wagg.on_batch_columnar(items)
                 except BaseException as ex:  # noqa: BLE001
@@ -502,6 +509,19 @@ class _StatefulBatchRt(_OpRt):
                     )
                 self._emit_window_events(events)
                 continue
+            if (
+                self.wagg.spec.kind != "count"
+                and self.wagg.is_empty()
+                and not self.logics
+            ):
+                # Numeric windowed folds only run on device for
+                # columnar key/ts/value batches; itemized deliveries
+                # can't promise timestamp-bearing numeric values, so
+                # permanently fall back to the host tier before any
+                # device state exists.
+                self.wagg = None
+                self.process("up", entries[i:])
+                return
             if isinstance(items, ArrayBatch):
                 items = items.to_pylist()
             keys: List[str] = []

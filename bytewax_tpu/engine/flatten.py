@@ -35,7 +35,7 @@ def _annotate_accel(op: Operator) -> None:
         spec = AccelSpec(op.conf["reducer"].kind)
     elif op.name == "stats_final":
         spec = AccelSpec("stats")
-    elif op.name == "count_window":
+    elif op.name in ("count_window", "fold_window", "reduce_window"):
         spec = _window_accel_spec(op)
     if spec is not None:
         inner = _find_core_stateful(op)
@@ -44,15 +44,18 @@ def _annotate_accel(op: Operator) -> None:
 
 
 def _window_accel_spec(op: Operator):
-    """Device lowering for windowed counting over EventClock +
+    """Device lowering for windowed folds over EventClock +
     tumbling/sliding windows.
 
-    Counting is the one windowed fold where acceleration is always
-    sound: the timestamp comes from the full item and the folded
-    "value" is a constant 1 (numeric folds of the values themselves
-    would need the values to be both numeric and timestamp-bearing,
-    which this API cannot promise statically — those stay on the host
-    tier).  Sessions and custom/fake clocks also stay host-side.
+    ``count_window`` always lowers (the folded "value" is a constant
+    1, so only the item's timestamp matters).  Numeric folds
+    (``fold_window``/``reduce_window`` with a marked
+    ``bytewax_tpu.xla`` reducer) lower too, but only columnar batches
+    carrying explicit ``key``/``ts``/``value`` columns run on device
+    — itemized deliveries can't statically promise numeric,
+    timestamp-bearing values, so the runtime falls back to the host
+    tier on first contact with them.  Sessions and custom/fake clocks
+    always stay host-side.
     """
     from bytewax_tpu.engine.window_accel import WindowAccelSpec
     from bytewax_tpu.operators import _get_system_utc, _identity
@@ -61,8 +64,28 @@ def _window_accel_spec(op: Operator):
         SlidingWindower,
         TumblingWindower,
     )
+    from bytewax_tpu.xla import Reducer
 
-    kind = "count"
+    if op.name == "count_window":
+        kind = "count"
+    elif op.name == "reduce_window" and isinstance(
+        op.conf.get("reducer"), Reducer
+    ):
+        kind = op.conf["reducer"].kind
+    elif op.name == "fold_window" and isinstance(
+        op.conf.get("folder"), Reducer
+    ):
+        kind = op.conf["folder"].kind
+        # The device fold starts from the kind's identity; a builder
+        # with any other initial accumulator must stay host-side.
+        identity = {"sum": 0, "min": float("inf"), "max": float("-inf")}
+        try:
+            if op.conf["builder"]() != identity.get(kind):
+                return None
+        except Exception:  # noqa: BLE001
+            return None
+    else:
+        return None
     clock = op.conf.get("clock")
     windower = op.conf.get("windower")
     if not isinstance(clock, EventClock):

@@ -122,10 +122,11 @@ class DeviceWindowAggState:
     # -- processing --------------------------------------------------------
 
     def on_batch_columnar(self, batch) -> List[Tuple[str, Tuple[int, str, Any]]]:
-        """Columnar fast path: a batch with a ``"key"`` column and a
-        ``"ts"`` column (``np.datetime64`` or int64 microseconds since
-        the epoch) counts into windows with no per-row Python.  Late
-        rows are reported with their timestamp as the value."""
+        """Columnar fast path: a batch with ``"key"`` and ``"ts"``
+        columns (``np.datetime64`` or int64 microseconds since the
+        epoch), plus a ``"value"`` column for numeric folds, runs with
+        no per-row Python.  Late rows are reported with their value
+        (counting: their timestamp)."""
         keys_col = batch.numpy("key")
         uniq_keys, inverse = np.unique(keys_col, return_inverse=True)
         kid_of_uniq = self._key_ids_for([str(k) for k in uniq_keys])
@@ -137,7 +138,17 @@ class DeviceWindowAggState:
             )
         else:
             ts_us = ts_col.astype(np.float64)
-        return self._ingest(kids, ts_us, _LateTs(ts_us))
+        if self.spec.kind == "count":
+            return self._ingest(kids, ts_us, _LateTs(ts_us))
+        # Keep the column's dtype: integer folds stay exact (the slot
+        # table's _pick_dtype handles int32 and rejects wider ints).
+        vals = batch.numpy("value")
+        if batch.value_scale is not None:
+            vals = (vals * batch.value_scale).astype(np.float32)
+        return self._ingest(kids, ts_us, vals)
+
+    def is_empty(self) -> bool:
+        return not self.open_close_us and not self.keys and not self.touched
 
     def on_batch(
         self, keys: List[str], values: List[Any]
@@ -210,7 +221,7 @@ class DeviceWindowAggState:
             if spec.kind == "count":
                 vals_ok = np.ones(int(ok.sum()), dtype=np.float64)
             else:
-                vals_ok = np.asarray(values, dtype=np.float64)[ok]
+                vals_ok = np.asarray(values)[ok]  # keep dtype for exact ints
             hi = np.floor(
                 (ts_ok - spec.align_us) / spec.offset_us
             ).astype(np.int64)
