@@ -926,6 +926,12 @@ class _Driver:
         self._progressed = True
 
     def _close_epoch(self, workers: Optional[range] = None) -> None:
+        from bytewax_tpu.tracing import span
+
+        with span("epoch_close", epoch=self.epoch):
+            self._close_epoch_inner(workers)
+
+    def _close_epoch_inner(self, workers: Optional[range] = None) -> None:
         if self.store is not None:
             snaps: List[Tuple[str, str, Optional[bytes]]] = []
             for rt in self.rts:
