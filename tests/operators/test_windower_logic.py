@@ -1,0 +1,107 @@
+"""Direct unit tests of windower/clock logic classes (model:
+``/root/reference/pytests/operators/windowing/test_session_windower.py``
+etc. — the reference tests logic classes directly as well as through
+dataflows)."""
+
+from datetime import datetime, timedelta, timezone
+
+from bytewax_tpu.operators.windowing import (
+    LATE_SESSION_ID,
+    SessionWindower,
+    SlidingWindower,
+    TumblingWindower,
+)
+
+ALIGN = datetime(2022, 1, 1, tzinfo=timezone.utc)
+
+
+def _t(seconds):
+    return ALIGN + timedelta(seconds=seconds)
+
+
+def test_sliding_intersecting_boundaries():
+    logic = SlidingWindower(
+        length=timedelta(seconds=10),
+        offset=timedelta(seconds=5),
+        align_to=ALIGN,
+    ).build(None)
+    # Exactly at a window open: belongs to it and the previous one.
+    assert logic.intersecting_ids(_t(10)) == [1, 2]
+    # Exactly at a close boundary: excluded from the closing window.
+    assert 0 not in logic.intersecting_ids(_t(10))
+    # Mid-window.
+    assert logic.intersecting_ids(_t(7)) == [0, 1]
+    # Before align_to: negative ids.
+    assert logic.intersecting_ids(_t(-3)) == [-2, -1]
+
+
+def test_tumbling_open_close_metadata():
+    logic = TumblingWindower(
+        length=timedelta(minutes=1), align_to=ALIGN
+    ).build(None)
+    (wid,) = logic.open_for(_t(30))
+    assert wid == 0
+    closed = logic.close_for(_t(59))
+    assert closed == []  # close time is exclusive
+    closed = logic.close_for(_t(60))
+    assert [w for w, _m in closed] == [0]
+    meta = closed[0][1]
+    assert meta.open_time == ALIGN
+    assert meta.close_time == _t(60)
+    assert logic.is_empty()
+
+
+def test_session_grows_and_merges():
+    logic = SessionWindower(gap=timedelta(seconds=5)).build(None)
+    (a,) = logic.open_for(_t(0))
+    (b,) = logic.open_for(_t(20))
+    assert a != b
+    # Within the gap after session a: extends it, then merges with b
+    # if boundaries now touch (they don't yet).
+    (a2,) = logic.open_for(_t(4))
+    assert a2 == a
+    assert list(logic.merged()) == []
+    # Bridge the two sessions.
+    (bridge,) = logic.open_for(_t(16))
+    assert bridge == b  # lands in the gap before b, extending it
+    # Extends a to close=12; b now opens at 16, within the 5s gap.
+    (bridge2,) = logic.open_for(_t(12))
+    merges = list(logic.merged())
+    # Session b (later open) merged into session a.
+    assert merges == [(b, a)]
+    # The surviving session spans 0..20.
+    closed = logic.close_for(_t(100))
+    assert [w for w, _m in closed] == [a]
+    meta = closed[0][1]
+    assert meta.open_time == _t(0)
+    assert meta.close_time == _t(20)
+    assert meta.merged_ids == {b}
+
+
+def test_session_never_reuses_ids():
+    logic = SessionWindower(gap=timedelta(seconds=1)).build(None)
+    (a,) = logic.open_for(_t(0))
+    logic.close_for(_t(100))
+    (b,) = logic.open_for(_t(200))
+    assert b != a
+    assert not logic.is_empty()  # sessions never report empty
+
+
+def test_session_late_sentinel():
+    logic = SessionWindower(gap=timedelta(seconds=1)).build(None)
+    assert list(logic.late_for(_t(0))) == [LATE_SESSION_ID]
+
+
+def test_sliding_snapshot_roundtrip():
+    windower = SlidingWindower(
+        length=timedelta(seconds=10),
+        offset=timedelta(seconds=5),
+        align_to=ALIGN,
+    )
+    logic = windower.build(None)
+    logic.open_for(_t(7))
+    snap = logic.snapshot()
+    resumed = windower.build(snap)
+    assert resumed.notify_at() == logic.notify_at()
+    closed = resumed.close_for(_t(100))
+    assert sorted(w for w, _m in closed) == [0, 1]
