@@ -61,11 +61,14 @@ def test_session_grows_and_merges():
     (a2,) = logic.open_for(_t(4))
     assert a2 == a
     assert list(logic.merged()) == []
-    # Bridge the two sessions.
-    (bridge,) = logic.open_for(_t(16))
-    assert bridge == b  # lands in the gap before b, extending it
-    # Extends a to close=12; b now opens at 16, within the 5s gap.
-    (bridge2,) = logic.open_for(_t(12))
+    # Pull b's open down to 16, then extend a's close 4 → 9 → 13; at
+    # that point b's open (16) is within the 5s gap and they merge.
+    (pre,) = logic.open_for(_t(16))
+    assert pre == b
+    (bridge,) = logic.open_for(_t(9))
+    assert bridge == a
+    (bridge2,) = logic.open_for(_t(13))
+    assert bridge2 == a
     merges = list(logic.merged())
     # Session b (later open) merged into session a.
     assert merges == [(b, a)]
