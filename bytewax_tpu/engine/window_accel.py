@@ -93,6 +93,9 @@ class DeviceWindowAggState:
         self.open_close_us: Dict[Tuple[int, int], float] = {}
         #: Keys touched since the last epoch snapshot.
         self.touched: set = set()
+        # Cached (kids, wids, closes) arrays over open_close_us;
+        # invalidated whenever the open-window set changes.
+        self._open_cache = None
 
     # -- clock -------------------------------------------------------------
 
@@ -173,22 +176,32 @@ class DeviceWindowAggState:
             self.keys[int(k)] for k in np.unique(kids)
         )
 
-        # Per-row watermark exactly as the host tier computes it
-        # per item (post-item): the running per-key prefix max of
+        # Per-row watermark exactly as the host tier computes it per
+        # item (post-item): the running per-key prefix max of
         # (ts - wait), floored by the carried base advanced with
-        # system time.  Vectorized with one accumulate per key in
-        # the batch.
+        # system time.  Group rows by key with one stable sort, then
+        # run one accumulate per contiguous segment — O(n log n), not
+        # O(keys × rows).
         eff = ts_us - spec.wait_us
-        wm_rows = np.empty(len(ts_us), dtype=np.float64)
-        for kid in np.unique(kids):
-            rows = kids == kid
+        n = len(ts_us)
+        order = np.argsort(kids, kind="stable")
+        kids_sorted = kids[order]
+        eff_sorted = eff[order]
+        seg_kids, seg_starts = np.unique(kids_sorted, return_index=True)
+        seg_ends = np.append(seg_starts[1:], n)
+        wm_sorted = np.empty(n, dtype=np.float64)
+        for kid, lo, hi in zip(
+            seg_kids.tolist(), seg_starts.tolist(), seg_ends.tolist()
+        ):
             carry = self.base_us[kid] + (now_us - self.sys_at_base[kid])
-            prefix = np.maximum.accumulate(eff[rows])
-            wm_rows[rows] = np.maximum(prefix, carry)
+            prefix = np.maximum.accumulate(eff_sorted[lo:hi])
+            np.maximum(prefix, carry, out=wm_sorted[lo:hi])
             new_base = prefix[-1]
             if new_base > self.base_us[kid]:
                 self.base_us[kid] = new_base
                 self.sys_at_base[kid] = now_us
+        wm_rows = np.empty(n, dtype=np.float64)
+        wm_rows[order] = wm_sorted
         late_mask = ts_us < wm_rows
 
         events: List[Tuple[str, Tuple[int, str, Any]]] = []
@@ -262,22 +275,43 @@ class DeviceWindowAggState:
                         + wid * spec.offset_us
                         + spec.length_us
                     )
+                    self._open_cache = None
             if len(comp):
                 self.agg.update_slots(slot_of_uniq[inverse], val_rep)
 
         events.extend(self._close_due(now_us))
         return events
 
+    def _open_arrays(self):
+        """Cached parallel arrays of the open-window table so the
+        per-batch due check is vectorized (a Python loop here is
+        O(keys × windows) per batch at high cardinality)."""
+        if self._open_cache is None:
+            items = list(self.open_close_us.items())
+            kids = np.fromiter(
+                (k for (k, _w), _c in items), dtype=np.int64, count=len(items)
+            )
+            wids = np.fromiter(
+                (w for (_k, w), _c in items), dtype=np.int64, count=len(items)
+            )
+            closes = np.fromiter(
+                (c for _kw, c in items), dtype=np.float64, count=len(items)
+            )
+            self._open_cache = (kids, wids, closes)
+        return self._open_cache
+
     def _close_due(self, now_us: float) -> List[Tuple[str, Tuple[int, str, Any]]]:
         if not self.open_close_us:
             return []
-        due = []
-        for (kid, wid), close_us in self.open_close_us.items():
-            wm = self.base_us[kid] + (now_us - self.sys_at_base[kid])
-            if close_us <= wm:
-                due.append((kid, wid, close_us))
-        if not due:
+        kids_arr, wids_arr, closes_arr = self._open_arrays()
+        wm = self.base_us[kids_arr] + (now_us - self.sys_at_base[kids_arr])
+        due_rows = np.nonzero(closes_arr <= wm)[0]
+        if not len(due_rows):
             return []
+        due = [
+            (int(kids_arr[i]), int(wids_arr[i]), float(closes_arr[i]))
+            for i in due_rows
+        ]
         events = []
         snaps = self.agg.snapshots_for(
             [f"{self.keys[kid]}\x00{wid}" for kid, wid, _ in due]
@@ -297,6 +331,7 @@ class DeviceWindowAggState:
             events.append(
                 (key, (wid, "M", WindowMetadata(open_dt, close_dt)))
             )
+        self._open_cache = None
         return events
 
     def _finalize_one(self, snap: Any) -> Any:
@@ -320,16 +355,17 @@ class DeviceWindowAggState:
     def notify_at(self) -> Optional[datetime]:
         """System time of the earliest window close: the instant the
         key's watermark reaches the close time."""
-        best: Optional[float] = None
-        for (kid, wid), close_us in self.open_close_us.items():
-            if not np.isfinite(self.base_us[kid]):
-                continue
-            at = self.sys_at_base[kid] + (close_us - self.base_us[kid])
-            if best is None or at < best:
-                best = at
-        if best is None:
+        if not self.open_close_us:
             return None
-        return datetime.fromtimestamp(best / _US, tz=timezone.utc)
+        kids_arr, _wids_arr, closes_arr = self._open_arrays()
+        bases = self.base_us[kids_arr]
+        finite = np.isfinite(bases)
+        if not finite.any():
+            return None
+        ats = self.sys_at_base[kids_arr][finite] + (
+            closes_arr[finite] - bases[finite]
+        )
+        return datetime.fromtimestamp(float(ats.min()) / _US, tz=timezone.utc)
 
     # -- recovery ----------------------------------------------------------
 
@@ -404,5 +440,6 @@ class DeviceWindowAggState:
             self.sys_at_base[kid] = _to_us(cs.system_time_of_max_event)
         for wid, meta in snap.windower_state.opened.items():
             self.open_close_us[(kid, wid)] = _to_us(meta.close_time)
+        self._open_cache = None
         for wid, state in snap.logic_states.items():
             self.agg.load(f"{key}\x00{wid}", state)

@@ -355,3 +355,44 @@ def test_windowed_fold_nonconforming_columnar_falls_back(monkeypatch):
     op.output("out", wo.down, TestingSink(out))
     run_main(flow)
     assert out == [("k", (0, ALIGN + timedelta(seconds=2)))]
+
+
+def test_high_cardinality_windowed_count(monkeypatch):
+    # 20k keys with open windows: the per-batch due check must stay
+    # vectorized (this is a smoke bound, not a benchmark).
+    import time
+
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
+    from bytewax_tpu.engine.arrays import ArrayBatch
+    from tests.test_xla import ArraySource
+
+    n_keys = 20_000
+    rows_per_batch = n_keys
+    n_batches = 5
+    keys = np.array([f"key{i:05d}" for i in range(n_keys)])
+    batches = []
+    for b in range(n_batches):
+        ts = (
+            np.datetime64(ALIGN.replace(tzinfo=None), "us")
+            + np.full(rows_per_batch, b, dtype=np.int64).astype(
+                "timedelta64[s]"
+            )
+        )
+        batches.append(ArrayBatch({"key": keys, "ts": ts}))
+
+    clock = EventClock(
+        ts_getter=lambda item: item,
+        wait_for_system_duration=timedelta(seconds=60),
+    )
+    windower = TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN)
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, ArraySource(batches))
+    wo = w.count_window("count", s, clock, windower, key=lambda item: item)
+    op.output("out", wo.down, TestingSink(out))
+    t0 = time.monotonic()
+    run_main(flow)
+    elapsed = time.monotonic() - t0
+    assert len(out) == n_keys
+    assert all(c == n_batches for _k, (_w, c) in out)
+    assert elapsed < 30, f"high-cardinality run too slow: {elapsed:.1f}s"
