@@ -857,12 +857,9 @@ class _Driver:
         # is busy or absent; host-tier flows don't need it).
         plat = os.environ.get("BYTEWAX_TPU_PLATFORM")
         if plat:
-            import jax
+            from bytewax_tpu.utils import force_platform
 
-            try:
-                jax.config.update("jax_platforms", plat)
-            except Exception:  # noqa: BLE001 — already initialized
-                pass
+            force_platform(plat)
 
         self.store: Optional[RecoveryStore] = None
         self._loads: Dict[Tuple[str, str], bytes] = {}

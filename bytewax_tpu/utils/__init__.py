@@ -1,10 +1,79 @@
 """Small shared helpers."""
 
+import os
+import re
 from typing import Callable, Iterable, List, Tuple, TypeVar
 
 X = TypeVar("X")
 
-__all__ = ["partition"]
+__all__ = ["force_cpu_mesh", "force_platform", "partition"]
+
+
+def force_platform(platform: str, n_devices=None) -> None:
+    """Steer jax onto ``platform`` before it initializes a backend.
+
+    Sets both the ``JAX_PLATFORMS`` environment variable and the
+    ``jax_platforms`` config flag because either alone can lose to a
+    pre-registered backend factory (a site hook may register an
+    accelerator whose tunnel hangs jax init; merely having ``jax`` in
+    ``sys.modules`` is fine — the backend is created lazily on the
+    first device query). With ``n_devices``, also requests that many
+    virtual host-platform devices via ``XLA_FLAGS``, upgrading an
+    inherited smaller count.
+
+    Best-effort: does NOT query devices, so it never triggers backend
+    init itself and silently has no effect if a backend already came
+    up. Use :func:`force_cpu_mesh` when the caller needs the result
+    verified.
+    """
+    os.environ["JAX_PLATFORMS"] = platform
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        opt = "--xla_force_host_platform_device_count="
+        m = re.search(re.escape(opt) + r"(\d+)", flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (flags + f" {opt}{n_devices}").strip()
+        elif int(m.group(1)) < n_devices:
+            os.environ["XLA_FLAGS"] = (
+                flags[: m.start()] + f"{opt}{n_devices}" + flags[m.end() :]
+            )
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+    except Exception:  # noqa: BLE001 — backend already initialized
+        pass
+
+
+def force_cpu_mesh(n_devices: int) -> None:
+    """Force jax onto the CPU backend with ``n_devices`` virtual
+    devices, verifying the result.
+
+    Must run before jax initializes a backend; raises if a backend
+    already came up on a non-CPU platform or with too few devices
+    (this check itself triggers backend init, which is the point —
+    fail loudly here rather than hang later).
+    """
+    force_platform("cpu", n_devices)
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform != "cpu":
+        msg = (
+            f"jax backend already initialized on {platform!r}; "
+            "force_cpu_mesh must run before any jax device query"
+        )
+        raise RuntimeError(msg)
+    avail = jax.device_count()
+    if avail < n_devices:
+        msg = (
+            f"virtual CPU mesh has {avail} devices, need {n_devices}; "
+            "jax initialized before force_cpu_mesh could set XLA_FLAGS "
+            f"(flags now: {os.environ['XLA_FLAGS']!r})"
+        )
+        raise RuntimeError(msg)
 
 
 def partition(

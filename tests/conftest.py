@@ -3,23 +3,12 @@
 (single lane, cluster with 1 lane, cluster with 2 lanes), and device
 tests run on a virtual 8-device CPU mesh."""
 
-import os
-
 # Force a deterministic virtual 8-device CPU mesh for all tests BEFORE
-# jax initializes (override any inherited platform setting, e.g. a
-# tunneled TPU); real TPU runs use bench.py / run.py directly.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# jax initializes a backend (override any inherited platform setting,
+# e.g. a tunneled TPU); real TPU runs use bench.py / run.py directly.
+from bytewax_tpu.utils import force_cpu_mesh
 
-import jax  # noqa: E402
-
-# Site hooks may pre-register an accelerator backend regardless of the
-# env var; the config flag wins as long as no backend was touched yet.
-jax.config.update("jax_platforms", "cpu")
+force_cpu_mesh(8)
 
 from datetime import datetime, timezone  # noqa: E402
 
