@@ -7,11 +7,45 @@ need items expand it with :meth:`to_pylist`; device-tier operators
 consume the columns directly.
 """
 
+from datetime import datetime
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["ArrayBatch"]
+__all__ = ["ArrayBatch", "TsValue", "column_ts"]
+
+
+class TsValue(float):
+    """Degrade payload for ``{key, ts, value}`` columnar rows: a float
+    that also carries the row's event timestamp as ``.ts``.
+
+    Arithmetic (fold/reduce) yields plain floats, so host-tier
+    reducers consume it unchanged; event-time clocks read the
+    timestamp via :func:`column_ts` (or ``lambda v: v.ts``).
+    """
+
+    __slots__ = ("ts",)
+
+    def __new__(cls, value: float, ts: datetime) -> "TsValue":
+        self = super().__new__(cls, value)
+        self.ts = ts
+        return self
+
+    def __reduce__(self):
+        # Default float pickling drops the ts attribute.
+        return (TsValue, (float(self), self.ts))
+
+
+def column_ts(value: Any) -> datetime:
+    """The ts getter for columnar flows that may degrade to items: a
+    ``{key, ts}`` batch degrades to timestamp values (returned as-is)
+    and a ``{key, ts, value}`` batch to :class:`TsValue` (read
+    ``.ts``).  On the device tier the ``ts`` column is used directly
+    and this getter is never called.
+    """
+    if isinstance(value, datetime):
+        return value
+    return value.ts
 
 
 class ArrayBatch:
@@ -53,6 +87,29 @@ class ArrayBatch:
     def numpy(self, name: str) -> np.ndarray:
         return np.asarray(self.cols[name])
 
+    def _scaled_values(self) -> np.ndarray:
+        """The ``value`` column with any fixed-point scale applied."""
+        values = np.asarray(self.cols["value"])
+        if self.value_scale is not None:
+            values = values * self.value_scale
+        return values
+
+    def _ts_datetimes(self) -> List[datetime]:
+        """The ``ts`` column as tz-aware datetimes (accepts
+        ``np.datetime64`` or int64/float64 microseconds since epoch)."""
+        from datetime import timezone
+
+        ts = np.asarray(self.cols["ts"])
+        if np.issubdtype(ts.dtype, np.datetime64):
+            return [
+                t.replace(tzinfo=timezone.utc)
+                for t in ts.astype("datetime64[us]").tolist()
+            ]
+        return [
+            datetime.fromtimestamp(t / 1e6, tz=timezone.utc)
+            for t in ts.astype(np.float64).tolist()
+        ]
+
     def to_pylist(self) -> List[Any]:
         """Expand to Python items for host-tier consumers.
 
@@ -65,37 +122,27 @@ class ArrayBatch:
             # Columnar windowed-event batches degrade to (key,
             # timestamp) items so the host tier (and cluster
             # exchange) key them correctly; ts getters must accept
-            # datetime values in columnar flows.
-            from datetime import timezone
-
+            # datetime values in columnar flows (see `column_ts`).
             keys = np.asarray(self.cols["key"]).tolist()
-            ts = np.asarray(self.cols["ts"])
-            if np.issubdtype(ts.dtype, np.datetime64):
-                stamps = [
-                    t.replace(tzinfo=timezone.utc)
-                    for t in ts.astype("datetime64[us]").tolist()
-                ]
-            else:
-                from datetime import datetime
-
-                stamps = [
-                    datetime.fromtimestamp(t / 1e6, tz=timezone.utc)
-                    for t in ts.astype(np.float64).tolist()
-                ]
-            return list(zip(keys, stamps))
+            return list(zip(keys, self._ts_datetimes()))
+        if names == {"key", "ts", "value"}:
+            # Numeric windowed-fold batches degrade to (key, TsValue)
+            # items: the payload folds as a plain float and carries
+            # the row's timestamp for `column_ts` getters.
+            keys = np.asarray(self.cols["key"]).tolist()
+            stamps = self._ts_datetimes()
+            values = self._scaled_values()
+            return [
+                (k, TsValue(v, t))
+                for k, v, t in zip(keys, values.tolist(), stamps)
+            ]
         if names == {"key_id", "value"} and self.key_vocab is not None:
             vocab = np.asarray(self.key_vocab)
             keys = vocab[np.asarray(self.cols["key_id"])].tolist()
-            values = np.asarray(self.cols["value"])
-            if self.value_scale is not None:
-                values = values * self.value_scale
-            return list(zip(keys, values.tolist()))
+            return list(zip(keys, self._scaled_values().tolist()))
         if names == {"key", "value"}:
             keys = np.asarray(self.cols["key"]).tolist()
-            values = np.asarray(self.cols["value"])
-            if self.value_scale is not None:
-                values = values * self.value_scale
-            return list(zip(keys, values.tolist()))
+            return list(zip(keys, self._scaled_values().tolist()))
         arrays = [np.asarray(c).tolist() for c in self.cols.values()]
         if len(arrays) == 1:
             return arrays[0]

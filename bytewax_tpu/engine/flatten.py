@@ -78,11 +78,22 @@ def _window_accel_spec(op: Operator):
         kind = op.conf["folder"].kind
         # The device fold starts from the kind's identity; a builder
         # with any other initial accumulator must stay host-side.
+        # NOTE: the probe runs the user's builder at plan time — a
+        # builder with side effects observes one extra call.
         identity = {"sum": 0, "min": float("inf"), "max": float("-inf")}
         try:
             if op.conf["builder"]() != identity.get(kind):
                 return None
-        except Exception:  # noqa: BLE001
+        except Exception as ex:  # noqa: BLE001
+            import warnings
+
+            warnings.warn(
+                f"step {op.step_id!r}: probing the window fold builder "
+                f"for device lowering raised {ex!r}; the step stays on "
+                "the host tier",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             return None
     else:
         return None

@@ -235,52 +235,60 @@ class DeviceWindowAggState:
                 vals_ok = np.ones(int(ok.sum()), dtype=np.float64)
             else:
                 vals_ok = np.asarray(values)[ok]  # keep dtype for exact ints
-            hi = np.floor(
-                (ts_ok - spec.align_us) / spec.offset_us
-            ).astype(np.int64)
-            if len(hi) and int(np.abs(hi).max()) >= (1 << 31) - self.expand:
-                msg = (
-                    "window ids exceed the composite encoding range; "
-                    "move align_to closer to the event times or use a "
-                    "larger window offset"
-                )
-                raise ValueError(msg)
-
-            # Expand each row into the (static count of) windows that
-            # contain it, all vectorized.
-            e = np.arange(self.expand, dtype=np.int64)
-            wids = hi[:, None] - e[None, :]  # [n, expand]
-            in_window = (
-                ts_ok[:, None]
-                < spec.align_us + wids * spec.offset_us + spec.length_us
-            )
-            kid_rep = np.broadcast_to(kids_ok[:, None], wids.shape)[in_window]
-            wid_flat = wids[in_window]
-            val_rep = np.broadcast_to(vals_ok[:, None], wids.shape)[in_window]
-
-            # Composite (key, window) ids; python work only per NEW
-            # composite, per-row mapping is pure numpy.
-            comp = (kid_rep << 32) + (wid_flat + (1 << 31))
-            uniq, inverse = np.unique(comp, return_inverse=True)
-            slot_of_uniq = np.empty(len(uniq), dtype=np.int32)
-            for j, c in enumerate(uniq.tolist()):
-                kid = c >> 32
-                wid = (c & ((1 << 32) - 1)) - (1 << 31)
-                slot_of_uniq[j] = self.agg.alloc(
-                    f"{self.keys[kid]}\x00{wid}"
-                )
-                if (kid, wid) not in self.open_close_us:
-                    self.open_close_us[(kid, wid)] = (
-                        spec.align_us
-                        + wid * spec.offset_us
-                        + spec.length_us
-                    )
-                    self._open_cache = None
-            if len(comp):
-                self.agg.update_slots(slot_of_uniq[inverse], val_rep)
+            self._fold_rows(kids_ok, ts_ok, vals_ok)
 
         events.extend(self._close_due(now_us))
         return events
+
+    def _fold_rows(
+        self, kids_ok: np.ndarray, ts_ok: np.ndarray, vals_ok: np.ndarray
+    ) -> None:
+        """Fold on-time rows into their containing windows (opening
+        windows as needed) — the scatter-combine into the slot table."""
+        spec = self.spec
+        hi = np.floor(
+            (ts_ok - spec.align_us) / spec.offset_us
+        ).astype(np.int64)
+        if len(hi) and int(np.abs(hi).max()) >= (1 << 31) - self.expand:
+            msg = (
+                "window ids exceed the composite encoding range; "
+                "move align_to closer to the event times or use a "
+                "larger window offset"
+            )
+            raise ValueError(msg)
+
+        # Expand each row into the (static count of) windows that
+        # contain it, all vectorized.
+        e = np.arange(self.expand, dtype=np.int64)
+        wids = hi[:, None] - e[None, :]  # [n, expand]
+        in_window = (
+            ts_ok[:, None]
+            < spec.align_us + wids * spec.offset_us + spec.length_us
+        )
+        kid_rep = np.broadcast_to(kids_ok[:, None], wids.shape)[in_window]
+        wid_flat = wids[in_window]
+        val_rep = np.broadcast_to(vals_ok[:, None], wids.shape)[in_window]
+
+        # Composite (key, window) ids; python work only per NEW
+        # composite, per-row mapping is pure numpy.
+        comp = (kid_rep << 32) + (wid_flat + (1 << 31))
+        uniq, inverse = np.unique(comp, return_inverse=True)
+        slot_of_uniq = np.empty(len(uniq), dtype=np.int32)
+        for j, c in enumerate(uniq.tolist()):
+            kid = c >> 32
+            wid = (c & ((1 << 32) - 1)) - (1 << 31)
+            slot_of_uniq[j] = self.agg.alloc(
+                f"{self.keys[kid]}\x00{wid}"
+            )
+            if (kid, wid) not in self.open_close_us:
+                self.open_close_us[(kid, wid)] = (
+                    spec.align_us
+                    + wid * spec.offset_us
+                    + spec.length_us
+                )
+                self._open_cache = None
+        if len(comp):
+            self.agg.update_slots(slot_of_uniq[inverse], val_rep)
 
     def _open_arrays(self):
         """Cached parallel arrays of the open-window table so the
@@ -443,3 +451,24 @@ class DeviceWindowAggState:
         self._open_cache = None
         for wid, state in snap.logic_states.items():
             self.agg.load(f"{key}\x00{wid}", state)
+        # A host-tier ordered=True logic keeps on-time values whose ts
+        # is still ahead of the watermark in `queue`, to apply in
+        # timestamp order once due.  The device tier folds eagerly
+        # (its folds are commutative), so replay them into their
+        # windows now — the host never late-drops queued entries, so
+        # neither do we.  Window closes happen on the next batch /
+        # notify via the restored watermark base.
+        queue = getattr(snap, "queue", None)
+        if queue:
+            ts_q = np.fromiter(
+                (_to_us(ts) for _v, ts in queue),
+                dtype=np.float64,
+                count=len(queue),
+            )
+            if self.spec.kind == "count":
+                vals_q = np.ones(len(queue), dtype=np.float64)
+            else:
+                vals_q = np.asarray([v for v, _ts in queue])
+            self._fold_rows(
+                np.full(len(queue), kid, dtype=np.int64), ts_q, vals_q
+            )

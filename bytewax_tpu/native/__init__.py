@@ -8,7 +8,9 @@ is XLA).
 """
 
 import ctypes
+import hashlib
 import os
+import platform
 import subprocess
 import threading
 from pathlib import Path
@@ -20,36 +22,63 @@ __all__ = ["BrcParser", "is_available", "lib"]
 
 _HERE = Path(__file__).parent
 _SRC = _HERE / "io_native.cpp"
-_LIB = _HERE / "_io_native.so"
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 
 
+def _cache_path(cmd_flags) -> Path:
+    """Cache key = source content + compiler flags + machine arch, so
+    a library built with ``-march=native`` for one arch is never
+    loaded on another (a stale or foreign binary can SIGILL); binaries
+    are gitignored, never shipped."""
+    h = hashlib.sha256()
+    h.update(_SRC.read_bytes())
+    h.update(" ".join(cmd_flags).encode())
+    h.update(platform.machine().encode())
+    return _HERE / f"_io_native-{h.hexdigest()[:12]}.so"
+
+
 def _build() -> Optional[ctypes.CDLL]:
     global _build_error
-    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
-        return ctypes.CDLL(str(_LIB))
-    cmd = [
-        os.environ.get("CXX", "g++"),
+    flags = [
         "-O3",
         "-march=native",
         "-shared",
         "-fPIC",
         "-std=c++17",
+    ]
+    lib_path = _cache_path(flags)
+    if lib_path.exists():
+        return ctypes.CDLL(str(lib_path))
+    # Compile to a per-process temp name and rename into place so a
+    # concurrent lane never CDLLs a half-written file (rename on the
+    # same filesystem is atomic).
+    tmp_path = lib_path.with_suffix(f".{os.getpid()}.tmp.so")
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        *flags,
         str(_SRC),
         "-o",
-        str(_LIB),
+        str(tmp_path),
     ]
     try:
         subprocess.run(
             cmd, check=True, capture_output=True, text=True, timeout=120
         )
+        os.replace(tmp_path, lib_path)
     except (subprocess.CalledProcessError, OSError, subprocess.TimeoutExpired) as ex:
         _build_error = getattr(ex, "stderr", str(ex)) or str(ex)
+        tmp_path.unlink(missing_ok=True)
         return None
-    return ctypes.CDLL(str(_LIB))
+    for stale in _HERE.glob("_io_native-*.so"):
+        if stale != lib_path:
+            try:
+                stale.unlink()
+            except OSError:
+                pass
+    return ctypes.CDLL(str(lib_path))
 
 
 def lib() -> ctypes.CDLL:
@@ -74,7 +103,7 @@ def is_available() -> bool:
     try:
         lib()
         return True
-    except RuntimeError:
+    except (RuntimeError, OSError):
         return False
 
 

@@ -23,10 +23,12 @@ from typing import Any, Callable, Dict, Optional
 import jax
 
 from bytewax_tpu.dataflow import KeyedStream, Stream, operator
-from bytewax_tpu.engine.arrays import ArrayBatch
+from bytewax_tpu.engine.arrays import ArrayBatch, TsValue, column_ts
 
 __all__ = [
     "ArrayBatch",
+    "TsValue",
+    "column_ts",
     "JaxUDF",
     "MAX",
     "MIN",

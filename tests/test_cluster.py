@@ -204,3 +204,106 @@ op.output("out", s, FileSink({out_path!r}))
     res = run_cluster()
     assert res.returncode == 0, res.stderr[-2000:]
     assert sorted(Path(out_path).read_text().split()) == ["a", "b", "c", "d"]
+
+
+@pytest.mark.parametrize("accel", ["0", "1"])
+def test_cluster_columnar_windowed_sum(tmp_path, accel):
+    # A {'key','ts','value'} columnar source in a 2-proc cluster: the
+    # keyed exchange degrades batches to (key, TsValue) items and
+    # ships them to their home lane; window sums must cover every row
+    # on both tiers.
+    flow_py = tmp_path / "colwin_flow.py"
+    out_path = str(tmp_path / "out.txt")
+    flow_py.write_text(
+        f'''
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+
+import bytewax_tpu.operators as op
+import bytewax_tpu.operators.windowing as w
+from bytewax_tpu import xla
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.engine.arrays import ArrayBatch
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+from bytewax_tpu.operators.windowing import EventClock, TumblingWindower
+
+ALIGN = datetime(2022, 1, 1, tzinfo=timezone.utc)
+
+
+class _Part(StatelessSourcePartition):
+    def __init__(self, worker_index):
+        self._batches = []
+        if worker_index == 0:
+            n = 400
+            rng = np.random.RandomState(0)
+            secs = np.sort(rng.randint(0, 180, size=n))
+            keys = np.array([f"key{{k}}" for k in rng.randint(0, 8, size=n)])
+            vals = np.ones(n)
+            ts = (
+                np.datetime64("2022-01-01", "us")
+                + secs.astype("timedelta64[s]")
+            )
+            self._batches = [
+                ArrayBatch(
+                    {{
+                        "key": keys[i : i + 128],
+                        "ts": ts[i : i + 128],
+                        "value": vals[i : i + 128],
+                    }}
+                )
+                for i in range(0, n, 128)
+            ]
+
+    def next_batch(self):
+        if not self._batches:
+            raise StopIteration()
+        return self._batches.pop(0)
+
+
+class BatchSource(DynamicSource):
+    def build(self, step_id, worker_index, worker_count):
+        return _Part(worker_index)
+
+
+clock = EventClock(
+    ts_getter=xla.column_ts,
+    wait_for_system_duration=timedelta(seconds=5),
+)
+windower = TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN)
+flow = Dataflow("colwin_df")
+s = op.input("inp", flow, BatchSource())
+wo = w.reduce_window("sum", s, clock, windower, xla.SUM)
+fmt = op.map(
+    "fmt", wo.down, lambda kv: (kv[0], f"{{kv[0]}} {{kv[1][0]}} {{kv[1][1]}}")
+)
+op.output("out", fmt, FileSink({out_path!r}))
+'''
+    )
+    env = _env()
+    env["BYTEWAX_TPU_ACCEL"] = accel
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.testing",
+            f"{flow_py}:flow",
+            "-p",
+            "2",
+        ],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    total = 0.0
+    seen = set()
+    for line in Path(out_path).read_text().splitlines():
+        key, wid, val = line.split()
+        assert (key, wid) not in seen, "duplicate (key, window) emission"
+        seen.add((key, wid))
+        total += float(val)
+    assert total == 400.0

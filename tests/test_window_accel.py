@@ -396,3 +396,115 @@ def test_high_cardinality_windowed_count(monkeypatch):
     assert len(out) == n_keys
     assert all(c == n_batches for _k, (_w, c) in out)
     assert elapsed < 30, f"high-cardinality run too slow: {elapsed:.1f}s"
+
+
+def test_windowed_sum_columnar_degrades_on_host_tier(monkeypatch):
+    # {'key','ts','value'} batches must degrade to (key, TsValue)
+    # items so the host-tier oracle (BYTEWAX_TPU_ACCEL=0) keys, times,
+    # and folds them correctly.
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
+    from bytewax_tpu import xla
+    from bytewax_tpu.engine.arrays import ArrayBatch
+    from tests.test_xla import ArraySource
+
+    secs = np.array([1, 2, 61])
+    keys = np.array(["a", "b", "a"])
+    vals = np.array([2.0, 5.0, 7.0])
+    ts = (
+        np.datetime64(ALIGN.replace(tzinfo=None), "us")
+        + secs.astype("timedelta64[s]")
+    )
+    batches = [ArrayBatch({"key": keys, "ts": ts, "value": vals})]
+    clock = EventClock(
+        ts_getter=xla.column_ts,
+        wait_for_system_duration=timedelta(seconds=5),
+    )
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, ArraySource(batches))
+    wo = w.reduce_window(
+        "sum",
+        s,
+        clock,
+        TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN),
+        xla.SUM,
+    )
+    op.output("out", wo.down, TestingSink(out))
+    run_main(flow)
+    assert sorted(out) == [("a", (0, 2.0)), ("a", (1, 7.0)), ("b", (0, 5.0))]
+
+
+def test_ts_value_degrade_shapes():
+    # The {'key','ts','value'} to_pylist convention: (key, TsValue)
+    # pairs whose payload folds as a float and carries .ts, applying
+    # any fixed-point value_scale; survives pickling (cluster ship).
+    import pickle
+
+    from bytewax_tpu.engine.arrays import ArrayBatch, TsValue, column_ts
+
+    ts = (
+        np.datetime64(ALIGN.replace(tzinfo=None), "us")
+        + np.array([1, 2]).astype("timedelta64[s]")
+    )
+    ab = ArrayBatch(
+        {
+            "key": np.array(["a", "b"]),
+            "ts": ts,
+            "value": np.array([25, -5], dtype=np.int16),
+        },
+        value_scale=0.1,
+    )
+    items = ab.to_pylist()
+    assert [k for k, _v in items] == ["a", "b"]
+    assert [float(v) for _k, v in items] == [2.5, -0.5]
+    assert [column_ts(v) for _k, v in items] == [
+        ALIGN + timedelta(seconds=1),
+        ALIGN + timedelta(seconds=2),
+    ]
+    v2 = pickle.loads(pickle.dumps(items[0][1]))
+    assert isinstance(v2, TsValue)
+    assert (float(v2), v2.ts) == (2.5, ALIGN + timedelta(seconds=1))
+
+
+def test_window_accel_host_to_device_recovery(tmp_path, monkeypatch):
+    # An ordered=True host-tier window logic keeps on-time values
+    # whose ts is ahead of the watermark in its snapshot `queue`;
+    # resuming that snapshot on the device tier must replay them into
+    # their windows, not drop them.
+    from bytewax_tpu import xla
+    from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+
+    init_db_dir(tmp_path, 1)
+    rc = RecoveryConfig(str(tmp_path))
+    ts_map = {
+        2.0: ALIGN + timedelta(seconds=1),
+        3.0: ALIGN + timedelta(seconds=2),
+        4.0: ALIGN + timedelta(seconds=3),
+    }
+    clock = EventClock(
+        ts_getter=lambda v: ts_map[v],
+        wait_for_system_duration=timedelta(days=999),
+    )
+    windower = TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN)
+    inp = [
+        ("k", 2.0),
+        ("k", 3.0),
+        TestingSource.ABORT(),
+        ("k", 4.0),
+    ]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    # fold_window (not reduce_window) because only ordered=True logics
+    # carry a queue, and reduce_window lowers with ordered=False.
+    wo = w.fold_window("sum", s, clock, windower, lambda: 0, xla.SUM, xla.SUM)
+    op.output("out", wo.down, TestingSink(out))
+
+    # Crash on the host tier (pending values live in `queue`), resume
+    # on the device tier.
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
+    run_main(flow, epoch_interval=timedelta(0), recovery_config=rc)
+    assert out == []
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
+    run_main(flow, epoch_interval=timedelta(0), recovery_config=rc)
+    assert out == [("k", (0, 9))]
