@@ -392,7 +392,11 @@ class _StatefulBatchRt(_OpRt):
             )
 
             if isinstance(spec, AccelSpec):
-                self.agg = DeviceAggState(spec.kind)
+                from bytewax_tpu.engine.sharded_state import make_agg_state
+
+                # Mesh-sharded (all_to_all over ICI) when >1 local
+                # device; single-device slot table otherwise.
+                self.agg = make_agg_state(spec.kind)
             elif isinstance(spec, WindowAccelSpec):
                 self.wagg = DeviceWindowAggState(spec)
         resumed = {

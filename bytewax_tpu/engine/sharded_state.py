@@ -1,0 +1,452 @@
+"""Mesh-sharded keyed aggregation state.
+
+The multi-chip sibling of :class:`bytewax_tpu.engine.xla.DeviceAggState`:
+per-key state lives as a slot table sharded over a device mesh
+(``n_shards * cap_per_shard`` slots, block *d* on device *d*), and each
+micro-batch runs ONE compiled program that exchanges rows to their
+owning shard with ``all_to_all`` over ICI and scatter-combines them
+into the local block (:func:`bytewax_tpu.ops.sharded.make_sharded_step`).
+
+This is the keyed shuffle of the reference collapsed into the compiled
+step: ``hash(key) → worker → routed_exchange → per-key callback``
+(``/root/reference/src/timely.rs:806-812``,
+``src/operators.rs:441-1041``) becomes ``hash(key) → shard →
+all_to_all → scatter-combine``, with no host hop on the exchange.
+
+Snapshots stay in the host tier's per-key scalar format, so recovery
+is interchangeable between the host tier, the single-device tier, and
+any mesh size (rescaling across tiers is just a resume).
+
+The exchange never drops rows: the host sizes each dispatch's bucket
+capacity to the batch's exact per-(source, destination) maximum before
+compiling/calling the step (skew just means a larger capacity bucket,
+pow2-quantized so XLA sees O(log n) shapes).
+"""
+
+import math
+import os
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bytewax_tpu.engine.arrays import ArrayBatch
+from bytewax_tpu.engine.xla import (
+    DeviceAggState,
+    NonNumericValues,
+    _final_of,
+    _snap_of,
+)
+from bytewax_tpu.ops.segment import AGG_KINDS
+
+__all__ = ["ShardedAggState", "make_agg_state"]
+
+_MIN_CAP_PER_SHARD = 128
+_MIN_ROWS_PER_SHARD = 64
+
+
+def make_agg_state(kind: str):
+    """Build aggregation state for one stateful step: mesh-sharded
+    when more than one local device is available (the pod is the
+    cluster), single-device otherwise.
+
+    ``BYTEWAX_TPU_SHARD`` overrides: ``0`` forces single-device,
+    ``auto``/unset uses all local devices, an integer uses that many.
+    """
+    want = os.environ.get("BYTEWAX_TPU_SHARD", "auto")
+    if want == "0":
+        return DeviceAggState(kind)
+    try:
+        import jax
+
+        n = len(jax.local_devices())
+    except Exception:  # noqa: BLE001 — no reachable backend
+        return DeviceAggState(kind)
+    if want not in ("auto", ""):
+        n = min(n, int(want))
+    if n <= 1:
+        return DeviceAggState(kind)
+    from bytewax_tpu.parallel.mesh import make_mesh
+
+    return ShardedAggState(kind, make_mesh(n))
+
+
+def _pow2(n: int, floor: int) -> int:
+    return 1 << max(floor, math.ceil(math.log2(max(n, 1))))
+
+
+class ShardedAggState:
+    """Slot-table aggregation state sharded over a device mesh.
+
+    Duck-types the ``DeviceAggState`` surface the engine driver uses
+    (``update`` / ``update_batch`` / ``load`` / ``snapshots_for`` /
+    ``finalize`` / ``keys``).
+
+    Key placement: a key's owner shard is ``adler32(key) % n_shards``
+    (the same family of stable hash the host tier routes with); its
+    slot within the owner is assigned densely per shard.  The wire id
+    is ``key_id = slot * n_shards + shard`` so the compiled step
+    recovers both with one mod/div.  Each shard's last slot is
+    scratch for padding rows, and key ids are stable across capacity
+    growth (only the scratch index moves).
+    """
+
+    def __init__(self, kind: str, mesh, cap_per_shard: int = _MIN_CAP_PER_SHARD):
+        import jax.numpy as jnp
+
+        from bytewax_tpu.parallel.mesh import SHARD_AXIS, key_sharding
+
+        self.kind_name = kind
+        self.kind = AGG_KINDS[kind]
+        self.mesh = mesh
+        self.n_shards = mesh.shape[SHARD_AXIS]
+        self.cap_per_shard = cap_per_shard
+        self.dtype = jnp.float32
+        # Rows and state blocks use the same leading-axis split.
+        self._sharding = key_sharding(mesh)
+        self.key_to_kid: Dict[str, int] = {}
+        #: per-shard count of assigned slots
+        self._shard_fill = [0] * self.n_shards
+        #: per-shard free (discarded) slot lists
+        self._free: List[List[int]] = [[] for _ in range(self.n_shards)]
+        self._pending_reset: List[int] = []
+        self._fields = None  # lazy until first update/load
+        self._steps: Dict[Tuple[int, int, int, Any], Any] = {}
+        # Dictionary-encoded fast path: external id -> wire key id.
+        self._ext_vocab: Optional[np.ndarray] = None
+        self._ext_to_kid: Optional[np.ndarray] = None
+        self._vocab_ref: Any = None
+
+    # -- key placement -----------------------------------------------------
+
+    def _owner(self, key: str) -> int:
+        return zlib.adler32(key.encode()) % self.n_shards
+
+    def alloc(self, key: str) -> int:
+        """Assign (or return) the wire key id for a key."""
+        kid = self.key_to_kid.get(key)
+        if kid is not None:
+            return kid
+        shard = self._owner(key)
+        if self._free[shard]:
+            slot = self._free[shard].pop()
+            self._pending_reset.append(shard * self.cap_per_shard + slot)
+        else:
+            slot = self._shard_fill[shard]
+            if slot >= self.cap_per_shard - 1:
+                self._grow()
+            self._shard_fill[shard] += 1
+        kid = slot * self.n_shards + shard
+        self.key_to_kid[key] = kid
+        return kid
+
+    def discard(self, key: str) -> None:
+        kid = self.key_to_kid.pop(key, None)
+        if kid is not None:
+            shard, slot = kid % self.n_shards, kid // self.n_shards
+            self._free[shard].append(slot)
+
+    def _global_idx(self, kid: int) -> int:
+        shard, slot = kid % self.n_shards, kid // self.n_shards
+        return shard * self.cap_per_shard + slot
+
+    def _grow(self) -> None:
+        """Double every shard's block.  Key ids are unchanged; only
+        the per-shard scratch slot (the block's last) moves, and the
+        old scratch becomes a real slot (cleared)."""
+        import jax
+        import jax.numpy as jnp
+
+        from bytewax_tpu.ops.segment import identity_for
+
+        old_cap = self.cap_per_shard
+        new_cap = old_cap * 2
+        if self._fields is not None:
+            grown = {}
+            for name, (init, _op) in self.kind.fields.items():
+                ident = identity_for(init, self.dtype)
+                blocks = self._fields[name].reshape(self.n_shards, old_cap)
+                blocks = blocks.at[:, old_cap - 1].set(ident)
+                pad = jnp.full(
+                    (self.n_shards, new_cap - old_cap), ident, self.dtype
+                )
+                arr = jnp.concatenate([blocks, pad], axis=1).reshape(-1)
+                grown[name] = jax.device_put(arr, self._sharding)
+            self._fields = grown
+        # Remap pending resets (their shard/slot split is cap-free
+        # only via kid; stored as global idx of the OLD layout).
+        self._pending_reset = [
+            (idx // old_cap) * new_cap + (idx % old_cap)
+            for idx in self._pending_reset
+        ]
+        self.cap_per_shard = new_cap
+
+    # -- state materialization ---------------------------------------------
+
+    def _ensure_fields(self) -> None:
+        from bytewax_tpu.ops.sharded import init_sharded_fields
+
+        if self._fields is None:
+            self._fields = init_sharded_fields(
+                self.kind, self.mesh, self.cap_per_shard, self.dtype
+            )
+            self._pending_reset.clear()
+        elif self._pending_reset:
+            import jax.numpy as jnp
+
+            from bytewax_tpu.ops.segment import identity_for
+
+            idxs = jnp.asarray(
+                np.asarray(self._pending_reset, dtype=np.int32)
+            )
+            for name, (init, _op) in self.kind.fields.items():
+                ident = identity_for(init, self.dtype)
+                self._fields[name] = self._fields[name].at[idxs].set(ident)
+            self._pending_reset.clear()
+
+    def _step_for(self, total_rows: int, capacity: int):
+        from bytewax_tpu.ops.sharded import make_sharded_step
+
+        key = (self.cap_per_shard, capacity, total_rows, self.dtype)
+        step = self._steps.get(key)
+        if step is None:
+            step = make_sharded_step(
+                self.mesh,
+                self.kind_name,
+                self.cap_per_shard,
+                capacity,
+                dtype=self.dtype,
+            )
+            self._steps[key] = step
+        return step
+
+    # -- dtype policy (mirrors DeviceAggState._pick_dtype) -------------------
+
+    def _pick_dtype(self, values: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        if np.issubdtype(values.dtype, np.integer):
+            if values.dtype.itemsize > 4:
+                if len(values) and (
+                    values.max() > np.iinfo(np.int32).max
+                    or values.min() < np.iinfo(np.int32).min
+                ):
+                    msg = (
+                        "device-accelerated reduction over integers "
+                        "wider than 32 bits is not exact; pass a plain "
+                        "Python reducer"
+                    )
+                    raise NonNumericValues(msg)
+                values = values.astype(np.int32)
+            if self._fields is None:
+                self.dtype = jnp.int32
+        return values
+
+    # -- updates -------------------------------------------------------------
+
+    def _dispatch(self, kids: np.ndarray, values: np.ndarray) -> None:
+        """Run one compiled exchange + fold over the mesh."""
+        import jax
+
+        n = len(kids)
+        if n == 0:
+            return
+        self._ensure_fields()
+        rows_per_shard = _pow2(
+            -(-n // self.n_shards), int(math.log2(_MIN_ROWS_PER_SHARD))
+        )
+        total = rows_per_shard * self.n_shards
+
+        kids_p = np.zeros(total, dtype=np.int32)
+        kids_p[:n] = kids
+        vals_p = np.zeros(total, dtype=np.dtype(self.dtype))
+        vals_p[:n] = values
+        valid_p = np.zeros(total, dtype=bool)
+        valid_p[:n] = True
+
+        # Exact per-(source block, destination shard) bucket maximum:
+        # sized on host so the exchange can never drop rows, however
+        # skewed the key distribution.
+        dest = kids % self.n_shards
+        block_of = np.arange(n) // rows_per_shard
+        pair_counts = np.bincount(
+            block_of * self.n_shards + dest,
+            minlength=self.n_shards * self.n_shards,
+        )
+        capacity = _pow2(int(pair_counts.max()), 4)
+
+        step = self._step_for(total, capacity)
+        self._fields = step(
+            self._fields,
+            jax.device_put(kids_p, self._sharding),
+            jax.device_put(vals_p, self._sharding),
+            jax.device_put(valid_p, self._sharding),
+        )
+
+    def update(self, keys: np.ndarray, values: np.ndarray) -> List[str]:
+        """Fold ``(key, value)`` rows in; returns the unique keys
+        touched (for epoch snapshot bookkeeping)."""
+        keys = np.asarray(keys)
+        values = np.asarray(values)
+        if values.dtype == object or values.dtype.kind in "US":
+            msg = (
+                "device-accelerated reduction requires numeric values; "
+                "pass a plain Python reducer for non-numeric data"
+            )
+            raise NonNumericValues(msg)
+        values = self._pick_dtype(values)
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        kid_of_uniq = np.empty(len(uniq), dtype=np.int32)
+        for j, k in enumerate(uniq):
+            kid_of_uniq[j] = self.alloc(str(k))
+        self._dispatch(kid_of_uniq[inverse], values)
+        return [str(k) for k in uniq]
+
+    def _sync_vocab(self, ids: np.ndarray, vocab: np.ndarray) -> np.ndarray:
+        """Assign wire ids for newly-seen external vocabulary ids;
+        returns the touched unique external ids."""
+        if self._ext_vocab is None:
+            self._ext_vocab = np.asarray(vocab)
+            self._ext_to_kid = np.full(len(vocab), -1, dtype=np.int32)
+            self._vocab_ref = vocab
+        elif vocab is not self._vocab_ref:
+            prev = len(self._ext_to_kid)
+            if len(vocab) < prev or not np.array_equal(
+                np.asarray(vocab)[:prev], self._ext_vocab[:prev]
+            ):
+                msg = (
+                    "key_vocab must be an append-only extension of the "
+                    "vocabulary used by earlier batches of this step"
+                )
+                raise TypeError(msg)
+            if len(vocab) > prev:
+                pad = np.full(len(vocab) - prev, -1, np.int32)
+                self._ext_vocab = np.asarray(vocab)
+                self._ext_to_kid = np.concatenate([self._ext_to_kid, pad])
+            self._vocab_ref = vocab
+        counts = np.bincount(ids, minlength=len(self._ext_to_kid))
+        uniq = np.nonzero(counts)[0]
+        new = uniq[self._ext_to_kid[uniq] < 0]
+        for ext in new.tolist():
+            self._ext_to_kid[ext] = self.alloc(str(self._ext_vocab[ext]))
+        return uniq
+
+    def update_batch(self, batch: ArrayBatch) -> List[str]:
+        if "key_id" in batch.cols and batch.key_vocab is not None:
+            ids = batch.numpy("key_id")
+            values = batch.numpy("value")
+            if batch.value_scale is not None:
+                import jax.numpy as jnp
+
+                if self.dtype != jnp.float32:
+                    msg = (
+                        "fixed-point (value_scale) batches need a float "
+                        "accumulator, but earlier batches locked this "
+                        "step's state to an integer dtype"
+                    )
+                    raise TypeError(msg)
+                values = (values * batch.value_scale).astype(np.float32)
+            else:
+                values = self._pick_dtype(values)
+            uniq = self._sync_vocab(
+                ids.astype(np.int64), np.asarray(batch.key_vocab)
+            )
+            self._dispatch(self._ext_to_kid[ids], values)
+            return [str(self._ext_vocab[e]) for e in uniq.tolist()]
+        if "key" in batch.cols:
+            values = batch.numpy("value")
+            if batch.value_scale is not None:
+                values = (values * batch.value_scale).astype(np.float32)
+            return self.update(batch.numpy("key"), values)
+        msg = (
+            "columnar batch feeding an accelerated keyed aggregation "
+            "needs a 'key' or dictionary-encoded 'key_id' column"
+        )
+        raise TypeError(msg)
+
+    # -- recovery ------------------------------------------------------------
+
+    def load(self, key: str, state: Any) -> None:
+        """Install a resumed snapshot for a key (host-tier format,
+        identical to ``DeviceAggState.load``)."""
+        import jax.numpy as jnp
+
+        kind = self.kind_name
+        if kind in ("sum", "min", "max", "count"):
+            name = "count" if kind == "count" else next(iter(self.kind.fields))
+            field_vals = {name: float(state)}
+            if isinstance(state, int) and self._fields is None:
+                self.dtype = jnp.int32
+        elif kind == "mean":
+            total, count = state
+            field_vals = {"sum": float(total), "count": float(count)}
+        else:  # stats
+            mn, mx, total, count = state
+            field_vals = {
+                "min": float(mn),
+                "max": float(mx),
+                "sum": float(total),
+                "count": float(count),
+            }
+        kid = self.alloc(key)
+        self._ensure_fields()
+        idx = self._global_idx(kid)
+        for name, val in field_vals.items():
+            self._fields[name] = (
+                self._fields[name].at[idx].set(jnp.asarray(val, self.dtype))
+            )
+
+    def _fetch(self) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        names = list(self.kind.fields)
+        stacked = np.asarray(
+            jnp.stack([self._fields[name] for name in names])
+        )
+        return {name: stacked[i] for i, name in enumerate(names)}
+
+    def snapshots_for(self, keys: List[str]) -> List[Tuple[str, Any]]:
+        """Host-format snapshots of specific keys (one device_get)."""
+        if self._fields is None or not keys:
+            return [(k, None) for k in keys]
+        host = self._fetch()
+        out = []
+        for key in keys:
+            kid = self.key_to_kid.get(key)
+            if kid is None:
+                out.append((key, None))
+            else:
+                out.append(
+                    (key, _snap_of(self.kind_name, host, self._global_idx(kid)))
+                )
+        return out
+
+    # -- finalization --------------------------------------------------------
+
+    def finalize(self) -> List[Tuple[str, Any]]:
+        """Emit ``(key, final_value)`` for every live key, sorted by
+        key (matching the host tier's EOF ordering), and clear."""
+        if not self.key_to_kid:
+            return []
+        self._ensure_fields()
+        host = self._fetch()
+        out = [
+            (
+                key,
+                _final_of(
+                    self.kind_name, host, self._global_idx(self.key_to_kid[key])
+                ),
+            )
+            for key in sorted(self.key_to_kid)
+        ]
+        self.key_to_kid.clear()
+        self._shard_fill = [0] * self.n_shards
+        self._free = [[] for _ in range(self.n_shards)]
+        self._fields = None
+        self._ext_vocab = None
+        self._ext_to_kid = None
+        self._vocab_ref = None
+        return out
+
+    def keys(self) -> List[str]:
+        return list(self.key_to_kid)

@@ -12,14 +12,13 @@ no host hop, no RPC mesh — replacing the reference's
 ``src/operators.rs:767-808``).
 """
 
-import functools
 from typing import Dict
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from bytewax_tpu.ops.segment import AGG_KINDS, AggKind
+from bytewax_tpu.ops.segment import AGG_KINDS, AggKind, identity_for
 from bytewax_tpu.parallel.exchange import bucket_by_shard
 from bytewax_tpu.parallel.mesh import SHARD_AXIS
 
@@ -35,7 +34,11 @@ def init_sharded_fields(
     sharding = NamedSharding(mesh, P(SHARD_AXIS))
     return {
         name: jax.device_put(
-            jnp.full((n_shards * cap_per_shard,), init, dtype=dtype),
+            jnp.full(
+                (n_shards * cap_per_shard,),
+                identity_for(init, dtype),
+                dtype=dtype,
+            ),
             sharding,
         )
         for name, (init, _op) in kind.fields.items()
@@ -47,6 +50,7 @@ def make_sharded_step(
     kind_name: str,
     cap_per_shard: int,
     exchange_capacity: int,
+    dtype=jnp.float32,
 ):
     """Build the jitted sharded update step.
 
@@ -55,25 +59,36 @@ def make_sharded_step(
     sharded per :func:`init_sharded_fields`.  Key ownership is
     ``key_id % n_shards``; a key's slot within its owner is
     ``key_id // n_shards``, scratch slot is the block's last.
+
+    ``exchange_capacity`` is the per-(source, destination) bucket
+    size; the caller must size it to the batch's true per-bucket
+    maximum (see ``engine/sharded_state.py``, which computes it
+    exactly per micro-batch) — rows beyond it would be dropped.
+
+    ``dtype`` is the accumulator dtype: float32 values ride the
+    exchange bitcast to int32 (so key ids keep full precision);
+    int32 values ride as-is and fold exactly.
     """
     kind = AGG_KINDS[kind_name]
     n_shards = mesh.shape[SHARD_AXIS]
+    integer = jnp.issubdtype(dtype, jnp.integer)
 
     def body(fields, key_ids, values, valid):
         # 1. Keyed exchange over ICI: ship each row to its owner.
-        # Values ride bitcast to int32 so key ids keep full precision
-        # (a float32 payload would corrupt ids above 2^24).
+        # Float payloads ride bitcast to int32 (a float32 payload
+        # lane would corrupt ids above 2^24).
         shard_ids = (key_ids % n_shards).astype(jnp.int32)
+        if integer:
+            value_bits = values.astype(jnp.int32)
+        else:
+            value_bits = jax.lax.bitcast_convert_type(
+                values.astype(jnp.float32), jnp.int32
+            )
         payload = jnp.stack(
-            [
-                key_ids.astype(jnp.int32),
-                jax.lax.bitcast_convert_type(
-                    values.astype(jnp.float32), jnp.int32
-                ),
-            ],
+            [key_ids.astype(jnp.int32), value_bits],
             axis=1,
         )
-        buckets, counts = bucket_by_shard(
+        buckets, counts, _dropped = bucket_by_shard(
             shard_ids, payload, valid, n_shards, exchange_capacity
         )
         got = jax.lax.all_to_all(
@@ -87,7 +102,10 @@ def make_sharded_step(
         ).reshape(-1)
         rows = got.reshape(-1, 2)
         recv_ids = rows[:, 0]
-        recv_vals = jax.lax.bitcast_convert_type(rows[:, 1], jnp.float32)
+        if integer:
+            recv_vals = rows[:, 1]
+        else:
+            recv_vals = jax.lax.bitcast_convert_type(rows[:, 1], jnp.float32)
 
         # 2. Local scatter-combine into this device's state block.
         local_slot = jnp.where(
@@ -96,13 +114,17 @@ def make_sharded_step(
         out = {}
         for name, (init, op_name) in kind.fields.items():
             arr = fields[name]
+            ident = identity_for(init, arr.dtype)
+            zero = jnp.zeros((), dtype=arr.dtype)
             if name == "count":
-                contrib = jnp.where(mask, 1.0, 0.0).astype(arr.dtype)
+                one = jnp.ones((), dtype=arr.dtype)
+                contrib = jnp.where(mask, one, zero)
             else:
-                contrib = jnp.where(mask, recv_vals, init).astype(arr.dtype)
+                contrib = jnp.where(
+                    mask, recv_vals.astype(arr.dtype), ident
+                )
             ref = arr.at[local_slot]
             if op_name == "add":
-                zero = jnp.zeros((), dtype=arr.dtype)
                 out[name] = ref.add(jnp.where(mask, contrib, zero))
             elif op_name == "min":
                 out[name] = ref.min(contrib)

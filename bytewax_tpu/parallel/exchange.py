@@ -30,17 +30,23 @@ def bucket_by_shard(
     valid: jax.Array,
     n_shards: int,
     capacity: int,
-) -> Tuple[jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Group rows into fixed-capacity per-shard buckets.
 
     :arg shard_ids: ``[n]`` int32 target shard per row.
     :arg values: ``[n, ...]`` row payloads.
     :arg valid: ``[n]`` bool mask of real (non-padding) rows.
     :arg n_shards: Number of buckets.
-    :arg capacity: Rows per bucket; overflowing rows are dropped (the
-        host driver must size micro-batches to prevent this).
+    :arg capacity: Rows per bucket.  Rows past a bucket's capacity do
+        not fit and are counted in ``dropped`` — callers must either
+        size ``capacity`` to the batch's true per-bucket maximum
+        (``engine/sharded_state.py`` computes it exactly per
+        micro-batch, so its exchanges never drop) or check
+        ``dropped`` and re-dispatch.
     :returns: ``(buckets [n_shards, capacity, ...], counts
-        [n_shards])``; slots beyond the count are zero.
+        [n_shards], dropped [])``; bucket slots beyond the count are
+        zero and ``dropped`` is the number of valid rows that did not
+        fit.
     """
     n = shard_ids.shape[0]
     shard_ids = jnp.where(valid, shard_ids, n_shards)  # padding → overflow bin
@@ -48,7 +54,9 @@ def bucket_by_shard(
     onehot = jax.nn.one_hot(shard_ids, n_shards + 1, dtype=jnp.int32)  # [n, S+1]
     pos = jnp.cumsum(onehot, axis=0) - onehot  # rank of row in its bucket
     row_pos = jnp.take_along_axis(pos, shard_ids[:, None], axis=1)[:, 0]
-    counts = jnp.minimum(onehot.sum(axis=0)[:n_shards], capacity)
+    raw_counts = onehot.sum(axis=0)[:n_shards]
+    counts = jnp.minimum(raw_counts, capacity)
+    dropped = (raw_counts - counts).sum()
 
     in_cap = row_pos < capacity
     keep = valid & (shard_ids < n_shards) & in_cap
@@ -57,7 +65,7 @@ def bucket_by_shard(
     flat_shape = (n_shards * capacity + 1,) + values.shape[1:]
     flat = jnp.zeros(flat_shape, dtype=values.dtype).at[flat_idx].set(values)
     buckets = flat[:-1].reshape((n_shards, capacity) + values.shape[1:])
-    return buckets, counts
+    return buckets, counts, dropped
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "capacity"))
@@ -73,7 +81,9 @@ def keyed_all_to_all(
     Each device buckets its local rows by target shard and the buckets
     are exchanged with ``all_to_all``; afterwards device *d* holds all
     rows whose ``shard_id == d`` (up to ``capacity`` per source
-    shard), plus a validity mask.
+    shard), plus a validity mask and the global count of rows that
+    did not fit any bucket (``dropped``, replicated on every shard) —
+    callers must check it or size ``capacity`` to the true maximum.
 
     Runs as ``shard_map`` over the mesh; inputs are sharded on the
     leading (row) axis.
@@ -81,7 +91,7 @@ def keyed_all_to_all(
     n_shards = mesh.shape[SHARD_AXIS]
 
     def body(shard_ids, values, valid):
-        buckets, counts = bucket_by_shard(
+        buckets, counts, dropped = bucket_by_shard(
             shard_ids, values, valid, n_shards, capacity
         )
         # [n_shards, capacity, ...] on each device → exchange along
@@ -95,11 +105,16 @@ def keyed_all_to_all(
         mask = (
             jnp.arange(capacity)[None, :] < got_counts[:, None]
         )  # [n_shards, capacity]
-        return got.reshape((n_shards * capacity,) + got.shape[2:]), mask.reshape(-1)
+        dropped_total = jax.lax.psum(dropped, SHARD_AXIS)
+        return (
+            got.reshape((n_shards * capacity,) + got.shape[2:]),
+            mask.reshape(-1),
+            dropped_total,
+        )
 
     return jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
-        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
     )(shard_ids, values, valid)

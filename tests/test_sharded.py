@@ -1,0 +1,377 @@
+"""Mesh-sharded keyed aggregation: the all_to_all exchange step, the
+ShardedAggState engine tier, dataflow equivalence with the host tier,
+and cross-tier recovery (host <-> single-device <-> mesh)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+import bytewax_tpu.operators as op
+from bytewax_tpu import xla
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.engine.arrays import ArrayBatch
+from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+from tests.test_xla import ArraySource
+
+
+def _mesh(n=8):
+    import jax
+
+    from bytewax_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices")
+    return make_mesh(n)
+
+
+# -- make_sharded_step directly ---------------------------------------------
+
+
+def _run_step(mesh, kind, key_ids, values, cap_per_shard=64, capacity=None,
+              dtype=None):
+    import jax
+    import jax.numpy as jnp
+
+    from bytewax_tpu.ops.sharded import init_sharded_fields, make_sharded_step
+    from bytewax_tpu.parallel.mesh import key_sharding
+
+    n_shards = len(mesh.devices)
+    if dtype is None:
+        dtype = jnp.float32
+    if capacity is None:
+        # true per-(source block, dest) maximum
+        rows_per_shard = len(key_ids) // n_shards
+        block_of = np.arange(len(key_ids)) // rows_per_shard
+        dest = key_ids % n_shards
+        capacity = int(
+            np.bincount(
+                block_of * n_shards + dest, minlength=n_shards * n_shards
+            ).max()
+        )
+    fields = init_sharded_fields(
+        xla_kind(kind), mesh, cap_per_shard, dtype=dtype
+    )
+    step = make_sharded_step(mesh, kind, cap_per_shard, capacity, dtype=dtype)
+    sh = key_sharding(mesh)
+    out = step(
+        fields,
+        jax.device_put(jnp.asarray(key_ids), sh),
+        jax.device_put(jnp.asarray(values), sh),
+        jax.device_put(jnp.ones(len(key_ids), dtype=bool), sh),
+    )
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def xla_kind(name):
+    from bytewax_tpu.ops.segment import AGG_KINDS
+
+    return AGG_KINDS[name]
+
+
+def _oracle_index(kid, n_shards, cap_per_shard):
+    shard, slot = kid % n_shards, kid // n_shards
+    return shard * cap_per_shard + slot
+
+
+def test_sharded_step_matches_oracle_random():
+    mesh = _mesh()
+    rng = np.random.RandomState(1)
+    n, n_keys, cap = 512, 100, 64
+    key_ids = rng.randint(0, n_keys, size=n).astype(np.int32)
+    values = rng.randn(n).astype(np.float32)
+    out = _run_step(mesh, "stats", key_ids, values, cap_per_shard=cap)
+    for k in range(n_keys):
+        idx = _oracle_index(k, 8, cap)
+        rows = values[key_ids == k]
+        assert out["count"][idx] == len(rows)
+        if len(rows):
+            np.testing.assert_allclose(out["sum"][idx], rows.sum(), rtol=1e-5)
+            np.testing.assert_allclose(out["min"][idx], rows.min(), rtol=1e-6)
+            np.testing.assert_allclose(out["max"][idx], rows.max(), rtol=1e-6)
+    assert out["count"].sum() == n  # row conservation
+
+
+def test_sharded_step_nonuniform_distribution():
+    # All rows target two shards; every other bucket is empty.
+    mesh = _mesh()
+    n, cap = 256, 64
+    key_ids = np.where(
+        np.arange(n) % 2 == 0, 0, 1
+    ).astype(np.int32)  # keys 0 (shard 0) and 1 (shard 1)
+    values = np.ones(n, dtype=np.float32)
+    out = _run_step(mesh, "sum", key_ids, values, cap_per_shard=cap)
+    assert out["sum"][_oracle_index(0, 8, cap)] == n // 2
+    assert out["sum"][_oracle_index(1, 8, cap)] == n // 2
+    assert out["sum"].sum() == n
+
+
+def test_sharded_step_float_bitcast_roundtrip():
+    # Negative / subnormal-ish floats must survive the int32 bitcast
+    # ride through the exchange exactly.
+    mesh = _mesh()
+    cap = 16
+    # Smallest NORMAL float32 included; subnormals are out of scope
+    # (XLA flushes them to zero on every tier).
+    specials = np.array(
+        [-0.0, 1.5, -2.25, 1.2e-38, -1e38, 3.14159], dtype=np.float32
+    )
+    n = 64
+    key_ids = (np.arange(n) % len(specials)).astype(np.int32)
+    values = specials[key_ids]
+    out = _run_step(mesh, "max", key_ids, values, cap_per_shard=cap)
+    for k, v in enumerate(specials):
+        idx = _oracle_index(k, 8, cap)
+        assert out["max"][idx] == np.float32(v), (k, v, out["max"][idx])
+
+
+def test_sharded_step_int32_exact():
+    import jax.numpy as jnp
+
+    mesh = _mesh()
+    cap = 16
+    n = 64
+    key_ids = np.zeros(n, dtype=np.int32)
+    values = np.full(n, 2**24 + 1, dtype=np.int32)  # not f32-representable
+    out = _run_step(
+        mesh, "sum", key_ids, values, cap_per_shard=cap, dtype=jnp.int32
+    )
+    assert out["sum"][_oracle_index(0, 8, cap)] == n * (2**24 + 1)
+
+
+def test_sharded_step_capacity_boundary():
+    # Exactly capacity rows from one source block to one destination:
+    # nothing may be lost at the boundary.
+    mesh = _mesh()
+    cap_per_shard, capacity = 16, 8
+    n = 64  # 8 rows per source block
+    key_ids = np.zeros(n, dtype=np.int32)  # all to shard 0, count==capacity
+    values = np.ones(n, dtype=np.float32)
+    out = _run_step(
+        mesh, "sum", key_ids, values,
+        cap_per_shard=cap_per_shard, capacity=capacity,
+    )
+    assert out["sum"][_oracle_index(0, 8, cap_per_shard)] == n
+
+
+# -- ShardedAggState --------------------------------------------------------
+
+
+def test_sharded_state_matches_single_device():
+    from bytewax_tpu.engine.sharded_state import ShardedAggState
+    from bytewax_tpu.engine.xla import DeviceAggState
+
+    mesh = _mesh()
+    rng = np.random.RandomState(2)
+    n = 3000
+    keys = np.array([f"k{i:03d}" for i in rng.randint(0, 413, size=n)])
+    vals = (rng.randn(n) * 10).round(1).astype(np.float64)
+
+    sharded = ShardedAggState("stats", mesh)
+    single = DeviceAggState("stats")
+    for i in range(0, n, 700):  # uneven batches
+        sharded.update(keys[i : i + 700], vals[i : i + 700])
+        single.update(keys[i : i + 700], vals[i : i + 700])
+    a, b = sharded.finalize(), single.finalize()
+    assert [k for k, _ in a] == [k for k, _ in b]
+    for (ka, va), (_kb, vb) in zip(a, b):
+        np.testing.assert_allclose(va, vb, rtol=1e-5, err_msg=ka)
+
+
+def test_sharded_state_skewed_hot_key():
+    # One key receives far more rows than any per-bucket guess would
+    # allow; the host-sized exchange must not lose a single row.
+    from bytewax_tpu.engine.sharded_state import ShardedAggState
+
+    mesh = _mesh()
+    st = ShardedAggState("count", mesh)
+    keys = np.array(["hot"] * 9000 + [f"cold{i}" for i in range(100)])
+    st.update(keys, np.zeros(len(keys)))
+    out = dict(st.finalize())
+    assert out["hot"] == 9000
+    assert sum(out.values()) == 9100
+
+
+def test_sharded_state_dict_encoded_batches():
+    from bytewax_tpu.engine.sharded_state import ShardedAggState
+
+    mesh = _mesh()
+    st = ShardedAggState("stats", mesh)
+    vocab = np.array([f"station{i}" for i in range(50)])
+    rng = np.random.RandomState(3)
+    rows = []
+    for _ in range(4):
+        ids = rng.randint(0, 50, size=500).astype(np.int32)
+        temps = rng.randint(-400, 400, size=500).astype(np.int16)
+        rows.append((ids, temps))
+        st.update_batch(
+            ArrayBatch(
+                {"key_id": ids, "value": temps},
+                key_vocab=vocab,
+                value_scale=0.1,
+            )
+        )
+    out = dict(st.finalize())
+    groups = collections.defaultdict(list)
+    for ids, temps in rows:
+        for i, t in zip(ids.tolist(), temps.tolist()):
+            groups[f"station{i}"].append(t * 0.1)
+    assert set(out) == set(groups)
+    for k, g in groups.items():
+        mn, mean, mx, cnt = out[k]
+        assert cnt == len(g)
+        np.testing.assert_allclose(mn, min(g), atol=1e-4)
+        np.testing.assert_allclose(mx, max(g), atol=1e-4)
+        np.testing.assert_allclose(mean, sum(g) / len(g), atol=1e-3)
+
+
+def test_sharded_state_growth_keeps_state():
+    # Keys folded before a capacity growth must keep their state after.
+    from bytewax_tpu.engine.sharded_state import ShardedAggState
+
+    mesh = _mesh()
+    st = ShardedAggState("sum", mesh, cap_per_shard=8)
+    st.update(np.array(["early"]), np.array([5.0]))
+    many = np.array([f"key{i:05d}" for i in range(1000)])
+    st.update(many, np.ones(1000))
+    st.update(np.array(["early"]), np.array([7.0]))
+    out = dict(st.finalize())
+    assert out["early"] == 12.0
+    assert len(out) == 1001
+
+
+# -- engine integration -----------------------------------------------------
+
+
+def _brc_flow(batches, out):
+    flow = Dataflow("sharded_df")
+    s = op.input("inp", flow, ArraySource(batches))
+    r = xla.stats_final("stats", s)
+    op.output("out", r, TestingSink(out))
+    return flow
+
+
+def _brc_batches(n=4000, n_keys=200, seed=4):
+    rng = np.random.RandomState(seed)
+    batches = []
+    for i in range(0, n, 512):
+        m = min(512, n - i)
+        batches.append(
+            ArrayBatch(
+                {
+                    "key": np.array(
+                        [f"s{k:03d}" for k in rng.randint(0, n_keys, size=m)]
+                    ),
+                    "value": (rng.randn(m) * 10).round(1),
+                }
+            )
+        )
+    return batches
+
+
+def test_dataflow_sharded_matches_host_tier(monkeypatch):
+    # The "Done" bar from the round-1 verdict: a dataflow on the
+    # 8-device mesh produces output identical to the host tier.
+    batches = _brc_batches()
+
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
+    monkeypatch.setenv("BYTEWAX_TPU_SHARD", "8")
+    sharded = []
+    run_main(_brc_flow(batches, sharded))
+
+    monkeypatch.setenv("BYTEWAX_TPU_SHARD", "0")
+    single = []
+    run_main(_brc_flow(batches, single))
+
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
+    host = []
+    run_main(_brc_flow(batches, host))
+
+    assert [k for k, _ in sharded] == [k for k, _ in host]
+    for (k, vs), (_k1, v1), (_k2, vh) in zip(sharded, single, host):
+        np.testing.assert_allclose(vs, v1, rtol=1e-5, err_msg=k)
+        np.testing.assert_allclose(vs, vh, rtol=1e-4, err_msg=k)
+
+
+def test_dataflow_sharded_reduce_sum_exact(monkeypatch):
+    # Integer reduce via the mesh stays exact and byte-identical to
+    # the host tier.
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
+    monkeypatch.setenv("BYTEWAX_TPU_SHARD", "8")
+    inp = [(f"k{i % 40}", i) for i in range(2000)]
+
+    def build(out):
+        flow = Dataflow("sum_df")
+        s = op.input("inp", flow, TestingSource(inp, batch_size=128))
+        r = op.reduce_final("sum", s, xla.SUM)
+        op.output("out", r, TestingSink(out))
+        return flow
+
+    sharded = []
+    run_main(build(sharded))
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
+    host = []
+    run_main(build(host))
+    assert sharded == host
+
+
+def test_sharded_cross_tier_recovery(tmp_path, monkeypatch):
+    # Crash on the host tier, resume on the mesh; crash on the mesh,
+    # resume on the host tier.  Snapshots are the same format.
+    from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+    from datetime import timedelta
+
+    def build(inp, out):
+        flow = Dataflow("rec_df")
+        s = op.input("inp", flow, TestingSource(inp))
+        r = op.reduce_final("sum", s, xla.SUM)
+        op.output("out", r, TestingSink(out))
+        return flow
+
+    # host -> mesh
+    d1 = tmp_path / "a"
+    d1.mkdir()
+    init_db_dir(d1, 1)
+    rc1 = RecoveryConfig(str(d1))
+    inp1 = [("k", 1.0), ("k", 2.0), TestingSource.ABORT(), ("k", 4.0)]
+    out1: list = []
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
+    run_main(build(inp1, out1), epoch_interval=timedelta(0), recovery_config=rc1)
+    assert out1 == []
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
+    monkeypatch.setenv("BYTEWAX_TPU_SHARD", "8")
+    run_main(build(inp1, out1), epoch_interval=timedelta(0), recovery_config=rc1)
+    assert out1 == [("k", 7.0)]
+
+    # mesh -> host
+    d2 = tmp_path / "b"
+    d2.mkdir()
+    init_db_dir(d2, 1)
+    rc2 = RecoveryConfig(str(d2))
+    inp2 = [("k", 1.0), ("k", 2.0), TestingSource.ABORT(), ("k", 4.0)]
+    out2: list = []
+    run_main(build(inp2, out2), epoch_interval=timedelta(0), recovery_config=rc2)
+    assert out2 == []
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
+    run_main(build(inp2, out2), epoch_interval=timedelta(0), recovery_config=rc2)
+    assert out2 == [("k", 7.0)]
+
+
+def test_make_agg_state_selection(monkeypatch):
+    from bytewax_tpu.engine.sharded_state import (
+        ShardedAggState,
+        make_agg_state,
+    )
+    from bytewax_tpu.engine.xla import DeviceAggState
+
+    _mesh()  # ensure devices exist
+    monkeypatch.setenv("BYTEWAX_TPU_SHARD", "0")
+    assert isinstance(make_agg_state("sum"), DeviceAggState)
+    monkeypatch.setenv("BYTEWAX_TPU_SHARD", "auto")
+    st = make_agg_state("sum")
+    assert isinstance(st, ShardedAggState)
+    assert st.n_shards == 8
+    monkeypatch.setenv("BYTEWAX_TPU_SHARD", "4")
+    st4 = make_agg_state("sum")
+    assert isinstance(st4, ShardedAggState)
+    assert st4.n_shards == 4

@@ -198,11 +198,12 @@ def test_keyed_all_to_all_mesh():
     values = np.arange(n, dtype=np.float32)
     valid = np.ones(n, dtype=bool)
 
-    got, mask = keyed_all_to_all(
+    got, mask, dropped = keyed_all_to_all(
         mesh, 16, jnp.asarray(shard_ids), jnp.asarray(values), jnp.asarray(valid)
     )
     got = np.asarray(got)
     mask = np.asarray(mask)
+    assert int(dropped) == 0
     # After exchange, device d's slice holds exactly the rows whose
     # shard_id == d.
     per_dev = got.reshape(8, -1)
@@ -211,6 +212,31 @@ def test_keyed_all_to_all_mesh():
         received = sorted(per_dev[d][per_mask[d]].tolist())
         expected = sorted(values[shard_ids == d].tolist())
         assert received == expected, f"device {d}"
+
+
+def test_keyed_all_to_all_reports_drops():
+    # An undersized bucket capacity must be detectable: the exchange
+    # reports how many valid rows did not fit instead of silently
+    # losing them.
+    import jax
+    import jax.numpy as jnp
+
+    from bytewax_tpu.parallel.exchange import keyed_all_to_all
+    from bytewax_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = make_mesh(8)
+    n = 64
+    shard_ids = np.zeros(n, dtype=np.int32)  # every row to shard 0
+    values = np.arange(n, dtype=np.float32)
+    valid = np.ones(n, dtype=bool)
+    got, mask, dropped = keyed_all_to_all(
+        mesh, 4, jnp.asarray(shard_ids), jnp.asarray(values), jnp.asarray(valid)
+    )
+    # 8 rows per source device, capacity 4 -> 4 dropped per source.
+    assert int(dropped) == 32
+    assert int(np.asarray(mask).sum()) == 32
 
 
 def test_int64_overflow_falls_back_to_host():
