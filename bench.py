@@ -1,15 +1,17 @@
-"""Benchmark harness: 1BRC-shaped keyed min/mean/max aggregation.
+"""Benchmark harness covering the full BASELINE.json metric:
+1BRC + wordcount events/sec/chip and fold_window p99 window-close
+latency, plus the isolated device-step time (so a dead chip link can
+never erase the architecture evidence).
 
-Compares the XLA tier (dictionary-encoded columnar micro-batches
-folded on device through the full engine) against the host tier
-(per-item Python stateful logic — the stand-in for the reference's
-per-item Timely+GIL path, since the reference's Rust engine is not
-installable here; see BASELINE.md).
+Prints ONE JSON line::
 
-Prints ONE JSON line:
-``{"metric", "value", "unit", "vs_baseline"}`` where value is the XLA
-tier's events/sec on this chip and vs_baseline is the speedup over the
-host tier on identical data.
+    {"metric", "value", "unit", "vs_baseline", "extra": {...}}
+
+The headline value is the 1BRC XLA-tier events/sec on this chip and
+``vs_baseline`` its speedup over the host tier (per-item Python — the
+stand-in for the reference's per-item Timely+GIL path, since the
+reference's Rust engine is not installable here; see BASELINE.md).
+``extra`` carries the windowing/wordcount/device-step sub-metrics.
 """
 
 import json
@@ -34,6 +36,9 @@ def _probe_accelerator() -> bool:
         return res.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+# -- 1BRC --------------------------------------------------------------------
 
 
 def _run_columnar(n_rows: int, batch_rows: int) -> float:
@@ -78,6 +83,273 @@ def _run_host(n_rows: int, batch_rows: int) -> float:
         os.environ.pop("BYTEWAX_TPU_ACCEL", None)
 
 
+# -- windowing ---------------------------------------------------------------
+
+
+def _run_windowing_host(batch_size: int, batch_count: int) -> float:
+    """The reference benchmark shape (list-append fold_window, 2 keys,
+    1-min tumbling, event time: examples/benchmark_windowing.py:11-39)
+    on the host tier; returns events/sec."""
+    from bytewax_tpu.models.windowing_bench import (
+        make_input,
+        windowing_bench_flow,
+    )
+    from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+    os.environ["BYTEWAX_TPU_ACCEL"] = "0"
+    try:
+        inp = make_input(batch_size, batch_count)
+        out = []
+        flow = windowing_bench_flow(
+            TestingSource(inp, batch_size=batch_size), TestingSink(out)
+        )
+        t0 = time.perf_counter()
+        run_main(flow)
+        dt = time.perf_counter() - t0
+        return len(inp) / dt
+    finally:
+        os.environ.pop("BYTEWAX_TPU_ACCEL", None)
+
+
+def _run_windowing_columnar(n_rows: int, batch_rows: int, accel: bool) -> float:
+    """A steady on-time event stream (10 rows per event-second — the
+    reference shape's density — 2 keys, 1-min tumbling count) as
+    columnar batches, on the device tier or the host tier (same
+    shape, so the ratio isolates the tier); returns events/sec."""
+    from datetime import timedelta
+
+    import numpy as np
+
+    import bytewax_tpu.operators as op
+    import bytewax_tpu.operators.windowing as w
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine.arrays import ArrayBatch
+    from bytewax_tpu.models.brc import ArrayBatchSource
+    from bytewax_tpu.models.windowing_bench import ALIGN_TO
+    from bytewax_tpu.operators.windowing import EventClock, TumblingWindower
+    from bytewax_tpu.testing import TestingSink, run_main
+
+    rng = np.random.RandomState(42)
+    base = np.datetime64(ALIGN_TO.replace(tzinfo=None), "us")
+    batches = []
+    for i in range(0, n_rows, batch_rows):
+        m = min(batch_rows, n_rows - i)
+        secs = (np.arange(i, i + m) // 10).astype("timedelta64[s]")
+        batches.append(
+            ArrayBatch(
+                {
+                    "key": rng.randint(0, 2, size=m).astype(str),
+                    "ts": base + secs,
+                }
+            )
+        )
+    clock = EventClock(
+        ts_getter=lambda x: x, wait_for_system_duration=timedelta(0)
+    )
+    windower = TumblingWindower(
+        align_to=ALIGN_TO, length=timedelta(minutes=1)
+    )
+    out = []
+    flow = Dataflow("winbench")
+    s = op.input("in", flow, ArrayBatchSource(batches))
+    wo = w.count_window("count", s, clock, windower, key=lambda x: x)
+    op.output("out", wo.down, TestingSink(out))
+    os.environ["BYTEWAX_TPU_ACCEL"] = "1" if accel else "0"
+    try:
+        t0 = time.perf_counter()
+        run_main(flow)
+        dt = time.perf_counter() - t0
+    finally:
+        os.environ.pop("BYTEWAX_TPU_ACCEL", None)
+    return n_rows / dt
+
+
+def _run_window_close_p99(n_batches: int = 200, batch_size: int = 1000):
+    """p99 window-close latency: wall time from the source emitting
+    the batch whose events push the watermark past a window's close to
+    the close (meta) event reaching the sink.  A progressive event-
+    time stream (1 s per item, 2 keys, 1-min tumbling) closes ~16
+    windows per batch at steady state."""
+    from datetime import timedelta
+
+    import numpy as np
+
+    import bytewax_tpu.operators as op
+    import bytewax_tpu.operators.windowing as w
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+    from bytewax_tpu.models.windowing_bench import ALIGN_TO
+    from bytewax_tpu.operators.windowing import EventClock, TumblingWindower
+    from bytewax_tpu.outputs import DynamicSink, StatelessSinkPartition
+    from bytewax_tpu.testing import TestingSink, run_main
+
+    wm_log = []  # (wall, max event ts) after each emitted batch
+    meta_log = []  # (wall, close_time) per window-close meta event
+
+    class _Src(StatelessSourcePartition):
+        def __init__(self):
+            self._i = 0
+
+        def next_batch(self):
+            if self._i >= n_batches:
+                raise StopIteration()
+            lo = self._i * batch_size
+            batch = [
+                ALIGN_TO + timedelta(seconds=lo + j)
+                for j in range(batch_size)
+            ]
+            self._i += 1
+            wm_log.append(
+                (time.perf_counter(), lo + batch_size - 1, self._i - 1)
+            )
+            return batch
+
+    class _SrcSource(DynamicSource):
+        def build(self, step_id, worker_index, worker_count):
+            return _Src() if worker_index == 0 else _Empty()
+
+    class _Empty(StatelessSourcePartition):
+        def next_batch(self):
+            raise StopIteration()
+
+    class _MetaPart(StatelessSinkPartition):
+        def write_batch(self, items):
+            now = time.perf_counter()
+            meta_log.extend((now, it) for it in items)
+
+    class _MetaSink(DynamicSink):
+        def build(self, step_id, worker_index, worker_count):
+            return _MetaPart()
+
+    clock = EventClock(
+        ts_getter=lambda x: x, wait_for_system_duration=timedelta(0)
+    )
+    windower = TumblingWindower(
+        align_to=ALIGN_TO, length=timedelta(minutes=1)
+    )
+    flow = Dataflow("close_lat")
+    import random
+
+    rand = random.Random(7)
+    s = op.input("in", flow, _SrcSource())
+    wo = w.count_window(
+        "count", s, clock, windower, key=lambda _x: str(rand.randrange(2))
+    )
+    drop = op.filter("drop", wo.down, lambda _x: False)
+    op.output("down", drop, TestingSink([]))
+    op.output("meta", wo.meta, _MetaSink())
+    run_main(flow)
+
+    # Latency per close: sink wall minus the wall of the first batch
+    # whose max event ts reached the close.  Closes crossed by the
+    # first batches are excluded — they time jit compilation, not the
+    # steady state a latency percentile is about.
+    import bisect
+
+    warmup_batches = max(5, n_batches // 10)
+    lats = []
+    walls = [wl for wl, _ts, _b in wm_log]
+    maxes = [ts for _wl, ts, _b in wm_log]
+    for recv_wall, item in meta_log:
+        _key, (_wid, meta) = item
+        close_s = (meta.close_time - ALIGN_TO).total_seconds()
+        i = bisect.bisect_left(maxes, close_s)  # first max ts >= close
+        if i < len(walls) and wm_log[i][2] >= warmup_batches:
+            lats.append(recv_wall - walls[i])
+    if not lats:
+        return None, 0
+    lats.sort()
+    return lats[int(len(lats) * 0.99)], len(lats)
+
+
+# -- wordcount ---------------------------------------------------------------
+
+
+def _run_wordcount(n_lines: int, words_per_line: int = 10) -> float:
+    """Host-tier wordcount (reference: examples/wordcount.py);
+    returns word-events/sec."""
+    import numpy as np
+
+    from bytewax_tpu.models.wordcount import wordcount_flow
+    from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+    import itertools
+    import string
+
+    rng = np.random.RandomState(0)
+    # Letter-only words (the default tokenizer strips digits).
+    vocab = np.array(
+        [
+            "w" + "".join(c)
+            for c in itertools.islice(
+                itertools.product(string.ascii_lowercase, repeat=3), 1000
+            )
+        ]
+    )
+    lines = [
+        " ".join(vocab[rng.randint(0, 1000, size=words_per_line)])
+        for _ in range(n_lines)
+    ]
+    out = []
+    flow = wordcount_flow(
+        TestingSource(lines, batch_size=1000), TestingSink(out)
+    )
+    t0 = time.perf_counter()
+    run_main(flow)
+    dt = time.perf_counter() - t0
+    assert len(out) == 1000
+    return n_lines * words_per_line / dt
+
+
+# -- isolated device step ----------------------------------------------------
+
+
+def _device_step_ms(n_rows: int = 1 << 20, reps: int = 5):
+    """Milliseconds per n_rows-row scatter-combine on the device
+    (steady state, including the host->device transfer), plus the
+    mesh-sharded all_to_all step time when >1 device is present."""
+    import jax
+    import numpy as np
+
+    from bytewax_tpu.engine.xla import DeviceAggState
+
+    rng = np.random.RandomState(0)
+    slots = rng.randint(0, 413, size=n_rows).astype(np.int32)
+    vals = rng.randn(n_rows).astype(np.float32)
+
+    st = DeviceAggState("stats")
+    for k in range(413):
+        st.alloc(f"s{k:03d}")
+    st.update_slots(slots[: 1 << 16], vals[: 1 << 16])  # warm small
+    st.update_slots(slots, vals)  # warm the timed shape
+    jax.block_until_ready(st._fields)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        st.update_slots(slots, vals)
+    jax.block_until_ready(st._fields)
+    single_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    sharded_ms = None
+    if len(jax.local_devices()) > 1:
+        from bytewax_tpu.engine.sharded_state import ShardedAggState
+        from bytewax_tpu.parallel.mesh import make_mesh
+
+        sst = ShardedAggState("stats", make_mesh())
+        kid_table = np.asarray(
+            [sst.alloc(f"s{k:03d}") for k in range(413)], dtype=np.int32
+        )
+        kids = kid_table[slots]
+        sst._dispatch(kids[: 1 << 16], vals[: 1 << 16])
+        sst._dispatch(kids, vals)
+        jax.block_until_ready(sst._fields)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            sst._dispatch(kids, vals)
+        jax.block_until_ready(sst._fields)
+        sharded_ms = (time.perf_counter() - t0) / reps * 1e3
+    return single_ms, sharded_ms
+
+
 def main() -> None:
     if not _probe_accelerator():
         # The accelerator is unreachable (e.g. tunnel down): run both
@@ -104,6 +376,39 @@ def main() -> None:
     xla_rate = max(_run_columnar(xla_rows, batch_rows) for _ in range(reps))
     host_rate = _run_host(host_rows, batch_rows)
 
+    win_ref = _run_windowing_host(100_000, 10)  # the reference shape
+    win_accel_rows = int(os.environ.get("BENCH_WIN_ROWS", 4_000_000))
+    _run_windowing_columnar(1 << 18, 1 << 18, accel=True)  # warm
+    win_accel = max(
+        _run_windowing_columnar(win_accel_rows, 1 << 19, accel=True)
+        for _ in range(2)
+    )
+    win_host = _run_windowing_columnar(
+        min(win_accel_rows, 1 << 21), 1 << 19, accel=False
+    )
+    p99_s, n_closes = _run_window_close_p99()
+    wc_rate = _run_wordcount(50_000)
+    step_ms, sharded_ms = _device_step_ms()
+
+    extra = {
+        "windowing_ref_shape_events_per_sec": round(win_ref),
+        "windowing_accel_events_per_sec": round(win_accel),
+        "windowing_host_events_per_sec": round(win_host),
+        "windowing_accel_vs_host": round(win_accel / win_host, 2),
+        "window_close_p99_ms": (
+            round(p99_s * 1e3, 3) if p99_s is not None else None
+        ),
+        "window_closes_measured": n_closes,
+        "wordcount_events_per_sec": round(wc_rate),
+        "device_step_1m_rows_ms": round(step_ms, 3),
+        "host_events_per_sec": round(host_rate),
+    }
+    if sharded_ms is not None:
+        extra["sharded_step_1m_rows_ms"] = round(sharded_ms, 3)
+        extra["sharded_devices"] = len(
+            __import__("jax").local_devices()
+        )
+
     print(
         json.dumps(
             {
@@ -111,6 +416,7 @@ def main() -> None:
                 "value": round(xla_rate),
                 "unit": "events/s/chip",
                 "vs_baseline": round(xla_rate / host_rate, 2),
+                "extra": extra,
             }
         )
     )

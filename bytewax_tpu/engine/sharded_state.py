@@ -56,19 +56,33 @@ def make_agg_state(kind: str):
     want = os.environ.get("BYTEWAX_TPU_SHARD", "auto")
     if want == "0":
         return DeviceAggState(kind)
+    if want not in ("auto", ""):
+        try:
+            limit = int(want)
+        except ValueError:
+            msg = (
+                f"BYTEWAX_TPU_SHARD={want!r} is not valid; use '0' "
+                "(single device), 'auto', or a device count"
+            )
+            raise ValueError(msg) from None
+    else:
+        limit = None
     try:
         import jax
 
-        n = len(jax.local_devices())
+        # local_devices only: this process can only shard state over
+        # devices it can address (each process of a multi-host pod
+        # builds its own mesh; cross-process routing stays host-tier).
+        devices = jax.local_devices()
     except Exception:  # noqa: BLE001 — no reachable backend
         return DeviceAggState(kind)
-    if want not in ("auto", ""):
-        n = min(n, int(want))
-    if n <= 1:
+    if limit is not None:
+        devices = devices[:limit]
+    if len(devices) <= 1:
         return DeviceAggState(kind)
     from bytewax_tpu.parallel.mesh import make_mesh
 
-    return ShardedAggState(kind, make_mesh(n))
+    return ShardedAggState(kind, make_mesh(devices=devices))
 
 
 def _pow2(n: int, floor: int) -> int:
