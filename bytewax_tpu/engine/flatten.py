@@ -64,25 +64,37 @@ def _window_accel_spec(op: Operator):
         SlidingWindower,
         TumblingWindower,
     )
-    from bytewax_tpu.xla import Reducer
+    from bytewax_tpu.xla import Reducer, WindowFold
 
+    folder = op.conf.get("folder")
     if op.name == "count_window":
         kind = "count"
     elif op.name == "reduce_window" and isinstance(
         op.conf.get("reducer"), Reducer
     ):
         kind = op.conf["reducer"].kind
-    elif op.name == "fold_window" and isinstance(
-        op.conf.get("folder"), Reducer
-    ):
-        kind = op.conf["folder"].kind
+    elif op.name == "fold_window" and isinstance(folder, (Reducer, WindowFold)):
+        from bytewax_tpu.ops.segment import AGG_KINDS
+
+        kind = folder.kind
+        if kind not in AGG_KINDS:
+            # User-constructed Reducer/WindowFold with a kind the
+            # device tier has no lowering for: stay host-side.
+            return None
         # The device fold starts from the kind's identity; a builder
         # with any other initial accumulator must stay host-side.
         # NOTE: the probe runs the user's builder at plan time — a
         # builder with side effects observes one extra call.
-        identity = {"sum": 0, "min": float("inf"), "max": float("-inf")}
+        if isinstance(folder, WindowFold):
+            expected = folder.make_acc()
+        else:
+            expected = {
+                "sum": 0,
+                "min": float("inf"),
+                "max": float("-inf"),
+            }.get(kind)
         try:
-            if op.conf["builder"]() != identity.get(kind):
+            if op.conf["builder"]() != expected:
                 return None
         except Exception as ex:  # noqa: BLE001
             import warnings

@@ -348,9 +348,10 @@ class DeviceWindowAggState:
             return 0 if kind == "count" else None
         if kind == "count":
             return int(snap)
-        if kind == "mean":
-            total, count = snap
-            return total / count if count else 0.0
+        # mean/stats windows emit the raw accumulator ((sum, count) /
+        # (min, max, sum, count)) exactly like the host-tier
+        # WindowFold; finalization happens downstream (mean_window /
+        # stats_window append it).
         return snap
 
     def on_notify(self) -> List[Tuple[str, Tuple[int, str, Any]]]:

@@ -96,8 +96,10 @@ __all__ = [
     "fold_window",
     "join_window",
     "max_window",
+    "mean_window",
     "min_window",
     "reduce_window",
+    "stats_window",
     "window",
 ]
 
@@ -986,6 +988,66 @@ def min_window(
     return reduce_window(
         "reduce_window", up, clock, windower, lambda a, b: min(a, b, key=by)
     )
+
+
+def _window_fold_op(up, clock, windower, fold) -> "WindowOut":
+    """fold_window with a ``bytewax_tpu.xla.WindowFold`` (lowered to
+    one device scatter-combine per micro-batch) plus its finalizer
+    applied to the emitted accumulators."""
+    wo = fold_window(
+        "fold_window",
+        up,
+        clock,
+        windower,
+        fold.make_acc,
+        fold,
+        fold.merge,
+        ordered=False,
+    )
+    down = op.map_value(
+        "finalize", wo.down, lambda p: (p[0], fold.finalize(p[1]))
+    )
+    return WindowOut(down, wo.late, wo.meta)
+
+
+@operator
+def mean_window(
+    step_id: str,
+    up: KeyedStream[V],
+    clock: Clock[V, Any],
+    windower: Windower[Any],
+) -> WindowOut[V, float]:
+    """Arithmetic mean of the values per key per window, emitted at
+    window close.
+
+    The fold keeps a ``(sum, count)`` accumulator the engine lowers
+    to one device scatter-combine per micro-batch (see
+    ``bytewax_tpu.xla.MEAN``); no reference counterpart — a TPU-tier
+    extension of the ``max_window``/``min_window`` family.
+    """
+    from bytewax_tpu.xla import MEAN
+
+    return _window_fold_op(up, clock, windower, MEAN)
+
+
+@operator
+def stats_window(
+    step_id: str,
+    up: KeyedStream[V],
+    clock: Clock[V, Any],
+    windower: Windower[Any],
+) -> WindowOut[V, tuple]:
+    """Min/mean/max/count per key per window in one pass (the 1BRC
+    shape, windowed), emitted at window close as ``(min, mean, max,
+    count)``.
+
+    The fold keeps a ``(min, max, sum, count)`` accumulator the
+    engine lowers to one device scatter-combine per micro-batch (see
+    ``bytewax_tpu.xla.STATS``).
+    """
+    from bytewax_tpu.xla import STATS
+
+    return _window_fold_op(up, clock, windower, STATS)
 
 
 def _collect_list_folder(acc: List, v: Any) -> List:

@@ -31,9 +31,12 @@ __all__ = [
     "column_ts",
     "JaxUDF",
     "MAX",
+    "MEAN",
     "MIN",
     "Reducer",
+    "STATS",
     "SUM",
+    "WindowFold",
     "jit_batch",
     "map_batch",
     "stats_final",
@@ -62,6 +65,59 @@ class Reducer:
 SUM = Reducer("sum", lambda a, b: a + b)
 MIN = Reducer("min", lambda a, b: min(a, b))
 MAX = Reducer("max", lambda a, b: max(a, b))
+
+
+class WindowFold:
+    """A windowed fold with a device lowering.
+
+    Unlike a :class:`Reducer` (a binary combine over values), a
+    ``WindowFold`` folds values into a structured accumulator —
+    ``mean`` keeps ``(sum, count)``, ``stats`` keeps ``(min, max,
+    sum, count)`` — which is exactly a row of the device tier's slot
+    table, so ``fold_window(step, up, clock, windower,
+    MEAN.make_acc, MEAN, MEAN.merge)`` lowers to one scatter-combine
+    per micro-batch.  On the host tier it is a plain callable folder.
+
+    The window emits the raw accumulator at close (both tiers);
+    apply :meth:`finalize` downstream for the human-facing value, or
+    use the :func:`bytewax_tpu.operators.windowing.mean_window` /
+    ``stats_window`` wrappers which do it for you.
+    """
+
+    def __init__(self, kind: str, make_acc, fold, merge, finalize):
+        self.kind = kind
+        self.make_acc = make_acc
+        self._fold = fold
+        self.merge = merge
+        self.finalize = finalize
+
+    def __call__(self, acc, v):
+        return self._fold(acc, v)
+
+    def __repr__(self) -> str:
+        return f"bytewax_tpu.xla.{self.kind.upper()}"
+
+
+MEAN = WindowFold(
+    "mean",
+    lambda: (0.0, 0),
+    lambda a, v: (a[0] + v, a[1] + 1),
+    lambda a, b: (a[0] + b[0], a[1] + b[1]),
+    lambda a: a[0] / a[1] if a[1] else 0.0,
+)
+
+STATS = WindowFold(
+    "stats",
+    lambda: (float("inf"), float("-inf"), 0.0, 0),
+    lambda a, v: (min(a[0], v), max(a[1], v), a[2] + v, a[3] + 1),
+    lambda a, b: (
+        min(a[0], b[0]),
+        max(a[1], b[1]),
+        a[2] + b[2],
+        a[3] + b[3],
+    ),
+    lambda a: (a[0], a[2] / a[3] if a[3] else 0.0, a[1], a[3]),
+)
 
 
 class JaxUDF:

@@ -508,3 +508,128 @@ def test_window_accel_host_to_device_recovery(tmp_path, monkeypatch):
     monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
     run_main(flow, epoch_interval=timedelta(0), recovery_config=rc)
     assert out == [("k", (0, 9))]
+
+
+@pytest.mark.parametrize("kind", ["mean", "stats"])
+def test_windowed_mean_stats_device_matches_host(monkeypatch, kind):
+    # mean/stats windowed folds lower to the device slot table; output
+    # must match the host tier folding the same columnar rows.
+    import bytewax_tpu.operators.windowing as w2
+    from bytewax_tpu import xla
+    from bytewax_tpu.engine.arrays import ArrayBatch
+    from tests.test_xla import ArraySource
+
+    n = 3000
+    rng = np.random.RandomState(11)
+    secs = np.sort(rng.randint(0, 300, size=n))
+    keys = np.array([f"key{k}" for k in rng.randint(0, 3, size=n)])
+    vals = (rng.randn(n) * 5).round(2)
+    ts = (
+        np.datetime64(ALIGN.replace(tzinfo=None), "us")
+        + secs.astype("timedelta64[s]")
+    )
+    windower = TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN)
+    op_fn = w2.mean_window if kind == "mean" else w2.stats_window
+
+    def run(accel):
+        monkeypatch.setenv("BYTEWAX_TPU_ACCEL", accel)
+        batches = [
+            ArrayBatch(
+                {
+                    "key": keys[i : i + 512],
+                    "ts": ts[i : i + 512],
+                    "value": vals[i : i + 512],
+                }
+            )
+            for i in range(0, n, 512)
+        ]
+        clock = EventClock(
+            ts_getter=xla.column_ts,
+            wait_for_system_duration=timedelta(seconds=30),
+        )
+        out = []
+        flow = Dataflow("test_df")
+        s = op.input("inp", flow, ArraySource(batches))
+        wo = op_fn(kind, s, clock, windower)
+        op.output("out", wo.down, TestingSink(out))
+        run_main(flow)
+        return sorted(out)
+
+    device, host = run("1"), run("0")
+    assert [kv[0] for kv in device] == [kv[0] for kv in host]
+    for (k, (wid_d, v_d)), (_k, (wid_h, v_h)) in zip(device, host):
+        assert wid_d == wid_h
+        np.testing.assert_allclose(v_d, v_h, rtol=1e-4, err_msg=k)
+
+    # And against a numpy oracle (mean case).
+    if kind == "mean":
+        expected = {}
+        for k, s_, v in zip(keys.tolist(), secs.tolist(), vals.tolist()):
+            expected.setdefault((k, s_ // 60), []).append(v)
+        got = {(k, wid): v for k, (wid, v) in device}
+        assert set(got) == set(expected)
+        for key2, rows in expected.items():
+            np.testing.assert_allclose(
+                got[key2], np.mean(rows), rtol=1e-4, err_msg=str(key2)
+            )
+
+
+def test_fold_window_with_mean_marker_is_annotated():
+    # The VERDICT bar: fold_window(..., MEAN)-style flows lower.
+    from bytewax_tpu import xla
+    from bytewax_tpu.engine.flatten import flatten
+    from bytewax_tpu.engine.window_accel import WindowAccelSpec
+
+    clock = EventClock(
+        ts_getter=lambda v: ALIGN, wait_for_system_duration=timedelta(0)
+    )
+    windower = TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN)
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource([]))
+    wo = w.fold_window(
+        "m", s, clock, windower, xla.MEAN.make_acc, xla.MEAN, xla.MEAN.merge
+    )
+    op.output("out", wo.down, TestingSink([]))
+    plan = flatten(flow)
+    stateful = [o for o in plan.ops if o.name == "stateful_batch"]
+    spec = stateful[0].conf.get("_accel")
+    assert isinstance(spec, WindowAccelSpec)
+    assert spec.kind == "mean"
+
+
+def test_mean_window_cross_tier_recovery(tmp_path, monkeypatch):
+    # mean windows crash on the device tier and resume on the host
+    # tier (and the accumulator format crosses over).
+    import bytewax_tpu.operators.windowing as w2
+    from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+
+    init_db_dir(tmp_path, 1)
+    rc = RecoveryConfig(str(tmp_path))
+    ts_map = {
+        2.0: ALIGN + timedelta(seconds=1),
+        4.0: ALIGN + timedelta(seconds=2),
+        9.0: ALIGN + timedelta(seconds=3),
+    }
+    clock = EventClock(
+        ts_getter=lambda v: ts_map[v],
+        wait_for_system_duration=timedelta(days=999),
+    )
+    windower = TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN)
+    inp = [
+        ("k", 2.0),
+        ("k", 4.0),
+        TestingSource.ABORT(),
+        ("k", 9.0),
+    ]
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    wo = w2.mean_window("mean", s, clock, windower)
+    op.output("out", wo.down, TestingSink(out))
+
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
+    run_main(flow, epoch_interval=timedelta(0), recovery_config=rc)
+    assert out == []
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
+    run_main(flow, epoch_interval=timedelta(0), recovery_config=rc)
+    assert out == [("k", (0, 5.0))]
