@@ -66,6 +66,15 @@ def _window_accel_spec(op: Operator):
     )
     from bytewax_tpu.xla import Reducer, WindowFold
 
+    from bytewax_tpu.ops.segment import AGG_KINDS
+
+    # A Reducer is a binary combine over bare values — only these
+    # kinds have that shape on the device tier (a Reducer("mean")
+    # would wrongly fold (sum, count) instead of applying its fn).
+    # WindowFolds carry a structured accumulator and may use any
+    # implemented kind.
+    reducer_identity = {"sum": 0, "min": float("inf"), "max": float("-inf")}
+
     folder = op.conf.get("folder")
     if op.name == "count_window":
         kind = "count"
@@ -73,26 +82,26 @@ def _window_accel_spec(op: Operator):
         op.conf.get("reducer"), Reducer
     ):
         kind = op.conf["reducer"].kind
-    elif op.name == "fold_window" and isinstance(folder, (Reducer, WindowFold)):
-        from bytewax_tpu.ops.segment import AGG_KINDS
-
-        kind = folder.kind
-        if kind not in AGG_KINDS:
-            # User-constructed Reducer/WindowFold with a kind the
-            # device tier has no lowering for: stay host-side.
+        if kind not in reducer_identity:
+            # User-constructed Reducer with a kind the device tier
+            # has no binary-reduce lowering for: stay host-side.
             return None
+    elif op.name == "fold_window" and isinstance(folder, (Reducer, WindowFold)):
+        kind = folder.kind
+        if isinstance(folder, WindowFold):
+            if kind not in AGG_KINDS:
+                # User-constructed WindowFold with a kind the device
+                # tier has no lowering for: stay host-side.
+                return None
+            expected = folder.make_acc()
+        else:
+            if kind not in reducer_identity:
+                return None
+            expected = reducer_identity[kind]
         # The device fold starts from the kind's identity; a builder
         # with any other initial accumulator must stay host-side.
         # NOTE: the probe runs the user's builder at plan time — a
         # builder with side effects observes one extra call.
-        if isinstance(folder, WindowFold):
-            expected = folder.make_acc()
-        else:
-            expected = {
-                "sum": 0,
-                "min": float("inf"),
-                "max": float("-inf"),
-            }.get(kind)
         try:
             if op.conf["builder"]() != expected:
                 return None
