@@ -16,11 +16,10 @@ from prometheus_client.exposition import generate_latest
 
 __all__ = [
     "DURATION_BUCKETS",
+    "DURATION_HISTOGRAMS",
     "generate_python_metrics",
     "item_inp_count",
     "item_out_count",
-    "snapshot_duration",
-    "step_duration",
 ]
 
 #: Explicit histogram buckets, matching the reference
@@ -55,19 +54,46 @@ item_out_count = Counter(
     ["step_id", "worker_index"],
 )
 
-step_duration = Histogram(
-    "bytewax_step_duration_seconds",
-    "Time spent running user code in a step",
-    ["step_id"],
-    buckets=DURATION_BUCKETS,
-)
+def _duration(name: str, doc: str) -> Histogram:
+    return Histogram(
+        f"bytewax_{name}_duration_seconds",
+        doc,
+        ["step_id", "worker_index"],
+        buckets=DURATION_BUCKETS,
+    )
 
-snapshot_duration = Histogram(
-    "bytewax_snapshot_duration_seconds",
-    "Time spent snapshotting state at epoch close",
-    ["step_id"],
-    buckets=DURATION_BUCKETS,
-)
+
+#: ``with_timer!``-parity histograms around every user-code call site
+#: (reference inventory: ``src/operators.rs:154-167``, ``:599-631``,
+#: ``src/inputs.rs:287-307``, ``src/outputs.rs:261-277``), keyed by
+#: the reference's metric stem.
+DURATION_HISTOGRAMS: Dict[str, Histogram] = {
+    "flat_map_batch": _duration(
+        "flat_map_batch", "Time running a flat_map_batch mapper"
+    ),
+    "inp_part_next_batch": _duration(
+        "inp_part_next_batch", "Time running a source partition's next_batch"
+    ),
+    "out_part_write_batch": _duration(
+        "out_part_write_batch", "Time running a sink partition's write_batch"
+    ),
+    "snapshot": _duration(
+        "snapshot", "Time snapshotting state at epoch close"
+    ),
+    "stateful_batch_on_batch": _duration(
+        "stateful_batch_on_batch",
+        "Time running stateful logic on_batch (or the device fold)",
+    ),
+    "stateful_batch_on_notify": _duration(
+        "stateful_batch_on_notify", "Time running stateful logic on_notify"
+    ),
+    "stateful_batch_on_eof": _duration(
+        "stateful_batch_on_eof", "Time running stateful logic on_eof"
+    ),
+    "stateful_batch_notify_at": _duration(
+        "stateful_batch_notify_at", "Time running stateful logic notify_at"
+    ),
+}
 
 
 def generate_python_metrics() -> str:

@@ -108,6 +108,24 @@ class _OpRt:
         # parity with the reference: src/operators.rs:154-167).
         self._m_inp: Dict[int, Any] = {}
         self._m_out: Dict[int, Any] = {}
+        self._m_timers: Dict[str, Any] = {}
+
+    def _timer(self, stem: str, w: Optional[int] = None) -> Any:
+        """Cached duration-histogram child for this step (with_timer!
+        parity: every user-code call site records its duration,
+        src/metrics/mod.rs:8-16).  ``w`` is the worker lane the call
+        is attributed to (matching the item counters' label); sites
+        without a natural lane use the process's first."""
+        if w is None:
+            w = self.driver.local_lo
+        key = (stem, w)
+        h = self._m_timers.get(key)
+        if h is None:
+            from bytewax_tpu._metrics import DURATION_HISTOGRAMS
+
+            h = DURATION_HISTOGRAMS[stem].labels(self.op.step_id, str(w))
+            self._m_timers[key] = h
+        return h
 
     def _count_inp(self, w: int, n: int) -> None:
         c = self._m_inp.get(w)
@@ -232,7 +250,10 @@ class _InputRt(_OpRt):
             if na is not None and na > now:
                 continue
             try:
-                batch = part.next_batch()
+                with self._timer(
+                    "inp_part_next_batch", self.part_worker.get(name)
+                ).time():
+                    batch = part.next_batch()
                 if not isinstance(batch, (list, ArrayBatch)):
                     batch = list(batch)
             except StopIteration:
@@ -271,7 +292,10 @@ class _InputRt(_OpRt):
         snaps, self.pending_snaps = self.pending_snaps, []
         for name, part in self.parts.items():
             try:
-                snaps.append((name, part.snapshot()))
+                with self._timer(
+                    "snapshot", self.part_worker.get(name)
+                ).time():
+                    snaps.append((name, part.snapshot()))
             except BaseException as ex:  # noqa: BLE001
                 _reraise(self.op.step_id, "`snapshot`", ex)
         return snaps
@@ -290,7 +314,8 @@ class _FlatMapBatchRt(_OpRt):
     def process(self, port: str, entries: List[Entry]) -> None:
         for w, items in entries:
             try:
-                out = self.mapper(items)
+                with self._timer("flat_map_batch", w).time():
+                    out = self.mapper(items)
                 if not isinstance(out, (list, ArrayBatch)):
                     out = list(out)
             except BaseException as ex:  # noqa: BLE001
@@ -428,7 +453,8 @@ class _StatefulBatchRt(_OpRt):
 
     def _resched(self, key: str, logic: Any) -> None:
         try:
-            at = logic.notify_at()
+            with self._timer("stateful_batch_notify_at").time():
+                at = logic.notify_at()
         except BaseException as ex:  # noqa: BLE001
             _reraise(self.op.step_id, "`notify_at`", ex)
         if at is not None:
@@ -506,7 +532,8 @@ class _StatefulBatchRt(_OpRt):
                 )
             ):
                 try:
-                    events = self.wagg.on_batch_columnar(items)
+                    with self._timer("stateful_batch_on_batch").time():
+                        events = self.wagg.on_batch_columnar(items)
                 except BaseException as ex:  # noqa: BLE001
                     _reraise(
                         self.op.step_id, "the device window fold", ex
@@ -537,7 +564,8 @@ class _StatefulBatchRt(_OpRt):
             if not keys:
                 continue
             try:
-                events = self.wagg.on_batch(keys, values)
+                with self._timer("stateful_batch_on_batch").time():
+                    events = self.wagg.on_batch(keys, values)
             except BaseException as ex:  # noqa: BLE001
                 _reraise(self.op.step_id, "the device window fold", ex)
             self._emit_window_events(events)
@@ -563,8 +591,12 @@ class _StatefulBatchRt(_OpRt):
                 if logic is None:
                     logic = self._build(None)
                     self.logics[key] = logic
+                w_home = _route_hash(key) % self.driver.worker_count
                 try:
-                    emits, discard = logic.on_batch(values)
+                    with self._timer(
+                        "stateful_batch_on_batch", w_home
+                    ).time():
+                        emits, discard = logic.on_batch(values)
                 except BaseException as ex:  # noqa: BLE001
                     _reraise(self.op.step_id, "`on_batch`", ex)
                 self._handle(key, emits, discard, out)
@@ -574,20 +606,21 @@ class _StatefulBatchRt(_OpRt):
         assert self.agg is not None
         for i, (_w, items) in enumerate(entries):
             try:
-                if isinstance(items, ArrayBatch):
-                    touched = self.agg.update_batch(items)
-                else:
-                    keys = []
-                    values = []
-                    for item in items:
-                        k, v = _extract_kv(item, self.op.step_id)
-                        keys.append(k)
-                        values.append(v)
-                    if not keys:
-                        continue
-                    touched = self.agg.update(
-                        np.asarray(keys), np.asarray(values)
-                    )
+                with self._timer("stateful_batch_on_batch").time():
+                    if isinstance(items, ArrayBatch):
+                        touched = self.agg.update_batch(items)
+                    else:
+                        keys = []
+                        values = []
+                        for item in items:
+                            k, v = _extract_kv(item, self.op.step_id)
+                            keys.append(k)
+                            values.append(v)
+                        if not keys:
+                            continue
+                        touched = self.agg.update(
+                            np.asarray(keys), np.asarray(values)
+                        )
             except NonNumericValues as ex:
                 if not self.agg.keys() and not self.logics:
                     # Non-numeric values: permanently fall back to the
@@ -605,7 +638,8 @@ class _StatefulBatchRt(_OpRt):
             at = self.wagg.notify_at()
             if at is not None and at <= now:
                 try:
-                    events = self.wagg.on_notify()
+                    with self._timer("stateful_batch_on_notify").time():
+                        events = self.wagg.on_notify()
                 except BaseException as ex:  # noqa: BLE001
                     _reraise(self.op.step_id, "the device window fold", ex)
                 self._emit_window_events(events)
@@ -622,8 +656,10 @@ class _StatefulBatchRt(_OpRt):
                 self.sched.pop(key, None)
                 continue
             self.sched.pop(key, None)
+            w_home = _route_hash(key) % self.driver.worker_count
             try:
-                emits, discard = logic.on_notify()
+                with self._timer("stateful_batch_on_notify", w_home).time():
+                    emits, discard = logic.on_notify()
             except BaseException as ex:  # noqa: BLE001
                 _reraise(self.op.step_id, "`on_notify`", ex)
             self._handle(key, emits, discard, out)
@@ -632,7 +668,8 @@ class _StatefulBatchRt(_OpRt):
     def on_upstream_eof(self) -> None:
         if self.wagg is not None:
             try:
-                events = self.wagg.on_eof()
+                with self._timer("stateful_batch_on_eof").time():
+                    events = self.wagg.on_eof()
             except BaseException as ex:  # noqa: BLE001
                 _reraise(self.op.step_id, "the device window fold", ex)
             self._emit_window_events(events)
@@ -640,7 +677,9 @@ class _StatefulBatchRt(_OpRt):
         if self.agg is not None:
             out: Dict[int, List[Any]] = {}
             w_count = self.driver.worker_count
-            for key, value in self.agg.finalize():
+            with self._timer("stateful_batch_on_eof").time():
+                finalized = self.agg.finalize()
+            for key, value in finalized:
                 out.setdefault(_route_hash(key) % w_count, []).append(
                     (key, value)
                 )
@@ -650,8 +689,10 @@ class _StatefulBatchRt(_OpRt):
         out = {}
         for key in sorted(self.logics.keys()):
             logic = self.logics[key]
+            w_home = _route_hash(key) % self.driver.worker_count
             try:
-                emits, discard = logic.on_eof()
+                with self._timer("stateful_batch_on_eof", w_home).time():
+                    emits, discard = logic.on_eof()
             except BaseException as ex:  # noqa: BLE001
                 _reraise(self.op.step_id, "`on_eof`", ex)
             self._handle(key, emits, discard, out)
@@ -664,14 +705,16 @@ class _StatefulBatchRt(_OpRt):
 
     def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
         if self.wagg is not None:
-            snaps = self.wagg.snapshots_for(
-                sorted(self.awoken | self.wagg.touched)
-            )
+            with self._timer("snapshot").time():
+                snaps = self.wagg.snapshots_for(
+                    sorted(self.awoken | self.wagg.touched)
+                )
             self.awoken.clear()
             self.wagg.touched.clear()
             return snaps
         if self.agg is not None:
-            snaps = self.agg.snapshots_for(sorted(self.awoken))
+            with self._timer("snapshot").time():
+                snaps = self.agg.snapshots_for(sorted(self.awoken))
             self.awoken.clear()
             return snaps
         snaps: List[Tuple[str, Optional[Any]]] = []
@@ -680,8 +723,10 @@ class _StatefulBatchRt(_OpRt):
             if logic is None:
                 snaps.append((key, None))
             else:
+                w_home = _route_hash(key) % self.driver.worker_count
                 try:
-                    snaps.append((key, logic.snapshot()))
+                    with self._timer("snapshot", w_home).time():
+                        snaps.append((key, logic.snapshot()))
                 except BaseException as ex:  # noqa: BLE001
                     _reraise(self.op.step_id, "`snapshot`", ex)
         self.awoken.clear()
@@ -761,21 +806,25 @@ class _OutputRt(_OpRt):
                     driver.ship_deliver(self.idx, "up", (owner, group))
                 for name, values in buckets.items():
                     try:
-                        self.parts[name].write_batch(values)
+                        with self._timer(
+                            "out_part_write_batch", self.part_owner[name]
+                        ).time():
+                            self.parts[name].write_batch(values)
                     except BaseException as ex:  # noqa: BLE001
                         _reraise(self.op.step_id, "`write_batch`", ex)
         else:
             for w, items in entries:
                 part = self.parts[f"worker-{w}"]
                 try:
-                    if isinstance(items, ArrayBatch):
-                        writer = getattr(part, "write_array_batch", None)
-                        if writer is not None:
-                            writer(items)
+                    with self._timer("out_part_write_batch", w).time():
+                        if isinstance(items, ArrayBatch):
+                            writer = getattr(part, "write_array_batch", None)
+                            if writer is not None:
+                                writer(items)
+                            else:
+                                part.write_batch(items.to_pylist())
                         else:
-                            part.write_batch(items.to_pylist())
-                    else:
-                        part.write_batch(items)
+                            part.write_batch(items)
                 except BaseException as ex:  # noqa: BLE001
                     _reraise(self.op.step_id, "`write_batch`", ex)
 
@@ -785,7 +834,10 @@ class _OutputRt(_OpRt):
         snaps = []
         for name, part in self.parts.items():
             try:
-                snaps.append((name, part.snapshot()))
+                with self._timer(
+                    "snapshot", self.part_owner[name]
+                ).time():
+                    snaps.append((name, part.snapshot()))
             except BaseException as ex:  # noqa: BLE001
                 _reraise(self.op.step_id, "`snapshot`", ex)
         return snaps

@@ -84,3 +84,65 @@ def test_map_dict_value():
     op.output("out", s, TestingSink(out))
     run_main(flow)
     assert out == [{"name": "ADA", "id": 1}]
+
+
+def test_duration_histograms_observed(monkeypatch):
+    # with_timer! parity (reference src/metrics/mod.rs:8-16): every
+    # user-code call site records a *_duration_seconds histogram.
+    from datetime import datetime, timedelta, timezone
+
+    from prometheus_client import REGISTRY
+
+    import bytewax_tpu.operators.windowing as w
+    from bytewax_tpu.connectors.files import FileSink
+    from bytewax_tpu.operators.windowing import EventClock, TumblingWindower
+
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
+    align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    inp = [align + timedelta(seconds=i) for i in range(50)]
+    clock = EventClock(
+        ts_getter=lambda x: x, wait_for_system_duration=timedelta(0)
+    )
+    windower = TumblingWindower(length=timedelta(seconds=10), align_to=align)
+    out = []
+    flow = Dataflow("hist_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=10))
+    s = op.map("fmt", s, lambda x: x)
+    wo = w.count_window("count", s, clock, windower, key=lambda _x: "k")
+    op.output("out", wo.down, TestingSink(out))
+    run_main(flow, epoch_interval=timedelta(0))
+    assert out  # windows closed
+
+    def count_of(name, step):
+        return REGISTRY.get_sample_value(
+            f"bytewax_{name}_duration_seconds_count",
+            {"step_id": step, "worker_index": "0"},
+        )
+
+    assert count_of("inp_part_next_batch", "hist_df.inp") >= 5
+    assert count_of("flat_map_batch", "hist_df.fmt.flat_map_batch") >= 5
+    assert (
+        count_of(
+            "stateful_batch_on_batch",
+            "hist_df.count.fold_window.window.stateful_batch",
+        )
+        >= 1
+    )
+    assert (
+        count_of(
+            "stateful_batch_on_eof",
+            "hist_df.count.fold_window.window.stateful_batch",
+        )
+        >= 1
+    )
+    assert (
+        count_of(
+            "snapshot", "hist_df.count.fold_window.window.stateful_batch"
+        )
+        >= 1
+    )
+    assert count_of("out_part_write_batch", "hist_df.out") >= 1
+    # And the bucket layout matches the reference (0.0005 .. 10).
+    from bytewax_tpu._metrics import DURATION_BUCKETS
+
+    assert DURATION_BUCKETS[0] == 0.0005 and DURATION_BUCKETS[-1] == 10.0
