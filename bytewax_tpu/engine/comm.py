@@ -12,6 +12,7 @@ rides ICI inside the compiled step instead (see
 ``bytewax_tpu/parallel/exchange.py``).
 """
 
+import os
 import pickle
 import selectors
 import socket
@@ -23,6 +24,10 @@ __all__ = ["Comm"]
 
 _LEN = struct.Struct("<Q")
 _DIAL_TIMEOUT_S = 30.0
+#: Default per-peer raw receive-buffer cap; reading from a peer
+#: pauses above it and resumes below half of it, so a fast producer
+#: sees TCP backpressure instead of ballooning this process's memory.
+_RX_CAP_DEFAULT = 64 * 1024 * 1024
 
 
 class Comm:
@@ -31,6 +36,16 @@ class Comm:
     Handshake: every process listens on ``addresses[proc_id]``; lower
     ids dial higher ids (one socket per pair) and introduce themselves
     with their proc id.
+
+    Receive memory is bounded: each peer's raw rx buffer is capped at
+    ``BYTEWAX_TPU_RX_BUFFER_CAP`` bytes (default 64 MiB).  A peer at
+    the cap is paused (not selected for reading) until its buffered
+    frames are parsed out; between parses its kernel socket buffer
+    fills and TCP flow control pushes back on the sender.  While THIS
+    process is blocked mid-send it keeps reading regardless (two
+    peers bulk-sending to each other must not deadlock) but parses
+    complete frames out of over-cap buffers instead of growing raw
+    bytes — in-flight data per epoch is bounded by the epoch barrier.
     """
 
     def __init__(self, addresses: List[str], proc_id: int):
@@ -38,8 +53,16 @@ class Comm:
         self.proc_count = len(addresses)
         self._socks: dict = {}
         self._rx_buf: dict = {}
+        self._paused: set = set()
+        self._pending: List[Tuple[int, Any]] = []
         self._closed: set = set()
         self._sel = selectors.DefaultSelector()
+        self._rx_cap = int(
+            os.environ.get("BYTEWAX_TPU_RX_BUFFER_CAP", _RX_CAP_DEFAULT)
+        )
+        #: High-water mark of any single peer's raw rx buffer (bytes);
+        #: test/observability hook.
+        self.rx_peak = 0
 
         host, _, port = addresses[proc_id].rpartition(":")
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -105,16 +128,60 @@ class Comm:
             except BlockingIOError:
                 # Our send buffer is full; free the pipeline by
                 # buffering whatever peers are sending us (parsed
-                # later by recv_ready).
-                self._drain_into_buffers(0.01)
+                # later by recv_ready).  mid_send: never pause peers
+                # here — two crossing bulk sends would deadlock — but
+                # parse over-cap buffers so raw bytes stay bounded.
+                self._drain_into_buffers(0.01, mid_send=True)
 
     def broadcast(self, msg: Any) -> None:
         for peer in self._socks:
             self.send(peer, msg)
 
-    def _drain_into_buffers(self, timeout: float) -> None:
+    def _pause(self, peer: int) -> None:
+        sock = self._socks.get(peer)
+        if sock is None or peer in self._paused or peer in self._closed:
+            return
+        try:
+            self._sel.unregister(sock)
+        except (KeyError, ValueError):
+            return
+        self._paused.add(peer)
+
+    def _maybe_resume(self, peer: int) -> None:
+        """Resume reading a paused peer after its frames are parsed
+        out.  Post-parse the leftover is at most one partial frame
+        that can only complete with more bytes, so the resume is
+        unconditional; the pause therefore bounds how much is READ
+        per drain (one cap's worth between parses), which is what
+        bounds raw rx memory — a frame larger than the cap is still
+        receivable (effective bound: max(cap, largest frame))."""
+        if peer not in self._paused:
+            return
+        self._paused.discard(peer)
+        sock = self._socks.get(peer)
+        if sock is not None and peer not in self._closed:
+            self._sel.register(sock, selectors.EVENT_READ, peer)
+
+    def _parse_frames(self, peer: int, out: List[Tuple[int, Any]]) -> None:
+        buf = self._rx_buf[peer]
+        while len(buf) >= _LEN.size:
+            (length,) = _LEN.unpack(buf[: _LEN.size])
+            if len(buf) < _LEN.size + length:
+                break
+            frame = bytes(buf[_LEN.size : _LEN.size + length])
+            del buf[: _LEN.size + length]
+            out.append((peer, pickle.loads(frame)))
+        self._maybe_resume(peer)
+
+    def _drain_into_buffers(self, timeout: float, mid_send: bool = False) -> None:
         """Read available bytes from all peers into rx buffers without
-        parsing (safe to call mid-send)."""
+        parsing (safe to call mid-send).
+
+        A peer whose raw buffer reaches the cap is paused; mid-send
+        (when pausing could deadlock two crossing bulk sends) its
+        complete frames are parsed into the pending queue instead so
+        raw bytes stay bounded either way.
+        """
         for key, _events in self._sel.select(timeout):
             peer = key.data
             sock = key.fileobj
@@ -126,9 +193,19 @@ class Comm:
                             self._sel.unregister(sock)
                         except (KeyError, ValueError):
                             pass
+                        self._paused.discard(peer)
                         self._closed.add(peer)
                         break
-                    self._rx_buf[peer].extend(chunk)
+                    buf = self._rx_buf[peer]
+                    buf.extend(chunk)
+                    if len(buf) > self.rx_peak:
+                        self.rx_peak = len(buf)
+                    if len(buf) >= self._rx_cap:
+                        if mid_send:
+                            self._parse_frames(peer, self._pending)
+                        else:
+                            self._pause(peer)
+                            break
                     if len(chunk) < (1 << 20):
                         break
             except BlockingIOError:
@@ -142,15 +219,13 @@ class Comm:
         raised on a later call.
         """
         self._drain_into_buffers(timeout)
-        out: List[Tuple[int, Any]] = []
-        for peer, buf in self._rx_buf.items():
-            while len(buf) >= _LEN.size:
-                (length,) = _LEN.unpack(buf[: _LEN.size])
-                if len(buf) < _LEN.size + length:
-                    break
-                frame = bytes(buf[_LEN.size : _LEN.size + length])
-                del buf[: _LEN.size + length]
-                out.append((peer, pickle.loads(frame)))
+        out: List[Tuple[int, Any]]
+        if self._pending:
+            out, self._pending = self._pending, []
+        else:
+            out = []
+        for peer in list(self._rx_buf):
+            self._parse_frames(peer, out)
         if not out and self._closed:
             # A peer died mid-run with nothing left to deliver (a
             # normal shutdown never pumps after its final close).

@@ -307,3 +307,110 @@ op.output("out", fmt, FileSink({out_path!r}))
         seen.add((key, wid))
         total += float(val)
     assert total == 400.0
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_comm_rx_buffer_bounded(monkeypatch):
+    # Two peers bulk-sending >100 MB to each other in one epoch with
+    # an 4 MiB rx cap: no deadlock, nothing lost, and neither side's
+    # raw rx buffer materially exceeds the cap.
+    import threading
+
+    from bytewax_tpu.engine.comm import Comm
+
+    cap = 4 * 1024 * 1024
+    monkeypatch.setenv("BYTEWAX_TPU_RX_BUFFER_CAP", str(cap))
+    addrs = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    n_msgs, msg_len = 60, 1_000_000  # ~60 MB each direction
+    payload = b"x" * msg_len
+    results = {}
+    errors = []
+    finished = threading.Barrier(2, timeout=120)
+
+    def run(pid):
+        try:
+            comm = Comm(addrs, pid)
+            got = []
+            # Ship everything, then drain until the peer's full set
+            # arrives (send() itself drains while blocked).
+            for i in range(n_msgs):
+                comm.send(1 - pid, (i, payload))
+            comm.send(1 - pid, "done")
+            done = False
+            while not done or len(got) < n_msgs:
+                for _peer, msg in comm.recv_ready(0.01):
+                    if msg == "done":
+                        done = True
+                    else:
+                        got.append(msg)
+            results[pid] = (got, comm.rx_peak)
+            finished.wait()  # both sides drained before either closes
+            comm.close()
+        except BaseException as ex:  # noqa: BLE001
+            errors.append((pid, ex))
+
+    threads = [threading.Thread(target=run, args=(p,)) for p in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "comm exchange deadlocked"
+    assert not errors, errors
+    for pid in (0, 1):
+        got, peak = results[pid]
+        assert sorted(i for i, _p in got) == list(range(n_msgs))
+        assert all(p == payload for _i, p in got)
+        # Raw buffer bounded: cap plus one read chunk of slack.
+        assert peak <= cap + (1 << 20), f"peer {pid} rx peaked at {peak}"
+
+
+def test_comm_single_frame_larger_than_cap(monkeypatch):
+    # A single frame bigger than the cap must still be receivable
+    # (effective bound = max(cap, largest frame)), not stall forever.
+    import threading
+
+    from bytewax_tpu.engine.comm import Comm
+
+    monkeypatch.setenv("BYTEWAX_TPU_RX_BUFFER_CAP", str(1 << 20))
+    addrs = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    big = b"y" * (5 << 20)
+    results = {}
+    errors = []
+
+    def run(pid):
+        try:
+            comm = Comm(addrs, pid)
+            if pid == 0:
+                comm.send(1, ("big", big))
+                got = []
+                while not got:
+                    got = comm.recv_ready(0.01)
+                results[0] = got
+            else:
+                got = []
+                while not got:
+                    got = comm.recv_ready(0.01)
+                results[1] = got
+                comm.send(0, "ack")
+            comm.close()
+        except BaseException as ex:  # noqa: BLE001
+            errors.append((pid, ex))
+
+    threads = [threading.Thread(target=run, args=(p,)) for p in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "oversized-frame exchange stalled"
+    assert not errors, errors
+    assert results[1] == [(0, ("big", big))]
+    assert results[0] == [(1, "ack")]
