@@ -65,7 +65,11 @@ class FileSource(FixedPartitionedSource[str, int]):
         self._batch_size = batch_size
         self._fs_id = get_fs_id(path.parent) if path.parent.exists() else "0"
         if "::" in self._fs_id:
-            msg = f"result of `get_fs_id` must not contain `::`; got {self._fs_id!r}"
+            msg = (
+                f"filesystem id {self._fs_id!r} contains the reserved "
+                "`::` partition-name separator; return ids without it "
+                "from `get_fs_id`"
+            )
             raise ValueError(msg)
 
     def list_parts(self) -> List[str]:
@@ -96,17 +100,21 @@ class DirSource(FixedPartitionedSource[str, int]):
     ):
         dir_path = Path(dir_path)
         if not dir_path.exists():
-            msg = f"input directory `{dir_path}` does not exist"
+            msg = f"no such input directory: {dir_path}"
             raise ValueError(msg)
         if not dir_path.is_dir():
-            msg = f"input directory `{dir_path}` is not a directory"
+            msg = f"input path {dir_path} must be a directory"
             raise ValueError(msg)
         self._dir_path = dir_path
         self._glob_pat = glob_pat
         self._batch_size = batch_size
         self._fs_id = get_fs_id(dir_path)
         if "::" in self._fs_id:
-            msg = f"result of `get_fs_id` must not contain `::`; got {self._fs_id!r}"
+            msg = (
+                f"filesystem id {self._fs_id!r} contains the reserved "
+                "`::` partition-name separator; return ids without it "
+                "from `get_fs_id`"
+            )
             raise ValueError(msg)
 
     def list_parts(self) -> List[str]:

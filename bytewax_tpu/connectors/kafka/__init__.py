@@ -285,10 +285,10 @@ class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
         raise_on_errors: bool = True,
     ):
         if isinstance(brokers, str):
-            msg = "brokers must be an iterable and not a string"
+            msg = "pass brokers as a list of addresses, not a single string"
             raise TypeError(msg)
         if isinstance(topics, str):
-            msg = "topics must be an iterable and not a string"
+            msg = "pass topics as a list of names, not a single string"
             raise TypeError(msg)
         _require_confluent()
         self._brokers = brokers

@@ -25,8 +25,9 @@ __all__ = ["Comm"]
 _LEN = struct.Struct("<Q")
 _DIAL_TIMEOUT_S = 30.0
 #: Default per-peer raw receive-buffer cap; reading from a peer
-#: pauses above it and resumes below half of it, so a fast producer
-#: sees TCP backpressure instead of ballooning this process's memory.
+#: pauses above it and resumes once its frames are parsed out, so a
+#: fast producer sees TCP backpressure instead of ballooning this
+#: process's memory.
 _RX_CAP_DEFAULT = 64 * 1024 * 1024
 
 

@@ -21,6 +21,11 @@ def map_dict_value(
     >>> mapper({"name": "ada", "id": 1})
     {'name': 'ADA', 'id': 1}
 
+    This "operate on one spot of a known nested structure" pattern is
+    a **lens**; for richer lenses (attributes vs keys, immutability)
+    see the ``lenses`` package — its mappers compose with
+    :func:`bytewax_tpu.operators.map` the same way.
+
     :arg key: Dictionary key.
     :arg mapper: Function to run on the value for that key.
     :returns: A function suitable for

@@ -1,0 +1,118 @@
+"""Schema-registry (de)serialization in Confluent wire format
+(reference: ``examples/confluent_serde.py``).
+
+Windowed per-sensor averages: Kafka in → Avro-decode (wire format,
+writer schema fetched from the registry by frame id) → 1 s tumbling
+windows → average → Avro-encode → Kafka out.
+
+Needs a reachable broker and schema registry::
+
+    KAFKA_SERVER=...  KAFKA_IN_TOPIC=...  KAFKA_OUT_TOPIC=...
+    CONFLUENT_URL=...  CONFLUENT_USERNAME=...  CONFLUENT_PASSWORD=...
+
+Subjects used: ``sensor-key``/``sensor-value`` in, and
+``aggregated-key``/``aggregated-value`` out.
+"""
+
+import logging
+import os
+from datetime import datetime, timedelta, timezone
+from typing import Dict, List
+
+import bytewax_tpu.operators as op
+import bytewax_tpu.operators.windowing as win
+from bytewax_tpu.connectors.kafka import KafkaSinkMessage, KafkaSourceMessage
+from bytewax_tpu.connectors.kafka import operators as kop
+from bytewax_tpu.connectors.kafka.serde import (
+    ConfluentAvroDeserializer,
+    ConfluentAvroSerializer,
+    SchemaRegistryClient,
+)
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.operators.windowing import SystemClock, TumblingWindower
+
+logger = logging.getLogger(__name__)
+logging.basicConfig(format=logging.BASIC_FORMAT, level=logging.WARNING)
+
+KAFKA_BROKERS = os.environ.get("KAFKA_SERVER", "localhost:19092").split(";")
+IN_TOPICS = os.environ.get("KAFKA_IN_TOPIC", "in_topic").split(";")
+OUT_TOPIC = os.environ.get("KAFKA_OUT_TOPIC", "out_topic")
+CONFLUENT_URL = os.environ["CONFLUENT_URL"]
+AUTH = (
+    (os.environ["CONFLUENT_USERNAME"], os.environ["CONFLUENT_PASSWORD"])
+    if "CONFLUENT_USERNAME" in os.environ
+    else None
+)
+
+add_config = {}
+if AUTH is not None:
+    add_config = {
+        "security.protocol": "SASL_SSL",
+        "sasl.mechanism": "PLAIN",
+        "sasl.username": AUTH[0],
+        "sasl.password": AUTH[1],
+    }
+
+flow = Dataflow("schema_registry")
+kinp = kop.input(
+    "kafka-in",
+    flow,
+    brokers=KAFKA_BROKERS,
+    topics=IN_TOPICS,
+    add_config=add_config,
+)
+# Inspect errors and crash.
+op.inspect("inspect-kafka-errors", kinp.errs).then(op.raises, "kafka-error")
+
+client = SchemaRegistryClient(CONFLUENT_URL, auth=AUTH)
+
+# The wire-format deserializer needs no schema up front — each frame
+# names its writer schema and the client fetches/caches it.
+key_de = ConfluentAvroDeserializer(client)
+val_de = ConfluentAvroDeserializer(client)
+msgs = kop.deserialize(
+    "de", kinp.oks, key_deserializer=key_de, val_deserializer=val_de
+)
+op.inspect("inspect-deser", msgs.errs).then(op.raises, "deser-error")
+
+
+def extract_identifier(msg: KafkaSourceMessage) -> str:
+    return msg.key["identifier"]
+
+
+keyed = op.key_on("key_on_identifier", msgs.oks, extract_identifier)
+
+
+def accumulate(acc: List[float], msg: KafkaSourceMessage) -> List[float]:
+    acc.append(msg.value["value"])
+    return acc
+
+
+cc = SystemClock()
+wc = TumblingWindower(
+    length=timedelta(seconds=1),
+    align_to=datetime(2023, 1, 1, tzinfo=timezone.utc),
+)
+windows = win.fold_window(
+    "calc_avg", keyed, cc, wc, list, accumulate, lambda a, b: a + b
+)
+
+
+def calc_avg(key__id_batch) -> KafkaSinkMessage:
+    key, (_window_id, batch) = key__id_batch
+    return KafkaSinkMessage(
+        key={"identifier": key, "name": "topic_key"},
+        value={"identifier": key, "avg": sum(batch) / len(batch)},
+    )
+
+
+avgs = op.map("avg", windows.down, calc_avg)
+op.inspect("inspect-out-data", avgs)
+
+# Serializers register (or fetch) their subject's schema.
+key_ser = ConfluentAvroSerializer(client, "aggregated-key")
+val_ser = ConfluentAvroSerializer(client, "aggregated-value")
+serialized = kop.serialize(
+    "ser", avgs, key_serializer=key_ser, val_serializer=val_ser
+)
+kop.output("kafka-out", serialized, brokers=KAFKA_BROKERS, topic=OUT_TOPIC)

@@ -1,0 +1,98 @@
+"""Plain-Avro (de)serialization with schemas from a Redpanda schema
+registry (reference: ``examples/redpanda_serde.py``).
+
+Same pipeline as ``confluent_serde.py`` but with Redpanda's
+convention: messages carry plain Avro bodies (no wire-format header),
+so the deserializers need their schemas up front — fetched from the
+registry by subject.
+
+Needs::
+
+    KAFKA_SERVER=...  KAFKA_IN_TOPIC=...  KAFKA_OUT_TOPIC=...
+    REDPANDA_REGISTRY_URL=...
+"""
+
+import logging
+import os
+from datetime import datetime, timedelta, timezone
+from typing import List
+
+import bytewax_tpu.operators as op
+import bytewax_tpu.operators.windowing as win
+from bytewax_tpu.connectors.kafka import KafkaSinkMessage, KafkaSourceMessage
+from bytewax_tpu.connectors.kafka import operators as kop
+from bytewax_tpu.connectors.kafka.serde import (
+    PlainAvroDeserializer,
+    PlainAvroSerializer,
+    SchemaRegistryClient,
+)
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.operators.windowing import SystemClock, TumblingWindower
+
+logger = logging.getLogger(__name__)
+logging.basicConfig(format=logging.BASIC_FORMAT, level=logging.WARNING)
+
+KAFKA_BROKERS = os.environ.get("KAFKA_SERVER", "localhost:19092").split(";")
+IN_TOPICS = os.environ.get("KAFKA_IN_TOPIC", "in-topic").split(";")
+OUT_TOPIC = os.environ.get("KAFKA_OUT_TOPIC", "out_topic")
+REDPANDA_REGISTRY_URL = os.environ["REDPANDA_REGISTRY_URL"]
+
+flow = Dataflow("schema_registry")
+kinp = kop.input("kafka-in", flow, brokers=KAFKA_BROKERS, topics=IN_TOPICS)
+op.inspect("inspect-kafka-errors", kinp.errs).then(op.raises, "kafka-error")
+
+client = SchemaRegistryClient(REDPANDA_REGISTRY_URL)
+
+# Plain Avro: fetch each subject's latest schema for the decoder.
+_key_id, key_schema = client.latest_for_subject("sensor-key")
+key_de = PlainAvroDeserializer(schema=key_schema)
+_val_id, val_schema = client.latest_for_subject("sensor-value")
+val_de = PlainAvroDeserializer(schema=val_schema)
+
+msgs = kop.deserialize(
+    "de", kinp.oks, key_deserializer=key_de, val_deserializer=val_de
+)
+op.inspect("inspect-deser", msgs.errs).then(op.raises, "deser-error")
+
+
+def extract_identifier(msg: KafkaSourceMessage) -> str:
+    return msg.key["identifier"]
+
+
+keyed = op.key_on("key_on_identifier", msgs.oks, extract_identifier)
+
+
+def accumulate(acc: List[float], msg: KafkaSourceMessage) -> List[float]:
+    acc.append(msg.value["value"])
+    return acc
+
+
+cc = SystemClock()
+wc = TumblingWindower(
+    length=timedelta(seconds=1),
+    align_to=datetime(2023, 1, 1, tzinfo=timezone.utc),
+)
+windows = win.fold_window(
+    "calc_avg", keyed, cc, wc, list, accumulate, lambda a, b: a + b
+)
+
+
+def calc_avg(key__id_batch) -> KafkaSinkMessage:
+    key, (_window_id, batch) = key__id_batch
+    return KafkaSinkMessage(
+        key={"identifier": key, "name": "topic_key"},
+        value={"identifier": key, "avg": sum(batch) / len(batch)},
+    )
+
+
+avgs = op.map("avg", windows.down, calc_avg)
+op.inspect("inspect-out-data", avgs)
+
+key_ser = PlainAvroSerializer(schema=key_schema)
+_out_id, out_val_schema = client.latest_for_subject("aggregated-value")
+val_ser = PlainAvroSerializer(schema=out_val_schema)
+serialized = kop.serialize(
+    "ser", avgs, key_serializer=key_ser, val_serializer=val_ser
+)
+op.inspect("inspect-serialized", serialized)
+kop.output("kafka-out", serialized, brokers=KAFKA_BROKERS, topic=OUT_TOPIC)

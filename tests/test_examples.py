@@ -10,8 +10,15 @@ import pytest
 
 EXAMPLES = Path(__file__).parent.parent / "examples"
 
-BUILD_ONLY = {
-    "simple_kafka_in_and_out.py",  # needs confluent_kafka
+KAFKA_GATED = {
+    # Need confluent_kafka (transport) and/or a live broker+registry.
+    "simple_kafka_in_and_out.py",
+    "confluent_serde.py",
+    "redpanda_serde.py",
+    "redpanda_anomaly_detection.py",
+}
+
+BUILD_ONLY = KAFKA_GATED | {
     "brc.py",  # needs a measurements file
     "wordcount_tpu.py",  # relative path; covered via wordcount.py
     "wordcount.py",  # relative sample path; run from repo root below
@@ -74,8 +81,8 @@ def test_wordcount_example_runs_from_repo_root():
     "name", sorted(p.name for p in EXAMPLES.glob("*.py"))
 )
 def test_example_builds(name):
-    if name == "simple_kafka_in_and_out.py":
-        pytest.skip("needs confluent_kafka")
+    if name in KAFKA_GATED:
+        pytest.skip("needs confluent_kafka / a live broker")
     code = (
         "import sys; sys.path.insert(0, 'examples')\n"
         f"import runpy\n"
@@ -95,3 +102,25 @@ def test_example_builds(name):
         timeout=120,
     )
     assert res.returncode == 0, res.stderr[-1500:]
+
+
+def test_events_to_parquet_writes_dataset(tmp_path):
+    pytest.importorskip("pyarrow")
+    env = _env()
+    env["PARQUET_DEMO_OUT"] = str(tmp_path / "ds")
+    res = subprocess.run(
+        [sys.executable, str(EXAMPLES / "events_to_parquet.py")],
+        env=env,
+        cwd=EXAMPLES.parent,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stderr[-1500:]
+    from pyarrow import parquet
+
+    table = parquet.read_table(str(tmp_path / "ds"))
+    assert table.num_rows == 500  # 10 batches x 50 events
+    assert {"page_url_path", "user_id", "duration_ms"} <= set(
+        table.column_names
+    )

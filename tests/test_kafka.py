@@ -117,3 +117,73 @@ def test_kafka_roundtrip_live():
         assert [m.value for m in out] == [b"x"]
     finally:
         admin.delete_topics([topic])
+
+
+def test_confluent_wire_format_roundtrip():
+    from bytewax_tpu.connectors.kafka.serde import (
+        confluent_wire_decode,
+        confluent_wire_encode,
+    )
+
+    framed = confluent_wire_encode(100002, b"\x02\x04payload")
+    assert framed[0] == 0  # magic byte
+    schema_id, payload = confluent_wire_decode(framed)
+    assert (schema_id, payload) == (100002, b"\x02\x04payload")
+    with pytest.raises(ValueError, match="magic"):
+        confluent_wire_decode(b"\x01\x00\x00\x00\x01x")
+    with pytest.raises(ValueError, match="short"):
+        confluent_wire_decode(b"\x00\x00")
+
+
+def test_schema_registry_client_rest(tmp_path):
+    # Serve a minimal Confluent-compatible registry from a local HTTP
+    # server; the client must fetch by id, by subject, and register.
+    import http.server
+    import json
+    import threading
+
+    from bytewax_tpu.connectors.kafka.serde import SchemaRegistryClient
+
+    schema = {"type": "record", "name": "r", "fields": []}
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def _reply(self, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/schemas/ids/7":
+                self._reply({"schema": json.dumps(schema)})
+            elif self.path == "/subjects/sensor-key/versions/latest":
+                self._reply({"id": 7, "schema": json.dumps(schema)})
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def do_POST(self):
+            length = int(self.headers["Content-Length"])
+            json.loads(self.rfile.read(length))  # validate body shape
+            self._reply({"id": 9})
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        client = SchemaRegistryClient(
+            f"http://127.0.0.1:{srv.server_address[1]}"
+        )
+        assert client.schema_for_id(7) == schema
+        assert client.latest_for_subject("sensor-key") == (7, schema)
+        assert client.register("aggregated-value", schema) == 9
+        # Cached: a second id fetch must not hit the server.
+        srv.shutdown()
+        assert client.schema_for_id(7) == schema
+    finally:
+        srv.shutdown()
+        srv.server_close()
