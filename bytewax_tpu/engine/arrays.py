@@ -12,7 +12,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["ArrayBatch", "TsValue", "column_ts"]
+__all__ = ["ArrayBatch", "TsValue", "VocabMap", "column_ts"]
 
 
 class TsValue(float):
@@ -34,6 +34,77 @@ class TsValue(float):
     def __reduce__(self):
         # Default float pickling drops the ts attribute.
         return (TsValue, (float(self), self.ts))
+
+
+class VocabMap:
+    """Append-only mapping from a batch's external ``key_id`` space to
+    engine-internal ids.
+
+    Shared by every dictionary-encoded fast path (single-device and
+    sharded keyed aggregation, windowed folds): validates that each
+    batch's ``key_vocab`` is an append-only extension of the previous
+    one (id meanings can never change between batches), grows the
+    id table, and assigns internal ids for newly-seen externals via
+    the caller's ``alloc``.
+    """
+
+    __slots__ = ("vocab", "table", "_ref", "_dtype")
+
+    def __init__(self, dtype=np.int32):
+        self.vocab: Optional[np.ndarray] = None
+        self.table: Optional[np.ndarray] = None
+        self._ref: Any = None
+        self._dtype = dtype
+
+    def sync(self, ids: np.ndarray, vocab: Any, alloc_many) -> np.ndarray:
+        """Install/extend ``vocab``, assign internal ids for new
+        externals appearing in ``ids`` (``alloc_many([key_str, ...])
+        -> id array``, one call per batch of new keys), and return
+        the unique external ids touched."""
+        same = vocab is self._ref and (
+            # Identity only short-circuits validation for ndarrays —
+            # a list mutated in place keeps its identity, so lists
+            # re-validate every batch.
+            isinstance(vocab, np.ndarray)
+            or len(vocab) == len(self.table)
+            and vocab == self.vocab.tolist()
+        )
+        if self.vocab is None:
+            self.vocab = np.asarray(vocab)
+            self.table = np.full(len(self.vocab), -1, dtype=self._dtype)
+            self._ref = vocab
+        elif not same:
+            arr = np.asarray(vocab)
+            prev = len(self.table)
+            if len(arr) < prev or not np.array_equal(
+                arr[:prev], self.vocab[:prev]
+            ):
+                msg = (
+                    "key_vocab must be an append-only extension of the "
+                    "vocabulary used by earlier batches of this step"
+                )
+                raise TypeError(msg)
+            if len(arr) > prev:
+                pad = np.full(len(arr) - prev, -1, self._dtype)
+                self.vocab = arr
+                self.table = np.concatenate([self.table, pad])
+            self._ref = vocab
+        if len(ids) and int(ids.max()) >= len(self.table):
+            msg = (
+                f"key_id {int(ids.max())} is out of range for a "
+                f"{len(self.table)}-entry key_vocab"
+            )
+            raise TypeError(msg)
+        # bincount + nonzero beats np.unique's sort by ~20x here.
+        counts = np.bincount(ids, minlength=len(self.table))
+        uniq = np.nonzero(counts)[0]
+        new = uniq[self.table[uniq] < 0]
+        if len(new):
+            self.table[new] = np.asarray(
+                alloc_many([str(self.vocab[e]) for e in new.tolist()]),
+                dtype=self._dtype,
+            )
+        return uniq
 
 
 def column_ts(value: Any) -> datetime:
@@ -87,6 +158,17 @@ class ArrayBatch:
     def numpy(self, name: str) -> np.ndarray:
         return np.asarray(self.cols[name])
 
+    def _key_strings(self) -> List[str]:
+        """The key column as Python strings, decoding ``key_id``
+        through ``key_vocab`` when dictionary-encoded."""
+        if "key_id" in self.cols:
+            if self.key_vocab is None:
+                msg = "key_id columns need a key_vocab to decode"
+                raise TypeError(msg)
+            vocab = np.asarray(self.key_vocab)
+            return vocab[np.asarray(self.cols["key_id"])].tolist()
+        return np.asarray(self.cols["key"]).tolist()
+
     def _scaled_values(self) -> np.ndarray:
         """The ``value`` column with any fixed-point scale applied."""
         values = np.asarray(self.cols["value"])
@@ -118,28 +200,28 @@ class ArrayBatch:
         per-row dicts.
         """
         names = set(self.cols)
-        if names == {"key", "ts"}:
+        if names in ({"key", "ts"}, {"key_id", "ts"}):
             # Columnar windowed-event batches degrade to (key,
             # timestamp) items so the host tier (and cluster
             # exchange) key them correctly; ts getters must accept
             # datetime values in columnar flows (see `column_ts`).
-            keys = np.asarray(self.cols["key"]).tolist()
-            return list(zip(keys, self._ts_datetimes()))
-        if names == {"key", "ts", "value"}:
+            return list(zip(self._key_strings(), self._ts_datetimes()))
+        if names in ({"key", "ts", "value"}, {"key_id", "ts", "value"}):
             # Numeric windowed-fold batches degrade to (key, TsValue)
             # items: the payload folds as a plain float and carries
             # the row's timestamp for `column_ts` getters.
-            keys = np.asarray(self.cols["key"]).tolist()
             stamps = self._ts_datetimes()
             values = self._scaled_values()
             return [
                 (k, TsValue(v, t))
-                for k, v, t in zip(keys, values.tolist(), stamps)
+                for k, v, t in zip(
+                    self._key_strings(), values.tolist(), stamps
+                )
             ]
-        if names == {"key_id", "value"} and self.key_vocab is not None:
-            vocab = np.asarray(self.key_vocab)
-            keys = vocab[np.asarray(self.cols["key_id"])].tolist()
-            return list(zip(keys, self._scaled_values().tolist()))
+        if names == {"key_id", "value"}:
+            return list(
+                zip(self._key_strings(), self._scaled_values().tolist())
+            )
         if names == {"key", "value"}:
             keys = np.asarray(self.cols["key"]).tolist()
             return list(zip(keys, self._scaled_values().tolist()))

@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from bytewax_tpu.engine.arrays import ArrayBatch
+from bytewax_tpu.engine.arrays import ArrayBatch, VocabMap
 from bytewax_tpu.engine.xla import (
     DeviceAggState,
     NonNumericValues,
@@ -127,9 +127,7 @@ class ShardedAggState:
         self._fields = None  # lazy until first update/load
         self._steps: Dict[Tuple[int, int, int, Any], Any] = {}
         # Dictionary-encoded fast path: external id -> wire key id.
-        self._ext_vocab: Optional[np.ndarray] = None
-        self._ext_to_kid: Optional[np.ndarray] = None
-        self._vocab_ref: Any = None
+        self._vocab = VocabMap(dtype=np.int32)
 
     # -- key placement -----------------------------------------------------
 
@@ -318,32 +316,11 @@ class ShardedAggState:
 
     def _sync_vocab(self, ids: np.ndarray, vocab: np.ndarray) -> np.ndarray:
         """Assign wire ids for newly-seen external vocabulary ids;
-        returns the touched unique external ids."""
-        if self._ext_vocab is None:
-            self._ext_vocab = np.asarray(vocab)
-            self._ext_to_kid = np.full(len(vocab), -1, dtype=np.int32)
-            self._vocab_ref = vocab
-        elif vocab is not self._vocab_ref:
-            prev = len(self._ext_to_kid)
-            if len(vocab) < prev or not np.array_equal(
-                np.asarray(vocab)[:prev], self._ext_vocab[:prev]
-            ):
-                msg = (
-                    "key_vocab must be an append-only extension of the "
-                    "vocabulary used by earlier batches of this step"
-                )
-                raise TypeError(msg)
-            if len(vocab) > prev:
-                pad = np.full(len(vocab) - prev, -1, np.int32)
-                self._ext_vocab = np.asarray(vocab)
-                self._ext_to_kid = np.concatenate([self._ext_to_kid, pad])
-            self._vocab_ref = vocab
-        counts = np.bincount(ids, minlength=len(self._ext_to_kid))
-        uniq = np.nonzero(counts)[0]
-        new = uniq[self._ext_to_kid[uniq] < 0]
-        for ext in new.tolist():
-            self._ext_to_kid[ext] = self.alloc(str(self._ext_vocab[ext]))
-        return uniq
+        returns the touched unique external ids (see
+        :class:`VocabMap`)."""
+        return self._vocab.sync(
+            ids, vocab, lambda keys: [self.alloc(k) for k in keys]
+        )
 
     def update_batch(self, batch: ArrayBatch) -> List[str]:
         if "key_id" in batch.cols and batch.key_vocab is not None:
@@ -362,11 +339,9 @@ class ShardedAggState:
                 values = (values * batch.value_scale).astype(np.float32)
             else:
                 values = self._pick_dtype(values)
-            uniq = self._sync_vocab(
-                ids.astype(np.int64), np.asarray(batch.key_vocab)
-            )
-            self._dispatch(self._ext_to_kid[ids], values)
-            return [str(self._ext_vocab[e]) for e in uniq.tolist()]
+            uniq = self._sync_vocab(ids.astype(np.int64), batch.key_vocab)
+            self._dispatch(self._vocab.table[ids], values)
+            return [str(self._vocab.vocab[e]) for e in uniq.tolist()]
         if "key" in batch.cols:
             values = batch.numpy("value")
             if batch.value_scale is not None:
@@ -457,9 +432,7 @@ class ShardedAggState:
         self._shard_fill = [0] * self.n_shards
         self._free = [[] for _ in range(self.n_shards)]
         self._fields = None
-        self._ext_vocab = None
-        self._ext_to_kid = None
-        self._vocab_ref = None
+        self._vocab = VocabMap(dtype=np.int32)
         return out
 
     def keys(self) -> List[str]:

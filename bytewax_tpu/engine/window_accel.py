@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from bytewax_tpu.engine.arrays import VocabMap
 from bytewax_tpu.engine.xla import DeviceAggState
 
 __all__ = ["DeviceWindowAggState", "WindowAccelSpec"]
@@ -96,6 +97,8 @@ class DeviceWindowAggState:
         # Cached (kids, wids, closes) arrays over open_close_us;
         # invalidated whenever the open-window set changes.
         self._open_cache = None
+        # Dictionary-encoded fast path: external id -> internal kid.
+        self._vocab = VocabMap(dtype=np.int64)
 
     # -- clock -------------------------------------------------------------
 
@@ -124,16 +127,29 @@ class DeviceWindowAggState:
 
     # -- processing --------------------------------------------------------
 
+    def _sync_vocab(self, ids: np.ndarray, vocab) -> np.ndarray:
+        """Map dictionary-encoded external ids to internal key ids
+        with one table lookup; vocabularies must be append-only
+        extensions between batches (see :class:`VocabMap`)."""
+        self._vocab.sync(ids, vocab, self._key_ids_for)
+        return self._vocab.table[ids]
+
     def on_batch_columnar(self, batch) -> List[Tuple[str, Tuple[int, str, Any]]]:
-        """Columnar fast path: a batch with ``"key"`` and ``"ts"``
+        """Columnar fast path: a batch with ``"key"`` (strings) or
+        dictionary-encoded ``"key_id"`` + ``key_vocab`` and ``"ts"``
         columns (``np.datetime64`` or int64 microseconds since the
         epoch), plus a ``"value"`` column for numeric folds, runs with
         no per-row Python.  Late rows are reported with their value
         (counting: their timestamp)."""
-        keys_col = batch.numpy("key")
-        uniq_keys, inverse = np.unique(keys_col, return_inverse=True)
-        kid_of_uniq = self._key_ids_for([str(k) for k in uniq_keys])
-        kids = kid_of_uniq[inverse]
+        if "key_id" in batch.cols and batch.key_vocab is not None:
+            kids = self._sync_vocab(
+                batch.numpy("key_id").astype(np.int64), batch.key_vocab
+            )
+        else:
+            keys_col = batch.numpy("key")
+            uniq_keys, inverse = np.unique(keys_col, return_inverse=True)
+            kid_of_uniq = self._key_ids_for([str(k) for k in uniq_keys])
+            kids = kid_of_uniq[inverse]
         ts_col = batch.numpy("ts")
         if np.issubdtype(ts_col.dtype, np.datetime64):
             ts_us = ts_col.astype("datetime64[us]").astype(np.int64).astype(

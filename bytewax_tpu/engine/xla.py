@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bytewax_tpu.engine.arrays import ArrayBatch
+from bytewax_tpu.engine.arrays import ArrayBatch, VocabMap
 from bytewax_tpu.ops.segment import (
     AGG_KINDS,
     init_fields,
@@ -114,9 +114,7 @@ class DeviceAggState:
         # Dictionary-encoded fast path: external id -> slot table,
         # mirrored on device so raw (id, value) columns are all the
         # host ships per batch.
-        self._ext_vocab: Optional[np.ndarray] = None
-        self._ext_to_slot: Optional[np.ndarray] = None
-        self._vocab_ref: Any = None
+        self._vocab = VocabMap(dtype=np.int32)
         self._dev_map = None
 
     # -- slot management ---------------------------------------------------
@@ -280,41 +278,21 @@ class DeviceAggState:
         return {name: stacked[i] for i, name in enumerate(names)}
 
     def _sync_vocab(self, ids: np.ndarray, vocab: np.ndarray) -> np.ndarray:
-        """Assign slots for newly-seen external ids and refresh the
-        on-device id→slot table; returns the touched unique ids."""
-        if self._ext_vocab is None:
-            self._ext_vocab = np.asarray(vocab)
-            self._ext_to_slot = np.full(len(vocab), -1, dtype=np.int32)
-            self._vocab_ref = vocab
-        elif vocab is not self._vocab_ref:
-            # Vocabularies must be append-only extensions: id meanings
-            # can never change between batches.
-            prev = len(self._ext_to_slot)
-            if len(vocab) < prev or not np.array_equal(
-                vocab[:prev], self._ext_vocab[:prev]
-            ):
-                msg = (
-                    "key_vocab must be an append-only extension of the "
-                    "vocabulary used by earlier batches of this step"
-                )
-                raise TypeError(msg)
-            if len(vocab) > prev:
-                pad = np.full(len(vocab) - prev, -1, np.int32)
-                self._ext_vocab = np.asarray(vocab)
-                self._ext_to_slot = np.concatenate([self._ext_to_slot, pad])
-            self._vocab_ref = vocab
-        # bincount + nonzero beats np.unique's sort by ~20x here.
-        counts = np.bincount(ids, minlength=len(self._ext_to_slot))
-        uniq = np.nonzero(counts)[0]
-        new = uniq[self._ext_to_slot[uniq] < 0]
-        if len(new) or self._dev_map is None:
-            for ext in new.tolist():
-                key = str(self._ext_vocab[ext])
-                # alloc reuses a recovery-resumed slot if one exists.
-                self._ext_to_slot[ext] = self.alloc(key)
+        """Assign slots for newly-seen external ids (alloc reuses a
+        recovery-resumed slot if one exists) and refresh the on-device
+        id→slot table; returns the touched unique ids."""
+        had_new = []
+
+        def alloc_many(keys):
+            had_new.extend(keys)
+            # alloc reuses a recovery-resumed slot if one exists.
+            return [self.alloc(key) for key in keys]
+
+        uniq = self._vocab.sync(ids, vocab, alloc_many)
+        if had_new or self._dev_map is None:
             # Rebuild the device table: unseen ids and the padding
             # sentinel (index len(vocab)) route to the scratch slot.
-            table = np.append(self._ext_to_slot, -1)
+            table = np.append(self._vocab.table, -1)
             table = np.where(table < 0, self.capacity - 1, table).astype(
                 np.int32
             )
@@ -342,10 +320,10 @@ class DeviceAggState:
                 values = (values * batch.value_scale).astype(np.float32)
             elif not quantized:
                 values = self._pick_dtype(values)
-            uniq = self._sync_vocab(ids, np.asarray(batch.key_vocab))
+            uniq = self._sync_vocab(ids, batch.key_vocab)
             self._ensure_fields()
             n = len(values)
-            sentinel = len(self._ext_to_slot)
+            sentinel = len(self._vocab.table)
             padded = 1 << max(5, math.ceil(math.log2(max(n, 1))))
             if quantized and sentinel < 2**15:
                 # Fixed-point fast path: one int16 [2, n] transfer.
@@ -373,7 +351,7 @@ class DeviceAggState:
                     jax.device_put(ids_p),
                     jax.device_put(vals_p),
                 )
-            return [str(self._ext_vocab[e]) for e in uniq.tolist()]
+            return [str(self._vocab.vocab[e]) for e in uniq.tolist()]
         if "key" in batch.cols:
             values = batch.numpy("value")
             if batch.value_scale is not None:
@@ -449,8 +427,7 @@ class DeviceAggState:
         self.key_to_slot.clear()
         self.slot_keys.clear()
         self._fields = None
-        self._ext_vocab = None
-        self._ext_to_slot = None
+        self._vocab = VocabMap(dtype=np.int32)
         self._dev_map = None
         return out
 
