@@ -46,6 +46,11 @@ class VocabMap:
     one (id meanings can never change between batches), grows the
     id table, and assigns internal ids for newly-seen externals via
     the caller's ``alloc``.
+
+    Grow a vocabulary by passing a NEW (longer) array or list each
+    time; an ndarray mutated in place keeps its identity and skips
+    re-validation, so rewriting entries of a reused array corrupts
+    the mapping silently — never do that.
     """
 
     __slots__ = ("vocab", "table", "_ref", "_dtype")
@@ -89,9 +94,12 @@ class VocabMap:
                 self.vocab = arr
                 self.table = np.concatenate([self.table, pad])
             self._ref = vocab
-        if len(ids) and int(ids.max()) >= len(self.table):
+        if len(ids) and (
+            int(ids.max()) >= len(self.table) or int(ids.min()) < 0
+        ):
+            bad = int(ids.max()) if int(ids.max()) >= len(self.table) else int(ids.min())
             msg = (
-                f"key_id {int(ids.max())} is out of range for a "
+                f"key_id {bad} is out of range for a "
                 f"{len(self.table)}-entry key_vocab"
             )
             raise TypeError(msg)
@@ -128,6 +136,10 @@ class ArrayBatch:
     Dictionary encoding is the fast path: the engine maps external ids
     to state slots with one vectorized table lookup instead of
     per-batch string sorting.
+
+    ``key_vocab`` entries must never change meaning across batches:
+    extend a vocabulary by passing a new, longer array (append-only);
+    never rewrite entries of a reused array in place.
     """
 
     __slots__ = ("cols", "key_vocab", "value_scale")
@@ -200,13 +212,18 @@ class ArrayBatch:
         per-row dicts.
         """
         names = set(self.cols)
-        if names in ({"key", "ts"}, {"key_id", "ts"}):
+        decodable = "key_id" not in self.cols or self.key_vocab is not None
+        if names == {"key", "ts"} or (
+            names == {"key_id", "ts"} and decodable
+        ):
             # Columnar windowed-event batches degrade to (key,
             # timestamp) items so the host tier (and cluster
             # exchange) key them correctly; ts getters must accept
             # datetime values in columnar flows (see `column_ts`).
             return list(zip(self._key_strings(), self._ts_datetimes()))
-        if names in ({"key", "ts", "value"}, {"key_id", "ts", "value"}):
+        if names == {"key", "ts", "value"} or (
+            names == {"key_id", "ts", "value"} and decodable
+        ):
             # Numeric windowed-fold batches degrade to (key, TsValue)
             # items: the payload folds as a plain float and carries
             # the row's timestamp for `column_ts` getters.
@@ -218,7 +235,7 @@ class ArrayBatch:
                     self._key_strings(), values.tolist(), stamps
                 )
             ]
-        if names == {"key_id", "value"}:
+        if names == {"key_id", "value"} and decodable:
             return list(
                 zip(self._key_strings(), self._scaled_values().tolist())
             )

@@ -85,6 +85,19 @@ def branch(
 ) -> BranchOut:
     """Divide items into two streams with a predicate.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("branch_eg")
+    >>> s = op.input("inp", flow, TestingSource([1, 2, 3, 4]))
+    >>> b = op.branch("evens", s, lambda x: x % 2 == 0)
+    >>> evens, odds = [], []
+    >>> op.output("ev", b.trues, TestingSink(evens))
+    >>> op.output("od", b.falses, TestingSink(odds))
+    >>> run_main(flow)
+    >>> (evens, odds)
+    ([2, 4], [1, 3])
+
     Reference parity: ``operators/__init__.py:119`` /
     ``src/operators.rs:34-100``.
 
@@ -159,6 +172,19 @@ def inspect_debug(
 @operator(_core=True)
 def merge(step_id: str, *ups: Stream[X]) -> Stream[X]:
     """Combine multiple streams together.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("merge_eg")
+    >>> ones = op.input("ones", flow, TestingSource([1, 2]))
+    >>> tens = op.input("tens", flow, TestingSource([10, 20]))
+    >>> s = op.merge("merge", ones, tens)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> sorted(out)
+    [1, 2, 10, 20]
 
     Reference parity: ``operators/__init__.py:394`` /
     ``src/operators.rs:319-343``.
@@ -367,6 +393,18 @@ def flat_map(
 ) -> Stream[Y]:
     """Transform items one-to-many.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("flat_map_eg")
+    >>> s = op.input("inp", flow, TestingSource(["hello world"]))
+    >>> s = op.flat_map("split", s, str.split)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    ['hello', 'world']
+
     Reference parity: ``operators/__init__.py:1460``.
     """
 
@@ -383,6 +421,18 @@ def flat_map_value(
     mapper: Callable[[V], Iterable[W]],
 ) -> KeyedStream[W]:
     """Transform values one-to-many.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("flat_map_value_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", "a b")]))
+    >>> s = op.flat_map_value("split", s, str.split)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', 'a'), ('k', 'b')]
 
     Reference parity: ``operators/__init__.py:1526``.
     """
@@ -408,6 +458,18 @@ def flatten(
 ) -> Stream[X]:
     """Move all sub-items up a level.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("flatten_eg")
+    >>> s = op.input("inp", flow, TestingSource([[1, 2], [3]]))
+    >>> s = op.flatten("flat", s)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [1, 2, 3]
+
     Reference parity: ``operators/__init__.py:1593``.
     """
 
@@ -430,6 +492,18 @@ def filter(  # noqa: A001
     predicate: Callable[[X], bool],
 ) -> Stream[X]:
     """Keep only some items.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("filter_eg")
+    >>> s = op.input("inp", flow, TestingSource([1, 2, 3, 4]))
+    >>> s = op.filter("keep_even", s, lambda x: x % 2 == 0)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [2, 4]
 
     Reference parity: ``operators/__init__.py:1652``.
     """
@@ -457,6 +531,18 @@ def filter_value(
 ) -> KeyedStream[V]:
     """Keep only some values; keys untouched.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("filter_value_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", 1), ("k", 2)]))
+    >>> s = op.filter_value("keep_even", s, lambda v: v % 2 == 0)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', 2)]
+
     Reference parity: ``operators/__init__.py:1726``.
     """
 
@@ -483,6 +569,18 @@ def filter_map(
 ) -> Stream[Y]:
     """Transform items one-to-maybe-one; ``None`` is discarded.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("filter_map_eg")
+    >>> s = op.input("inp", flow, TestingSource(["1", "x", "3"]))
+    >>> s = op.filter_map("to_int", s, lambda x: int(x) if x.isdigit() else None)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [1, 3]
+
     Reference parity: ``operators/__init__.py:1790``.
     """
 
@@ -502,6 +600,18 @@ def filter_map_value(
     mapper: Callable[[V], Optional[W]],
 ) -> KeyedStream[W]:
     """Transform values one-to-maybe-one; ``None`` is discarded.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("filter_map_value_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", "1"), ("k", "x")]))
+    >>> s = op.filter_map_value("to_int", s, lambda v: int(v) if v.isdigit() else None)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', 1)]
 
     Reference parity: ``operators/__init__.py:1860``.
     """
@@ -541,6 +651,18 @@ def inspect(
 def key_on(step_id: str, up: Stream[X], key: Callable[[X], str]) -> KeyedStream[X]:
     """Add a key for each item, making a :class:`KeyedStream`.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("key_on_eg")
+    >>> s = op.input("inp", flow, TestingSource(["apple", "kiwi"]))
+    >>> s = op.key_on("by_first", s, lambda x: x[0])
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('a', 'apple'), ('k', 'kiwi')]
+
     Reference parity: ``operators/__init__.py:2375``.
     """
 
@@ -561,6 +683,18 @@ def key_on(step_id: str, up: Stream[X], key: Callable[[X], str]) -> KeyedStream[
 def key_rm(step_id: str, up: KeyedStream[X]) -> Stream[X]:
     """Discard keys.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("key_rm_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", 1), ("k", 2)]))
+    >>> s = op.key_rm("unkey", s)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [1, 2]
+
     Reference parity: ``operators/__init__.py:2439``.
     """
 
@@ -579,6 +713,18 @@ def map(  # noqa: A001
 ) -> Stream[Y]:
     """Transform items one-by-one.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("map_eg")
+    >>> s = op.input("inp", flow, TestingSource([1, 2, 3]))
+    >>> s = op.map("double", s, lambda x: x * 2)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [2, 4, 6]
+
     Reference parity: ``operators/__init__.py:2497``.
     """
 
@@ -595,6 +741,18 @@ def map_value(
     mapper: Callable[[V], W],
 ) -> KeyedStream[W]:
     """Transform values one-by-one.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("map_value_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", 1), ("k", 2)]))
+    >>> s = op.map_value("double", s, lambda v: v * 2)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', 2), ('k', 4)]
 
     Reference parity: ``operators/__init__.py:2557``.
     """
@@ -662,6 +820,18 @@ def fold_final(
     """Build an empty accumulator, then combine values into it; emit at
     EOF.  Only works on finite streams.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("fold_final_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", 1), ("k", 2), ("k", 3)]))
+    >>> s = op.fold_final("sum", s, lambda: 0, lambda acc, v: acc + v)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', 6)]
+
     Reference parity: ``operators/__init__.py:1944``.
     """
 
@@ -683,6 +853,18 @@ def count_final(
 
     Vectorized on the XLA tier as a segment-sum over hashed key ids.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("count_final_eg")
+    >>> s = op.input("inp", flow, TestingSource(["a", "b", "a"]))
+    >>> s = op.count_final("count", s, lambda x: x)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> sorted(out)
+    [('a', 2), ('b', 1)]
+
     Reference parity: ``operators/__init__.py:1221``.
     """
     from bytewax_tpu.xla import SUM
@@ -698,6 +880,18 @@ def max_final(
     by=_identity,
 ) -> KeyedStream:
     """Find the maximum value for each key; emit at EOF.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("max_final_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", 4), ("k", 9), ("k", 1)]))
+    >>> s = op.max_final("max", s)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', 9)]
 
     Reference parity: ``operators/__init__.py:2624``.
     """
@@ -715,6 +909,18 @@ def min_final(
     by=_identity,
 ) -> KeyedStream:
     """Find the minimum value for each key; emit at EOF.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("min_final_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", 4), ("k", 9), ("k", 1)]))
+    >>> s = op.min_final("min", s)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', 1)]
 
     Reference parity: ``operators/__init__.py:2692``.
     """
@@ -734,6 +940,18 @@ def reduce_final(
     """Distill all values for a key down into a single value; emit at
     EOF.  Like :func:`fold_final` but the first value is the initial
     accumulator.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("reduce_final_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", 1), ("k", 2), ("k", 3)]))
+    >>> s = op.reduce_final("sum", s, lambda a, b: a + b)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', 6)]
 
     Includes a map-side pre-combine within each batch (the reference
     does the same: ``operators/__init__.py:2836-2847``), which is also
@@ -814,6 +1032,19 @@ def collect(
 ) -> KeyedStream[List[V]]:
     """Collect items into a list up to a size or a timeout.
 
+    >>> from datetime import timedelta
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("collect_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", 1), ("k", 2), ("k", 3)]))
+    >>> s = op.collect("batch", s, timeout=timedelta(seconds=10), max_size=2)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', [1, 2]), ('k', [3])]
+
     Reference parity: ``operators/__init__.py:1148``.
     """
 
@@ -879,6 +1110,22 @@ def enrich_cached(
     _now_getter: Callable[[], datetime] = _get_system_utc,
 ) -> Stream[Y]:
     """Enrich / join items using a cached lookup to an external service.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> def lookup(user_id):
+    ...     return {"1": "ada", "2": "kay"}[user_id]
+    >>> def enrich(cache, user_id):
+    ...     return (user_id, cache.get(user_id))
+    >>> flow = Dataflow("enrich_eg")
+    >>> s = op.input("inp", flow, TestingSource(["1", "2", "1"]))
+    >>> s = op.enrich_cached("names", s, lookup, enrich)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('1', 'ada'), ('2', 'kay'), ('1', 'ada')]
 
     Reference parity: ``operators/__init__.py:1314``.
     """
@@ -1004,6 +1251,19 @@ def join(
 ) -> KeyedStream[Tuple]:
     """Gather together the value for a key on multiple streams.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("join_eg")
+    >>> names = op.input("names", flow, TestingSource([("1", "ada")]))
+    >>> emails = op.input("emails", flow, TestingSource([("1", "a@b.co")]))
+    >>> s = op.join("join", names, emails)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('1', ('ada', 'a@b.co'))]
+
     Reference parity: ``operators/__init__.py:2324``.
     """
     if insert_mode not in ("first", "last", "product"):
@@ -1070,6 +1330,18 @@ def stateful_flat_map(
 
     Returning ``None`` as the updated state discards it.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("stateful_flat_map_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", 1), ("k", 1), ("k", 2)]))
+    >>> s = op.stateful_flat_map("dedupe_run", s, lambda st, v: (v, [] if st == v else [v]))
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', 1), ('k', 2)]
+
     Reference parity: ``operators/__init__.py:2893``.
     """
 
@@ -1088,6 +1360,18 @@ def stateful_map(
     """Transform values one-to-one, referencing a persistent state.
 
     Returning ``None`` as the updated state discards it.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("stateful_map_eg")
+    >>> s = op.input("inp", flow, TestingSource([("k", 1), ("k", 2), ("k", 3)]))
+    >>> s = op.stateful_map("running_sum", s, lambda st, v: ((st or 0) + v, (st or 0) + v))
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', 1), ('k', 3), ('k', 6)]
 
     Reference parity: ``operators/__init__.py:2920``.
     """

@@ -914,6 +914,34 @@ def fold_window(
     :arg merger: Combines two accumulators when windows merge
         (session windows).
 
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    >>> inp = [
+    ...     ("k", (align + timedelta(seconds=1), "a")),
+    ...     ("k", (align + timedelta(seconds=2), "b")),
+    ... ]
+    >>> clock = win.EventClock(
+    ...     ts_getter=lambda v: v[0], wait_for_system_duration=timedelta(hours=1)
+    ... )
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1), align_to=align
+    ... )
+    >>> flow = Dataflow("fold_window_eg")
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> wo = win.fold_window(
+    ...     "letters", s, clock, windower,
+    ...     list, lambda acc, v: acc + [v[1]], lambda a, b: a + b,
+    ... )
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', (0, ['a', 'b']))]
+
     Reference parity: ``windowing.py:1717``.
     """
 
@@ -1095,6 +1123,31 @@ def collect_window(
 
     For ``dict``, values must be ``(key, value)`` 2-tuples.
 
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    >>> inp = [
+    ...     ("k", (align + timedelta(seconds=1), 10)),
+    ...     ("k", (align + timedelta(seconds=2), 20)),
+    ... ]
+    >>> clock = win.EventClock(
+    ...     ts_getter=lambda v: v[0], wait_for_system_duration=timedelta(hours=1)
+    ... )
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1), align_to=align
+    ... )
+    >>> flow = Dataflow("collect_window_eg")
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> wo = win.collect_window("batch", s, clock, windower)
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> [(k, (wid, [v for _ts, v in vals])) for k, (wid, vals) in out]
+    [('k', (0, [10, 20]))]
+
     Reference parity: ``windowing.py:1436``.
     """
     if into is list:
@@ -1126,6 +1179,28 @@ def count_window(
     Columnar batches carrying ``"key"`` + ``"ts"`` columns pass
     through keying untouched and count on device with no per-row
     Python (see ``bytewax_tpu/engine/window_accel.py``).
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    >>> inp = [align + timedelta(seconds=sec) for sec in (1, 2, 61)]
+    >>> clock = win.EventClock(
+    ...     ts_getter=lambda x: x, wait_for_system_duration=timedelta(hours=1)
+    ... )
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1), align_to=align
+    ... )
+    >>> flow = Dataflow("count_window_eg")
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> wo = win.count_window("count", s, clock, windower, key=lambda _x: "all")
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> sorted(out)
+    [('all', (0, 2)), ('all', (1, 1))]
 
     Reference parity: ``windowing.py:1579``.
     """

@@ -901,3 +901,88 @@ def test_windowed_fallback_boundary_then_columnar(monkeypatch):
 
     device, host = run("1"), run("0")
     assert device == host == [("a", (0, 5.0))]
+
+
+def test_dict_encoded_window_cross_tier_recovery(tmp_path, monkeypatch):
+    # Dict-encoded windowed batches crash on the device tier and
+    # resume on the host tier (and the vocab re-syncs after resume on
+    # the device tier).
+    from bytewax_tpu import xla
+    from bytewax_tpu.engine.arrays import ArrayBatch
+    from bytewax_tpu.inputs import FixedPartitionedSource, StatefulSourcePartition
+    from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+
+    init_db_dir(tmp_path, 1)
+    rc = RecoveryConfig(str(tmp_path))
+    vocab = np.array(["a", "b"])
+    base = np.datetime64(ALIGN.replace(tzinfo=None), "us")
+
+    def batch(ids, secs, vals):
+        return ArrayBatch(
+            {
+                "key_id": np.asarray(ids, dtype=np.int32),
+                "ts": base + np.asarray(secs).astype("timedelta64[s]"),
+                "value": np.asarray(vals, dtype=np.float64),
+            },
+            key_vocab=vocab,
+        )
+
+    crashed: list = []  # the crash marker fires once, like ABORT
+
+    class _Part(StatefulSourcePartition):
+        def __init__(self, resume):
+            self._i = resume or 0
+            self._batches = [
+                batch([0, 1], [1, 2], [2.0, 5.0]),
+                None,  # crash marker
+                batch([0, 1], [3, 4], [3.0, 7.0]),
+            ]
+
+        def next_batch(self):
+            while True:
+                if self._i >= len(self._batches):
+                    raise StopIteration()
+                b = self._batches[self._i]
+                self._i += 1
+                if b is None:
+                    if not crashed:
+                        crashed.append(True)
+                        from bytewax_tpu.inputs import AbortExecution
+
+                        raise AbortExecution()
+                    continue
+                return b
+
+        def snapshot(self):
+            return self._i
+
+    class Src(FixedPartitionedSource):
+        def list_parts(self):
+            return ["p0"]
+
+        def build_part(self, step_id, name, resume):
+            return _Part(resume)
+
+    def build(out):
+        clock = EventClock(
+            ts_getter=xla.column_ts,
+            wait_for_system_duration=timedelta(days=999),
+        )
+        windower = TumblingWindower(
+            length=timedelta(minutes=1), align_to=ALIGN
+        )
+        flow = Dataflow("test_df")
+        s = op.input("inp", flow, Src())
+        wo = w.reduce_window("sum", s, clock, windower, xla.SUM)
+        op.output("out", wo.down, TestingSink(out))
+        return flow
+
+    out: list = []
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
+    # The crash marker only fires on the first execution: resumes skip
+    # it because the partition snapshot is already past its index.
+    run_main(build(out), epoch_interval=timedelta(0), recovery_config=rc)
+    assert out == []
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
+    run_main(build(out), epoch_interval=timedelta(0), recovery_config=rc)
+    assert sorted(out) == [("a", (0, 5.0)), ("b", (0, 12.0))]
