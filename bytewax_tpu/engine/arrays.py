@@ -94,15 +94,15 @@ class VocabMap:
                 self.vocab = arr
                 self.table = np.concatenate([self.table, pad])
             self._ref = vocab
-        if len(ids) and (
-            int(ids.max()) >= len(self.table) or int(ids.min()) < 0
-        ):
-            bad = int(ids.max()) if int(ids.max()) >= len(self.table) else int(ids.min())
-            msg = (
-                f"key_id {bad} is out of range for a "
-                f"{len(self.table)}-entry key_vocab"
-            )
-            raise TypeError(msg)
+        if len(ids):
+            mx, mn = int(ids.max()), int(ids.min())
+            if mx >= len(self.table) or mn < 0:
+                bad = mx if mx >= len(self.table) else mn
+                msg = (
+                    f"key_id {bad} is out of range for a "
+                    f"{len(self.table)}-entry key_vocab"
+                )
+                raise TypeError(msg)
         # bincount + nonzero beats np.unique's sort by ~20x here.
         counts = np.bincount(ids, minlength=len(self.table))
         uniq = np.nonzero(counts)[0]
@@ -212,18 +212,16 @@ class ArrayBatch:
         per-row dicts.
         """
         names = set(self.cols)
-        decodable = "key_id" not in self.cols or self.key_vocab is not None
-        if names == {"key", "ts"} or (
-            names == {"key_id", "ts"} and decodable
-        ):
+        # A column named key_id invokes the dictionary-encoded keyed
+        # convention; _key_strings raises a clear error when the
+        # vocab is missing rather than silently mis-keying rows.
+        if names in ({"key", "ts"}, {"key_id", "ts"}):
             # Columnar windowed-event batches degrade to (key,
             # timestamp) items so the host tier (and cluster
             # exchange) key them correctly; ts getters must accept
             # datetime values in columnar flows (see `column_ts`).
             return list(zip(self._key_strings(), self._ts_datetimes()))
-        if names == {"key", "ts", "value"} or (
-            names == {"key_id", "ts", "value"} and decodable
-        ):
+        if names in ({"key", "ts", "value"}, {"key_id", "ts", "value"}):
             # Numeric windowed-fold batches degrade to (key, TsValue)
             # items: the payload folds as a plain float and carries
             # the row's timestamp for `column_ts` getters.
@@ -235,7 +233,7 @@ class ArrayBatch:
                     self._key_strings(), values.tolist(), stamps
                 )
             ]
-        if names == {"key_id", "value"} and decodable:
+        if names == {"key_id", "value"}:
             return list(
                 zip(self._key_strings(), self._scaled_values().tolist())
             )

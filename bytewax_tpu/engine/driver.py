@@ -161,7 +161,21 @@ class _OpRt:
                 entries, self.queues[port] = q, []
                 for w, items in entries:
                     self._count_inp(w, len(items))
-                self.process(port, entries)
+                if self.driver.trace_ops:
+                    # Per-activation spans, like the reference's
+                    # debug_span!("operator") (src/operators.rs:184) —
+                    # only when a backend/DEBUG logging wants them.
+                    from bytewax_tpu.tracing import span
+
+                    with span(
+                        "operator",
+                        step_id=self.op.step_id,
+                        port=port,
+                        entries=len(entries),
+                    ):
+                        self.process(port, entries)
+                else:
+                    self.process(port, entries)
 
     def process(self, port: str, entries: List[Entry]) -> None:
         raise NotImplementedError()
@@ -907,6 +921,11 @@ class _Driver:
         # Device acceleration of recognized aggregations; disable with
         # BYTEWAX_TPU_ACCEL=0 to force the host-tier oracle.
         self.accel = os.environ.get("BYTEWAX_TPU_ACCEL", "1") != "0"
+
+        # Per-operator activation spans only when someone is looking.
+        from bytewax_tpu.tracing import spans_active
+
+        self.trace_ops = spans_active()
 
         # BYTEWAX_TPU_PLATFORM=cpu forces the CPU backend even when a
         # site hook pre-registers an accelerator (useful when the chip

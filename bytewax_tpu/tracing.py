@@ -22,6 +22,7 @@ __all__ = [
     "TracingConfig",
     "setup_tracing",
     "span",
+    "spans_active",
 ]
 
 logger = logging.getLogger("bytewax_tpu")
@@ -129,6 +130,15 @@ def setup_tracing(
 
     _tracer = BytewaxTracer(tracing_config, provider)
     return _tracer
+
+
+def spans_active() -> bool:
+    """Whether spans currently go anywhere (an exporting backend is
+    configured, or local DEBUG logging is on) — callers on hot paths
+    check this once instead of paying the span plumbing per call."""
+    if _tracer is not None and _tracer._provider is not None:
+        return True
+    return logger.isEnabledFor(logging.DEBUG)
 
 
 @contextlib.contextmanager

@@ -146,3 +146,34 @@ def test_duration_histograms_observed(monkeypatch):
     from bytewax_tpu._metrics import DURATION_BUCKETS
 
     assert DURATION_BUCKETS[0] == 0.0005 and DURATION_BUCKETS[-1] == 10.0
+
+
+def test_per_operator_spans_at_debug(caplog):
+    # With DEBUG tracing on, every operator activation emits a span
+    # (the reference's debug_span!("operator") analog).
+    import logging
+
+    from bytewax_tpu.tracing import setup_tracing
+
+    guard = setup_tracing(None, "DEBUG")
+    try:
+        with caplog.at_level(logging.DEBUG, logger="bytewax_tpu"):
+            out = []
+            flow = Dataflow("span_df")
+            s = op.input("inp", flow, TestingSource([1, 2]))
+            s = op.map("double", s, lambda x: x * 2)
+            op.output("out", s, TestingSink(out))
+            run_main(flow)
+        assert out == [2, 4]
+        spans = [
+            r.getMessage()
+            for r in caplog.records
+            if "span operator" in r.getMessage()
+        ]
+        assert spans, "no operator spans emitted at DEBUG"
+        joined = " ".join(spans)
+        assert "span_df.double.flat_map_batch" in joined
+        assert "span_df.out" in joined
+    finally:
+        guard.shutdown()
+        setup_tracing(None, "ERROR")

@@ -986,3 +986,21 @@ def test_dict_encoded_window_cross_tier_recovery(tmp_path, monkeypatch):
     monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
     run_main(build(out), epoch_interval=timedelta(0), recovery_config=rc)
     assert sorted(out) == [("a", (0, 5.0)), ("b", (0, 12.0))]
+
+
+def test_key_id_without_vocab_raises_clearly():
+    # A key_id column invokes the dict convention; forgetting the
+    # vocab must be a clear error, not silently mis-keyed rows.
+    from bytewax_tpu.engine.arrays import ArrayBatch
+
+    ts = (
+        np.datetime64(ALIGN.replace(tzinfo=None), "us")
+        + np.array([1]).astype("timedelta64[s]")
+    )
+    for cols in (
+        {"key_id": np.array([0]), "ts": ts},
+        {"key_id": np.array([0]), "ts": ts, "value": np.array([1.0])},
+        {"key_id": np.array([0]), "value": np.array([1.0])},
+    ):
+        with pytest.raises(TypeError, match="key_vocab"):
+            ArrayBatch(cols).to_pylist()
