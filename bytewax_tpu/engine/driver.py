@@ -35,6 +35,8 @@ from bytewax_tpu.inputs import (
     DynamicSource,
     FixedPartitionedSource,
 )
+from bytewax_tpu.native import group_kv as _native_group_kv
+from bytewax_tpu.tracing import span as _span, spans_active as _spans_active
 from bytewax_tpu.outputs import DynamicSink, FixedPartitionedSink
 
 __all__ = ["cluster_main", "run_main"]
@@ -165,9 +167,7 @@ class _OpRt:
                     # Per-activation spans, like the reference's
                     # debug_span!("operator") (src/operators.rs:184) —
                     # only when a backend/DEBUG logging wants them.
-                    from bytewax_tpu.tracing import span
-
-                    with span(
+                    with _span(
                         "operator",
                         step_id=self.op.step_id,
                         port=port,
@@ -596,10 +596,21 @@ class _StatefulBatchRt(_OpRt):
         for _w, items in entries:
             if isinstance(items, ArrayBatch):
                 items = items.to_pylist()
-            groups: Dict[str, List[Any]] = {}
-            for item in items:
-                k, v = _extract_kv(item, self.op.step_id)
-                groups.setdefault(k, []).append(v)
+            groups: Optional[Dict[str, List[Any]]] = None
+            if type(items) is list:
+                try:
+                    # Native one-pass grouping (None when no toolchain).
+                    groups = _native_group_kv(items)
+                except TypeError:
+                    # Rows that are not exact str-keyed 2-tuples take
+                    # the general loop for its permissive unpacking
+                    # and step-qualified errors.
+                    groups = None
+            if groups is None:
+                groups = {}
+                for item in items:
+                    k, v = _extract_kv(item, self.op.step_id)
+                    groups.setdefault(k, []).append(v)
             for key, values in groups.items():
                 logic = self.logics.get(key)
                 if logic is None:
@@ -923,9 +934,7 @@ class _Driver:
         self.accel = os.environ.get("BYTEWAX_TPU_ACCEL", "1") != "0"
 
         # Per-operator activation spans only when someone is looking.
-        from bytewax_tpu.tracing import spans_active
-
-        self.trace_ops = spans_active()
+        self.trace_ops = _spans_active()
 
         # BYTEWAX_TPU_PLATFORM=cpu forces the CPU backend even when a
         # site hook pre-registers an accelerator (useful when the chip

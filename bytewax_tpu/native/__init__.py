@@ -14,11 +14,11 @@ import platform
 import subprocess
 import threading
 from pathlib import Path
-from typing import Optional
+from typing import Any, Optional
 
 import numpy as np
 
-__all__ = ["BrcParser", "is_available", "lib"]
+__all__ = ["BrcParser", "group_kv", "is_available", "lib"]
 
 _HERE = Path(__file__).parent
 _SRC = _HERE / "io_native.cpp"
@@ -26,18 +26,91 @@ _SRC = _HERE / "io_native.cpp"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
+_host_ops: Any = None
+_host_ops_tried = False
 
 
-def _cache_path(cmd_flags) -> Path:
-    """Cache key = source content + compiler flags + machine arch, so
-    a library built with ``-march=native`` for one arch is never
-    loaded on another (a stale or foreign binary can SIGILL); binaries
-    are gitignored, never shipped."""
+def _hashed_out_path(stem: str, src: Path, flags, *extra: str) -> Path:
+    """Cache key = source content + compiler flags + host identity
+    (a stale or foreign binary can SIGILL); binaries are gitignored,
+    never shipped."""
     h = hashlib.sha256()
-    h.update(_SRC.read_bytes())
-    h.update(" ".join(cmd_flags).encode())
+    h.update(src.read_bytes())
+    h.update(" ".join(flags).encode())
     h.update(platform.machine().encode())
-    return _HERE / f"_io_native-{h.hexdigest()[:12]}.so"
+    for part in extra:
+        h.update(part.encode())
+    return _HERE / f"{stem}-{h.hexdigest()[:12]}.so"
+
+
+def _compile_cached(compiler: str, src: Path, flags, out_path: Path) -> None:
+    """Compile to a per-process temp name and rename into place so a
+    concurrent lane never loads a half-written file (rename on the
+    same filesystem is atomic); failed runs leave no orphan temp, and
+    stale cache entries (not in-progress temps) are cleaned up."""
+    tmp_path = out_path.with_suffix(f".{os.getpid()}.tmp.so")
+    cmd = [compiler, *flags, str(src), "-o", str(tmp_path)]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=120
+        )
+        os.replace(tmp_path, out_path)
+    except BaseException:
+        tmp_path.unlink(missing_ok=True)
+        raise
+    stem = out_path.name.rsplit("-", 1)[0]
+    for stale in _HERE.glob(f"{stem}-*.so"):
+        if stale != out_path and not stale.name.endswith(".tmp.so"):
+            try:
+                stale.unlink()
+            except OSError:
+                pass
+
+
+def _build_ext(src: Path, modname: str):
+    """Compile + import a CPython extension module from one C file."""
+    import importlib.util
+    import sysconfig
+
+    flags = [
+        "-O3",
+        "-shared",
+        "-fPIC",
+        f"-I{sysconfig.get_path('include')}",
+    ]
+    ext_path = _hashed_out_path(
+        f"_{modname}", src, flags, platform.python_version()
+    )
+    if not ext_path.exists():
+        _compile_cached(
+            os.environ.get("CC", os.environ.get("CXX", "gcc")),
+            src,
+            flags,
+            ext_path,
+        )
+    spec = importlib.util.spec_from_file_location(modname, ext_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def group_kv(items):
+    """Group ``(str key, value)`` tuples into ``{key: [values]}`` with
+    the native fast path when it is available (and buildable), else
+    ``None`` so the caller runs its general Python loop.  The fast
+    path itself raises TypeError on rows that are not exact str-keyed
+    2-tuples — callers must fall back on that too."""
+    global _host_ops, _host_ops_tried
+    if _host_ops is None:
+        if _host_ops_tried:
+            return None
+        with _lock:
+            _host_ops_tried = True
+            try:
+                _host_ops = _build_ext(_HERE / "host_ops.c", "host_ops")
+            except Exception:  # noqa: BLE001 — no toolchain: stay Python
+                return None
+    return _host_ops.group_kv(items)
 
 
 def _build() -> Optional[ctypes.CDLL]:
@@ -49,35 +122,16 @@ def _build() -> Optional[ctypes.CDLL]:
         "-fPIC",
         "-std=c++17",
     ]
-    lib_path = _cache_path(flags)
+    lib_path = _hashed_out_path("_io_native", _SRC, flags)
     if lib_path.exists():
         return ctypes.CDLL(str(lib_path))
-    # Compile to a per-process temp name and rename into place so a
-    # concurrent lane never CDLLs a half-written file (rename on the
-    # same filesystem is atomic).
-    tmp_path = lib_path.with_suffix(f".{os.getpid()}.tmp.so")
-    cmd = [
-        os.environ.get("CXX", "g++"),
-        *flags,
-        str(_SRC),
-        "-o",
-        str(tmp_path),
-    ]
     try:
-        subprocess.run(
-            cmd, check=True, capture_output=True, text=True, timeout=120
+        _compile_cached(
+            os.environ.get("CXX", "g++"), _SRC, flags, lib_path
         )
-        os.replace(tmp_path, lib_path)
     except (subprocess.CalledProcessError, OSError, subprocess.TimeoutExpired) as ex:
         _build_error = getattr(ex, "stderr", str(ex)) or str(ex)
-        tmp_path.unlink(missing_ok=True)
         return None
-    for stale in _HERE.glob("_io_native-*.so"):
-        if stale != lib_path:
-            try:
-                stale.unlink()
-            except OSError:
-                pass
     return ctypes.CDLL(str(lib_path))
 
 

@@ -1,0 +1,82 @@
+/* Host data-plane fast paths for the engine driver.
+ *
+ * The reference's engine runs its per-item plumbing in native code
+ * (Rust); here the hot host-tier loop — grouping a delivery of
+ * (key, value) tuples by key — is one C pass instead of per-item
+ * Python bytecode.  Strictness contract: only exact 2-tuples with
+ * str keys take the fast path; anything else raises TypeError and
+ * the caller falls back to the general Python loop (which accepts
+ * any 2-iterable and raises the step-qualified error).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static PyObject *
+group_kv(PyObject *self, PyObject *args)
+{
+    PyObject *items;
+    if (!PyArg_ParseTuple(args, "O", &items)) {
+        return NULL;
+    }
+    if (!PyList_Check(items)) {
+        PyErr_SetString(PyExc_TypeError, "items must be a list");
+        return NULL;
+    }
+    PyObject *groups = PyDict_New();
+    if (groups == NULL) {
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(items);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GET_ITEM(items, i); /* borrowed */
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2) {
+            Py_DECREF(groups);
+            PyErr_SetString(PyExc_TypeError,
+                            "row is not a (key, value) 2-tuple");
+            return NULL;
+        }
+        PyObject *k = PyTuple_GET_ITEM(item, 0);
+        PyObject *v = PyTuple_GET_ITEM(item, 1);
+        if (!PyUnicode_Check(k)) {
+            Py_DECREF(groups);
+            PyErr_SetString(PyExc_TypeError, "key is not a str");
+            return NULL;
+        }
+        PyObject *lst = PyDict_GetItemWithError(groups, k); /* borrowed */
+        if (lst == NULL) {
+            if (PyErr_Occurred()) {
+                Py_DECREF(groups);
+                return NULL;
+            }
+            lst = PyList_New(0);
+            if (lst == NULL || PyDict_SetItem(groups, k, lst) < 0) {
+                Py_XDECREF(lst);
+                Py_DECREF(groups);
+                return NULL;
+            }
+            Py_DECREF(lst); /* dict keeps it alive; borrowed below */
+        }
+        if (PyList_Append(lst, v) < 0) {
+            Py_DECREF(groups);
+            return NULL;
+        }
+    }
+    return groups;
+}
+
+static PyMethodDef HostOpsMethods[] = {
+    {"group_kv", group_kv, METH_VARARGS,
+     "Group a list of (str key, value) tuples into {key: [values]}."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef hostopsmodule = {
+    PyModuleDef_HEAD_INIT, "host_ops",
+    "Native host-tier fast paths.", -1, HostOpsMethods,
+};
+
+PyMODINIT_FUNC
+PyInit_host_ops(void)
+{
+    return PyModule_Create(&hostopsmodule);
+}

@@ -18,6 +18,21 @@ from bytewax_tpu.recovery import RecoveryConfig, init_db_dir  # noqa: E402
 from bytewax_tpu.testing import cluster_main, run_main  # noqa: E402
 
 
+@fixture(scope="session", autouse=True)
+def _warm_device_tier():
+    """Compile the device fold once up front: EventClock watermarks
+    advance with wall-clock time, so a ~1s first-compile inside a
+    windowing test can flip borderline items late (a cold-start flake
+    when a single test runs alone)."""
+    import numpy as np
+
+    from bytewax_tpu.engine.xla import DeviceAggState
+
+    st = DeviceAggState("count")
+    st.update(np.array(["warm"]), np.array([1.0]))
+    st.finalize()
+
+
 @fixture(params=["run_main", "cluster_main-1thread", "cluster_main-2thread"])
 def entry_point_name(request):
     """Run a version of the test for each execution entry point."""
