@@ -68,6 +68,11 @@ class Comm:
         host, _, port = addresses[proc_id].rpartition(":")
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if hasattr(socket, "SO_REUSEPORT"):
+            # Lets the testing spawner hold each allocated port (non-
+            # listening) until this process binds it, closing the
+            # port-stealing race between allocation and bind.
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         listener.bind((host or "0.0.0.0", int(port)))
         listener.listen(self.proc_count)
 

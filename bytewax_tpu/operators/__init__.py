@@ -60,6 +60,20 @@ def _get_system_utc() -> datetime:
     return datetime.now(timezone.utc)
 
 
+def _unpack_kv(step_id: str, k_v: Any) -> Tuple[str, Any]:
+    """Unpack an upstream ``(key, value)`` 2-tuple with the shared
+    keyed-operator error wording."""
+    try:
+        k, v = k_v
+    except TypeError as ex:
+        msg = (
+            f"step {step_id!r} requires (key, value) 2-tuple from "
+            f"upstream; got a {type(k_v)!r} instead"
+        )
+        raise TypeError(msg) from ex
+    return k, v
+
+
 def _untyped_none() -> Any:
     return None
 
@@ -437,18 +451,15 @@ def flat_map_value(
     Reference parity: ``operators/__init__.py:1526``.
     """
 
-    def shim_mapper(k_v: Tuple[str, V]) -> Iterable[Tuple[str, W]]:
-        try:
-            k, v = k_v
-        except TypeError as ex:
-            msg = (
-                f"step {step_id!r} requires (key, value) 2-tuple from "
-                f"upstream; got a {type(k_v)!r} instead"
-            )
-            raise TypeError(msg) from ex
-        return ((k, w) for w in mapper(v))
+    def shim_mapper(k_vs: List[Tuple[str, V]]) -> List[Tuple[str, W]]:
+        out = []
+        for k_v in k_vs:
+            k, v = _unpack_kv(step_id, k_v)
+            for w in mapper(v):
+                out.append((k, w))
+        return out
 
-    return flat_map("flat_map", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
 @operator
@@ -473,16 +484,19 @@ def flatten(
     Reference parity: ``operators/__init__.py:1593``.
     """
 
-    def shim_mapper(x: Iterable[X]) -> Iterable[X]:
-        if not isinstance(x, Iterable):
-            msg = (
-                f"step {step_id!r} requires upstream to be iterables; "
-                f"got a {type(x)!r} instead"
-            )
-            raise TypeError(msg)
-        return x
+    def shim_mapper(xs: List[Iterable[X]]) -> List[X]:
+        out: List[X] = []
+        for x in xs:
+            if not isinstance(x, Iterable):
+                msg = (
+                    f"step {step_id!r} requires upstream to be iterables; "
+                    f"got a {type(x)!r} instead"
+                )
+                raise TypeError(msg)
+            out.extend(x)
+        return out
 
-    return flat_map("flat_map", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
 @operator
@@ -508,19 +522,22 @@ def filter(  # noqa: A001
     Reference parity: ``operators/__init__.py:1652``.
     """
 
-    def shim_mapper(x: X) -> Iterable[X]:
-        keep = predicate(x)
-        if not isinstance(keep, bool):
-            msg = (
-                f"return value of predicate {f_repr(predicate)} "
-                f"in step {step_id!r} must be a bool; got {keep!r} instead"
-            )
-            raise TypeError(msg)
-        if keep:
-            return (x,)
-        return _EMPTY
+    def shim_mapper(xs: List[X]) -> List[X]:
+        out = []
+        for x in xs:
+            keep = predicate(x)
+            if not isinstance(keep, bool):
+                msg = (
+                    f"return value of predicate {f_repr(predicate)} "
+                    f"in step {step_id!r} must be a bool; got {keep!r} "
+                    "instead"
+                )
+                raise TypeError(msg)
+            if keep:
+                out.append(x)
+        return out
 
-    return flat_map("flat_map", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
 @operator
@@ -546,19 +563,23 @@ def filter_value(
     Reference parity: ``operators/__init__.py:1726``.
     """
 
-    def shim_mapper(v: V) -> Iterable[V]:
-        keep = predicate(v)
-        if not isinstance(keep, bool):
-            msg = (
-                f"return value of predicate {f_repr(predicate)} "
-                f"in step {step_id!r} must be a bool; got {keep!r} instead"
-            )
-            raise TypeError(msg)
-        if keep:
-            return (v,)
-        return _EMPTY
+    def shim_mapper(k_vs: List[Tuple[str, V]]) -> List[Tuple[str, V]]:
+        out = []
+        for k_v in k_vs:
+            _k, v = _unpack_kv(step_id, k_v)
+            keep = predicate(v)
+            if not isinstance(keep, bool):
+                msg = (
+                    f"return value of predicate {f_repr(predicate)} "
+                    f"in step {step_id!r} must be a bool; got {keep!r} "
+                    "instead"
+                )
+                raise TypeError(msg)
+            if keep:
+                out.append(k_v)
+        return out
 
-    return flat_map_value("filter", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
 @operator
@@ -584,13 +605,15 @@ def filter_map(
     Reference parity: ``operators/__init__.py:1790``.
     """
 
-    def shim_mapper(x: X) -> Iterable[Y]:
-        y = mapper(x)
-        if y is not None:
-            return (y,)
-        return _EMPTY
+    def shim_mapper(xs: List[X]) -> List[Y]:
+        out = []
+        for x in xs:
+            y = mapper(x)
+            if y is not None:
+                out.append(y)
+        return out
 
-    return flat_map("flat_map", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
 @operator
@@ -616,13 +639,16 @@ def filter_map_value(
     Reference parity: ``operators/__init__.py:1860``.
     """
 
-    def shim_mapper(v: V) -> Iterable[W]:
-        w = mapper(v)
-        if w is not None:
-            return (w,)
-        return _EMPTY
+    def shim_mapper(k_vs: List[Tuple[str, V]]) -> List[Tuple[str, W]]:
+        out = []
+        for k_v in k_vs:
+            k, v = _unpack_kv(step_id, k_v)
+            w = mapper(v)
+            if w is not None:
+                out.append((k, w))
+        return out
 
-    return flat_map_value("flat_map_value", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
 @operator
@@ -666,17 +692,20 @@ def key_on(step_id: str, up: Stream[X], key: Callable[[X], str]) -> KeyedStream[
     Reference parity: ``operators/__init__.py:2375``.
     """
 
-    def shim_mapper(x: X) -> Tuple[str, X]:
-        k = key(x)
-        if not isinstance(k, str):
-            msg = (
-                f"return value of key function {f_repr(key)} "
-                f"in step {step_id!r} must be a str; got {k!r} instead"
-            )
-            raise TypeError(msg)
-        return (k, x)
+    def shim_mapper(xs: List[X]) -> List[Tuple[str, X]]:
+        out = []
+        for x in xs:
+            k = key(x)
+            if not isinstance(k, str):
+                msg = (
+                    f"return value of key function {f_repr(key)} "
+                    f"in step {step_id!r} must be a str; got {k!r} instead"
+                )
+                raise TypeError(msg)
+            out.append((k, x))
+        return out
 
-    return map("map", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
 @operator
@@ -698,11 +727,10 @@ def key_rm(step_id: str, up: KeyedStream[X]) -> Stream[X]:
     Reference parity: ``operators/__init__.py:2439``.
     """
 
-    def shim_mapper(k_v: Tuple[str, X]) -> X:
-        _k, v = k_v
-        return v
+    def shim_batch(k_vs: List[Tuple[str, X]]) -> List[X]:
+        return [v for _k, v in k_vs]
 
-    return map("map", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, shim_batch)
 
 
 @operator
@@ -768,7 +796,10 @@ def map_value(
             raise TypeError(msg) from ex
         return (k, mapper(v))
 
-    return map("map", up, shim_mapper)
+    def shim_batch(k_vs: List[Tuple[str, V]]) -> List[Tuple[str, W]]:
+        return [shim_mapper(k_v) for k_v in k_vs]
+
+    return flat_map_batch("flat_map_batch", up, shim_batch)
 
 
 @operator

@@ -226,11 +226,19 @@ def _cluster_test_main() -> None:
         run_main_cli()
         return
 
+    # Allocate each worker's port and HOLD it (SO_REUSEPORT, not
+    # listening) until the children have spawned: closing before the
+    # child rebinds would let any concurrent process steal the port.
     addresses = []
+    holders = []
     for _ in range(args.processes):
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            addresses.append(f"127.0.0.1:{s.getsockname()[1]}")
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if hasattr(socket, "SO_REUSEPORT"):
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind(("127.0.0.1", 0))
+        holders.append(s)
+        addresses.append(f"127.0.0.1:{s.getsockname()[1]}")
 
     procs = []
     for proc_id in range(args.processes):
@@ -253,6 +261,8 @@ def _cluster_test_main() -> None:
         for proc in procs:
             proc.wait()
             exit_code = exit_code or proc.returncode
+        for holder in holders:
+            holder.close()
     except KeyboardInterrupt:
         for proc in procs:
             proc.terminate()
