@@ -295,6 +295,13 @@ class ShardedAggState:
             jax.device_put(valid_p, self._sharding),
         )
 
+    def update_ids(self, kids: np.ndarray, values: np.ndarray) -> None:
+        """Fold rows into pre-allocated wire ids (the id-based fold
+        surface shared with ``DeviceAggState``: ids are whatever
+        :meth:`alloc` returned)."""
+        values = self._pick_dtype(np.asarray(values))
+        self._dispatch(np.asarray(kids, dtype=np.int32), values)
+
     def update(self, keys: np.ndarray, values: np.ndarray) -> List[str]:
         """Fold ``(key, value)`` rows in; returns the unique keys
         touched (for epoch snapshot bookkeeping)."""

@@ -24,7 +24,6 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from bytewax_tpu.engine.arrays import VocabMap
-from bytewax_tpu.engine.xla import DeviceAggState
 
 __all__ = ["DeviceWindowAggState", "WindowAccelSpec"]
 
@@ -80,8 +79,14 @@ class DeviceWindowAggState:
     """
 
     def __init__(self, spec: WindowAccelSpec):
+        from bytewax_tpu.engine.sharded_state import make_agg_state
+
         self.spec = spec
-        self.agg = DeviceAggState(spec.kind)
+        # Mesh-sharded slot table when >1 local device: the window
+        # bookkeeping (watermarks, open/close) stays host-side; the
+        # per-(key, window) fold rides the same all_to_all exchange
+        # as keyed aggregations.
+        self.agg = make_agg_state(spec.kind)
         # windows_per_ts is static for a sliding windower.
         self.expand = max(1, int(np.ceil(spec.length_us / spec.offset_us)))
         # Per-key clock state, indexed by key id.
@@ -304,7 +309,7 @@ class DeviceWindowAggState:
                 )
                 self._open_cache = None
         if len(comp):
-            self.agg.update_slots(slot_of_uniq[inverse], val_rep)
+            self.agg.update_ids(slot_of_uniq[inverse], val_rep)
 
     def _open_arrays(self):
         """Cached parallel arrays of the open-window table so the

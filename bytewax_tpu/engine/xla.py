@@ -209,6 +209,10 @@ class DeviceAggState:
         self._ensure_fields()
         self._scatter(slot_ids.astype(np.int32), values)
 
+    # The id-based fold surface shared with ShardedAggState: ids are
+    # whatever :meth:`alloc` returned (slots here, wire kids there).
+    update_ids = update_slots
+
     # -- updates -----------------------------------------------------------
 
     def _pick_dtype(self, values: np.ndarray) -> np.ndarray:

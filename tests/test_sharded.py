@@ -375,3 +375,63 @@ def test_make_agg_state_selection(monkeypatch):
     st4 = make_agg_state("sum")
     assert isinstance(st4, ShardedAggState)
     assert st4.n_shards == 4
+
+
+def test_windowed_fold_sharded_matches_single_device(monkeypatch):
+    # The windowed fold table shards over the mesh too: same output
+    # as the single-device slot table and the host tier.
+    from datetime import datetime, timedelta, timezone
+
+    import bytewax_tpu.operators.windowing as w
+    from bytewax_tpu.operators.windowing import EventClock, TumblingWindower
+    from tests.test_xla import ArraySource
+
+    _mesh()
+    align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    n = 4000
+    rng = np.random.RandomState(12)
+    secs = np.sort(rng.randint(0, 300, size=n))
+    keys = np.array([f"key{k}" for k in rng.randint(0, 6, size=n)])
+    vals = (rng.randn(n) * 3).round(2)
+    ts = (
+        np.datetime64(align.replace(tzinfo=None), "us")
+        + secs.astype("timedelta64[s]")
+    )
+
+    def run(accel, shard):
+        monkeypatch.setenv("BYTEWAX_TPU_ACCEL", accel)
+        monkeypatch.setenv("BYTEWAX_TPU_SHARD", shard)
+        batches = [
+            ArrayBatch(
+                {
+                    "key": keys[i : i + 512],
+                    "ts": ts[i : i + 512],
+                    "value": vals[i : i + 512],
+                }
+            )
+            for i in range(0, n, 512)
+        ]
+        clock = EventClock(
+            ts_getter=xla.column_ts,
+            wait_for_system_duration=timedelta(seconds=30),
+        )
+        windower = TumblingWindower(
+            length=timedelta(minutes=1), align_to=align
+        )
+        out = []
+        flow = Dataflow("swin_df")
+        s = op.input("inp", flow, ArraySource(batches))
+        wo = w.reduce_window("sum", s, clock, windower, xla.SUM)
+        op.output("out", wo.down, TestingSink(out))
+        run_main(flow)
+        return sorted(out)
+
+    sharded = run("1", "8")
+    single = run("1", "0")
+    host = run("0", "0")
+    assert [kv[0] for kv in sharded] == [kv[0] for kv in host]
+    for (k, (wd, vs)), (_k1, (_w1, v1)), (_k2, (_w2, vh)) in zip(
+        sharded, single, host
+    ):
+        np.testing.assert_allclose(vs, v1, rtol=1e-5, err_msg=k)
+        np.testing.assert_allclose(vs, vh, rtol=1e-4, err_msg=k)
