@@ -50,12 +50,22 @@ def bucket_by_shard(
     """
     n = shard_ids.shape[0]
     shard_ids = jnp.where(valid, shard_ids, n_shards)  # padding → overflow bin
-    # Stable position of each row within its bucket.
-    onehot = jax.nn.one_hot(shard_ids, n_shards + 1, dtype=jnp.int32)  # [n, S+1]
-    pos = jnp.cumsum(onehot, axis=0) - onehot  # rank of row in its bucket
-    row_pos = jnp.take_along_axis(pos, shard_ids[:, None], axis=1)[:, 0]
-    raw_counts = onehot.sum(axis=0)[:n_shards]
-    counts = jnp.minimum(raw_counts, capacity)
+    # Stable position of each row within its bucket via a sort by
+    # shard id: rank = index in sort order − bucket start.  O(n log n)
+    # time and O(n) memory — a one-hot cumsum would be O(n·S) memory,
+    # which matters on large meshes.
+    order = jnp.argsort(shard_ids, stable=True)
+    shard_sorted = shard_ids[order]
+    raw_counts_all = jnp.bincount(shard_ids, length=n_shards + 1)
+    starts = jnp.concatenate(
+        [jnp.zeros(1, dtype=raw_counts_all.dtype), jnp.cumsum(raw_counts_all)[:-1]]
+    )
+    rank_sorted = jnp.arange(n) - starts[shard_sorted]
+    row_pos = jnp.zeros(n, dtype=jnp.int32).at[order].set(
+        rank_sorted.astype(jnp.int32)
+    )
+    raw_counts = raw_counts_all[:n_shards]
+    counts = jnp.minimum(raw_counts, capacity).astype(jnp.int32)
     dropped = (raw_counts - counts).sum()
 
     in_cap = row_pos < capacity

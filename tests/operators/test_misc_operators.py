@@ -156,3 +156,30 @@ def test_then_returns_chainable_windowout():
     op.output("out", wo.down, TestingSink(out))
     run_main(flow)
     assert len(out) == 1
+
+
+def test_batch_async_slow_producer_preserves_inflight():
+    # A producer slower than the gather timeout yields partial/empty
+    # batches, and the in-flight anext survives across timeouts so no
+    # item is ever lost or duplicated.
+    import asyncio
+    from datetime import timedelta
+
+    from bytewax_tpu.inputs import batch_async
+
+    async def agen():
+        for i in range(6):
+            await asyncio.sleep(0.03)  # slower than the 20ms timeout
+            yield i
+
+    batcher = batch_async(
+        agen(), timeout=timedelta(seconds=0.02), batch_size=3
+    )
+    got = []
+    rounds = 0
+    for batch in batcher:
+        got.extend(batch)
+        rounds += 1
+        assert rounds < 100, "batcher never finished"
+    assert got == [0, 1, 2, 3, 4, 5]
+    assert rounds > 3  # timeouts produced partial/empty rounds

@@ -29,9 +29,15 @@ ALIGN_TO = datetime(2022, 1, 1, tzinfo=timezone.utc)
 
 
 def _ts_clock():
+    # wait=10s gives load tolerance: EventClock watermarks advance
+    # with wall-clock time, so with wait=0 any ~1s stall between
+    # single-item batches (compile, CI load) flips the next on-time
+    # item late.  The deliberate lateness scenarios in this file use
+    # event-time gaps of 29-59s, far above the wait, and every window
+    # still closes at EOF.
     return EventClock(
         ts_getter=lambda item: item[0],
-        wait_for_system_duration=ZERO_TD,
+        wait_for_system_duration=timedelta(seconds=10),
     )
 
 

@@ -88,7 +88,7 @@ def test_count_window_benchmark_shape(monkeypatch):
         inp = [ALIGN + timedelta(seconds=i) for i in range(3000)]
         clock = EventClock(
             ts_getter=lambda x: x,
-            wait_for_system_duration=timedelta(seconds=0),
+            wait_for_system_duration=timedelta(seconds=10),
         )
         windower = TumblingWindower(
             length=timedelta(minutes=1), align_to=ALIGN
@@ -117,7 +117,7 @@ def test_window_accel_late_items(monkeypatch):
     monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
     clock = EventClock(
         ts_getter=lambda item: item[0],
-        wait_for_system_duration=timedelta(seconds=0),
+        wait_for_system_duration=timedelta(seconds=10),
     )
     windower = TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN)
     inp = [
