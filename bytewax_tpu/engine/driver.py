@@ -945,6 +945,44 @@ class _Driver:
 
             force_platform(plat)
 
+        # Multi-host accelerator pods: BYTEWAX_TPU_DISTRIBUTED=1 runs
+        # jax.distributed.initialize before any backend comes up, so
+        # each cluster process owns exactly its host's chips (on TPU
+        # pods jax REQUIRES this; each process then shards its
+        # aggregation state over jax.local_devices() while the host
+        # TCP mesh carries cross-process keyed routing).  The
+        # coordinator defaults to process 0's host on the cluster
+        # port + 1711; override with BYTEWAX_TPU_COORDINATOR.
+        if (
+            os.environ.get("BYTEWAX_TPU_DISTRIBUTED") == "1"
+            and self.proc_count > 1
+        ):
+            import jax
+
+            if not jax.distributed.is_initialized():
+                coord = os.environ.get("BYTEWAX_TPU_COORDINATOR")
+                if not coord:
+                    # Derive a deterministic coordinator port from the
+                    # cluster port, folded into the registered-port
+                    # range so high ephemeral cluster ports can't
+                    # produce an invalid (>65535) address.  Collisions
+                    # with unrelated listeners remain possible — set
+                    # BYTEWAX_TPU_COORDINATOR explicitly on shared
+                    # hosts.
+                    host, _, port = addresses[0].rpartition(":")
+                    cport = 1024 + (int(port) + 1711) % 60000
+                    coord = f"{host or '127.0.0.1'}:{cport}"
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=self.proc_count,
+                    process_id=proc_id,
+                )
+            # Backend creation is COLLECTIVE under the distributed
+            # runtime (local-topology exchange): every process must
+            # join it, so bring the backend up now rather than
+            # whenever some worker happens to touch jax first.
+            jax.local_devices()
+
         self.store: Optional[RecoveryStore] = None
         self._loads: Dict[Tuple[str, str], bytes] = {}
         resume = ResumeFrom(0, 1)

@@ -604,3 +604,71 @@ op.output("out", fmt, FileSink({out_path!r}))
     # counts per key must cover all 30 items exactly once.
     assert len(lines) == 30
     assert max(int(x) for x in lines) == 6  # 30 items / 5 keys
+
+
+def test_cluster_jax_distributed_init(tmp_path):
+    # BYTEWAX_TPU_DISTRIBUTED=1: each cluster process joins one jax
+    # distributed runtime (global devices = sum of locals) while the
+    # dataflow's keyed exchange still routes over the host mesh —
+    # the multi-host pod composition, exercised on CPU.
+    flow_py = tmp_path / "dist_flow.py"
+    out_path = str(tmp_path / "out.txt")
+    flow_py.write_text(
+        f'''
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+
+
+class _Part(StatelessSourcePartition):
+    def __init__(self, worker_index):
+        self._items = [(f"key-{{i}}", 1) for i in range(8)]
+        self._done = worker_index != 0
+
+    def next_batch(self):
+        if self._done:
+            raise StopIteration()
+        self._done = True
+        import jax
+
+        # Inside a worker: the distributed runtime is live — the
+        # global device view is both processes' locals combined.
+        assert jax.process_count() == 2, jax.process_count()
+        assert (
+            jax.device_count() == 2 * jax.local_device_count()
+        ), (jax.device_count(), jax.local_device_count())
+        return self._items
+
+
+class Src(DynamicSource):
+    def build(self, step_id, worker_index, worker_count):
+        return _Part(worker_index)
+
+
+flow = Dataflow("dist_df")
+s = op.input("inp", flow, Src())
+summed = op.reduce_final("sum", s, lambda a, b: a + b)
+fmt = op.map_value("fmt", summed, str)
+op.output("out", fmt, FileSink({out_path!r}))
+'''
+    )
+    env = _env()
+    env["BYTEWAX_TPU_DISTRIBUTED"] = "1"
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.testing",
+            f"{flow_py}:flow",
+            "-p",
+            "2",
+        ],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert sorted(Path(out_path).read_text().split()) == ["1"] * 8
