@@ -966,6 +966,37 @@ def reduce_window(
 
     Like :func:`fold_window` but the first value is the accumulator.
 
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    >>> clock = win.EventClock(
+    ...     ts_getter=lambda v: v[0], wait_for_system_duration=timedelta(hours=1)
+    ... )
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1), align_to=align
+    ... )
+    >>> inp = [
+    ...     ("k", (align + timedelta(seconds=1), 4.0)),
+    ...     ("k", (align + timedelta(seconds=2), 9.0)),
+    ...     ("k", (align + timedelta(seconds=3), 2.0)),
+    ... ]
+    >>> vals_of = lambda s: op.map_value("unwrap", s, lambda p: p[1])
+    >>> flow = Dataflow("reduce_window_eg")
+    >>> s = vals_of(op.input("inp", flow, TestingSource(inp)))
+    >>> # ts getter sees bare floats after unwrap: map them back
+    >>> clock2 = win.EventClock(
+    ...     ts_getter=lambda v: align, wait_for_system_duration=timedelta(hours=1)
+    ... )
+    >>> wo = win.reduce_window("max", s, clock2, windower, max)
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', (0, 9.0))]
+
     Reference parity: ``windowing.py:2239``.
     """
 
@@ -994,6 +1025,33 @@ def max_window(
 ) -> WindowOut[V, V]:
     """Maximum value per key per window, emitted at window close.
 
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    >>> clock = win.EventClock(
+    ...     ts_getter=lambda v: v[0], wait_for_system_duration=timedelta(hours=1)
+    ... )
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1), align_to=align
+    ... )
+    >>> inp = [
+    ...     ("k", (align + timedelta(seconds=1), 4.0)),
+    ...     ("k", (align + timedelta(seconds=2), 9.0)),
+    ...     ("k", (align + timedelta(seconds=3), 2.0)),
+    ... ]
+    >>> vals_of = lambda s: op.map_value("unwrap", s, lambda p: p[1])
+    >>> flow = Dataflow("max_window_eg")
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> wo = win.max_window("max", s, clock, windower, by=lambda p: p[1])
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> [(k, (wid, v)) for k, (wid, (_ts, v)) in out]
+    [('k', (0, 9.0))]
+
     Reference parity: ``windowing.py:2164``.
     """
     return reduce_window(
@@ -1010,6 +1068,33 @@ def min_window(
     by=_identity,
 ) -> WindowOut[V, V]:
     """Minimum value per key per window, emitted at window close.
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    >>> clock = win.EventClock(
+    ...     ts_getter=lambda v: v[0], wait_for_system_duration=timedelta(hours=1)
+    ... )
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1), align_to=align
+    ... )
+    >>> inp = [
+    ...     ("k", (align + timedelta(seconds=1), 4.0)),
+    ...     ("k", (align + timedelta(seconds=2), 9.0)),
+    ...     ("k", (align + timedelta(seconds=3), 2.0)),
+    ... ]
+    >>> vals_of = lambda s: op.map_value("unwrap", s, lambda p: p[1])
+    >>> flow = Dataflow("min_window_eg")
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> wo = win.min_window("min", s, clock, windower, by=lambda p: p[1])
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> [(k, (wid, v)) for k, (wid, (_ts, v)) in out]
+    [('k', (0, 2.0))]
 
     Reference parity: ``windowing.py:2211``.
     """
@@ -1052,6 +1137,33 @@ def mean_window(
     to one device scatter-combine per micro-batch (see
     ``bytewax_tpu.xla.MEAN``); no reference counterpart — a TPU-tier
     extension of the ``max_window``/``min_window`` family.
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu import xla
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    >>> inp = [
+    ...     ("k", xla.TsValue(4.0, align + timedelta(seconds=1))),
+    ...     ("k", xla.TsValue(9.0, align + timedelta(seconds=2))),
+    ...     ("k", xla.TsValue(2.0, align + timedelta(seconds=3))),
+    ... ]
+    >>> clock = win.EventClock(
+    ...     ts_getter=xla.column_ts, wait_for_system_duration=timedelta(hours=1)
+    ... )
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1), align_to=align
+    ... )
+    >>> flow = Dataflow("mean_window_eg")
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> wo = win.mean_window("mean", s, clock, windower)
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', (0, 5.0))]
     """
     from bytewax_tpu.xla import MEAN
 
@@ -1072,6 +1184,33 @@ def stats_window(
     The fold keeps a ``(min, max, sum, count)`` accumulator the
     engine lowers to one device scatter-combine per micro-batch (see
     ``bytewax_tpu.xla.STATS``).
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu import xla
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    >>> inp = [
+    ...     ("k", xla.TsValue(4.0, align + timedelta(seconds=1))),
+    ...     ("k", xla.TsValue(9.0, align + timedelta(seconds=2))),
+    ...     ("k", xla.TsValue(2.0, align + timedelta(seconds=3))),
+    ... ]
+    >>> clock = win.EventClock(
+    ...     ts_getter=xla.column_ts, wait_for_system_duration=timedelta(hours=1)
+    ... )
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1), align_to=align
+    ... )
+    >>> flow = Dataflow("stats_window_eg")
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> wo = win.stats_window("stats", s, clock, windower)
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', (0, (2.0, 5.0, 9.0, 3)))]
     """
     from bytewax_tpu.xla import STATS
 
