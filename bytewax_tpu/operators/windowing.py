@@ -385,6 +385,11 @@ class _SlidingWindowerLogic(WindowerLogic[_SlidingWindowerState]):
     offset: timedelta
     align_to: datetime
     state: _SlidingWindowerState
+    # One-element timestamp->ids memo: real streams carry runs of
+    # identical (e.g. second-granularity) timestamps, and the id
+    # arithmetic is the per-item hot spot.  Not part of the snapshot.
+    _memo_ts: Optional[datetime] = field(default=None, compare=False)
+    _memo_ids: List[int] = field(default_factory=list, compare=False)
 
     def intersecting_ids(self, timestamp: datetime) -> List[int]:
         # Window i spans [align_to + i*offset, align_to + i*offset +
@@ -400,10 +405,18 @@ class _SlidingWindowerLogic(WindowerLogic[_SlidingWindowerState]):
         return WindowMetadata(open_time, open_time + self.length)
 
     def open_for(self, timestamp: datetime) -> List[int]:
-        ids = self.intersecting_ids(timestamp)
+        if timestamp == self._memo_ts:
+            # Copy on hit: callers own the returned list (the memo
+            # must never alias caller-visible state).
+            ids = list(self._memo_ids)
+        else:
+            ids = self.intersecting_ids(timestamp)
+            self._memo_ts = timestamp
+            self._memo_ids = list(ids)
+        opened = self.state.opened
         for window_id in ids:
-            if window_id not in self.state.opened:
-                self.state.opened[window_id] = self._meta_for(window_id)
+            if window_id not in opened:
+                opened[window_id] = self._meta_for(window_id)
         return ids
 
     def late_for(self, timestamp: datetime) -> List[int]:
