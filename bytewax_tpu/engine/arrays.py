@@ -49,17 +49,32 @@ class VocabMap:
 
     Grow a vocabulary by passing a NEW (longer) array or list each
     time; an ndarray mutated in place keeps its identity and skips
-    re-validation, so rewriting entries of a reused array corrupts
-    the mapping silently — never do that.
+    full re-validation, but the identity fast path spot-checks a
+    sample of entries and raises on a detected in-place rewrite.
     """
 
-    __slots__ = ("vocab", "table", "_ref", "_dtype")
+    __slots__ = ("vocab", "table", "_ref", "_ref_probe", "_dtype")
+
+    #: How many entries the identity fast path spot-checks per batch.
+    _PROBE_N = 16
 
     def __init__(self, dtype=np.int32):
         self.vocab: Optional[np.ndarray] = None
         self.table: Optional[np.ndarray] = None
         self._ref: Any = None
+        self._ref_probe: Any = None
         self._dtype = dtype
+
+    def _probe_of(self, arr: np.ndarray):
+        """A cheap fingerprint of an ndarray vocab: a spread of sampled
+        entries.  Lets the identity fast path catch in-place rewrites
+        (same object, new meanings) instead of corrupting the mapping
+        silently."""
+        n = len(arr)
+        if n == 0:
+            return (0, ())
+        idx = np.linspace(0, n - 1, min(n, self._PROBE_N)).astype(np.intp)
+        return (n, tuple(arr[idx].tolist()))
 
     def sync(self, ids: np.ndarray, vocab: Any, alloc_many) -> np.ndarray:
         """Install/extend ``vocab``, assign internal ids for new
@@ -67,17 +82,27 @@ class VocabMap:
         -> id array``, one call per batch of new keys), and return
         the unique external ids touched."""
         same = vocab is self._ref and (
-            # Identity only short-circuits validation for ndarrays —
-            # a list mutated in place keeps its identity, so lists
-            # re-validate every batch.
+            # Identity only short-circuits full validation for
+            # ndarrays (spot-checked below) — a list mutated in place
+            # keeps its identity, so lists re-validate every batch.
             isinstance(vocab, np.ndarray)
             or len(vocab) == len(self.table)
             and vocab == self.vocab.tolist()
         )
+        if same and isinstance(vocab, np.ndarray):
+            if self._probe_of(vocab) != self._ref_probe:
+                msg = (
+                    "key_vocab ndarray was rewritten in place; id "
+                    "meanings can never change between batches — grow "
+                    "a vocabulary by passing a new, longer array"
+                )
+                raise TypeError(msg)
         if self.vocab is None:
             self.vocab = np.asarray(vocab)
             self.table = np.full(len(self.vocab), -1, dtype=self._dtype)
             self._ref = vocab
+            if isinstance(vocab, np.ndarray):
+                self._ref_probe = self._probe_of(vocab)
         elif not same:
             arr = np.asarray(vocab)
             prev = len(self.table)
@@ -94,6 +119,8 @@ class VocabMap:
                 self.vocab = arr
                 self.table = np.concatenate([self.table, pad])
             self._ref = vocab
+            if isinstance(vocab, np.ndarray):
+                self._ref_probe = self._probe_of(vocab)
         if len(ids):
             mx, mn = int(ids.max()), int(ids.min())
             if mx >= len(self.table) or mn < 0:

@@ -68,10 +68,16 @@ class Comm:
         host, _, port = addresses[proc_id].rpartition(":")
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        if hasattr(socket, "SO_REUSEPORT"):
+        if hasattr(socket, "SO_REUSEPORT") and os.environ.get(
+            "BYTEWAX_TPU_REUSEPORT"
+        ) == "1":
             # Lets the testing spawner hold each allocated port (non-
             # listening) until this process binds it, closing the
-            # port-stealing race between allocation and bind.
+            # port-stealing race between allocation and bind.  Opt-in
+            # only (the spawner sets the env var): a production bind
+            # must fail fast with EADDRINUSE when two processes are
+            # given the same address instead of silently splitting
+            # incoming handshake dials between them.
             listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         listener.bind((host or "0.0.0.0", int(port)))
         listener.listen(self.proc_count)

@@ -60,6 +60,8 @@ def make_agg_state(kind: str):
         try:
             limit = int(want)
         except ValueError:
+            limit = -1
+        if limit < 0:
             msg = (
                 f"BYTEWAX_TPU_SHARD={want!r} is not valid; use '0' "
                 "(single device), 'auto', or a device count"
@@ -252,6 +254,16 @@ class ShardedAggState:
                 values = values.astype(np.int32)
             if self._fields is None:
                 self.dtype = jnp.int32
+        elif self.dtype == jnp.int32:
+            # Mirrors the value_scale guard: a float batch after the
+            # accumulator locked to int32 would otherwise be silently
+            # truncated by the host-side cast into the int32 carrier.
+            msg = (
+                "float values arrived after earlier batches locked "
+                "this step's device state to an integer dtype; pass a "
+                "plain Python reducer for mixed int/float streams"
+            )
+            raise TypeError(msg)
         return values
 
     # -- updates -------------------------------------------------------------

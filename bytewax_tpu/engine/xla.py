@@ -235,6 +235,16 @@ class DeviceAggState:
                 values = values.astype(np.int32)
             if self._fields is None:
                 self.dtype = jnp.int32
+        elif self.dtype == jnp.int32:
+            # Mirrors the value_scale guard: a float batch after the
+            # accumulator locked to int32 would otherwise be silently
+            # truncated by the host-side cast into the int32 carrier.
+            msg = (
+                "float values arrived after earlier batches locked "
+                "this step's device state to an integer dtype; pass a "
+                "plain Python reducer for mixed int/float streams"
+            )
+            raise TypeError(msg)
         return values
 
     def update(self, keys: np.ndarray, values: np.ndarray) -> List[str]:

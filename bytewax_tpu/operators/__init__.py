@@ -1100,6 +1100,10 @@ def collect(
 class TTLCache(Generic[DK, DV]):
     """A dict-like cache with a fixed time-to-live.
 
+    Entries are stamped when fetched and re-fetched on first access
+    at or past their deadline (expiry is lazy: an entry that is never
+    read again is simply overwritten whenever it is next fetched).
+
     Reference parity: ``operators/__init__.py:1275``.
     """
 
@@ -1112,23 +1116,21 @@ class TTLCache(Generic[DK, DV]):
         self._getter = getter
         self._now_getter = now_getter
         self._ttl = ttl
-        self._cache: Dict[DK, Tuple[datetime, DV]] = {}
+        self._entries: Dict[DK, Tuple[datetime, DV]] = {}
 
     def get(self, k: DK) -> DV:
         """Get the cached value for a key, refreshing if expired."""
         now = self._now_getter()
-        try:
-            ts, v = self._cache[k]
-            if now - ts >= self._ttl:
-                raise KeyError()
-        except KeyError:
-            v = self._getter(k)
-            self._cache[k] = (now, v)
-        return v
+        entry = self._entries.get(k)
+        if entry is not None and now - entry[0] < self._ttl:
+            return entry[1]
+        value = self._getter(k)
+        self._entries[k] = (now, value)
+        return value
 
     def remove(self, k: DK) -> None:
         """Remove the cached value for a key."""
-        del self._cache[k]
+        del self._entries[k]
 
 
 @operator
@@ -1189,88 +1191,138 @@ JoinEmitMode: TypeAlias = Literal["complete", "final", "running"]
 (then the state resets), ``final`` only at EOF (finite streams only),
 ``running`` on every new value (missing sides are ``None``)."""
 
-_LONE_NONE = [None]
+class _SideTable:
+    """Per-side value pools for one key of a join.
 
+    Each side of the join owns a pool of values seen so far (an empty
+    pool means that side is still missing).  The insert mode is
+    applied at absorb time — ``first`` ignores repeats, ``last``
+    overwrites, ``product`` accumulates — and the window-merge algebra
+    lives in :meth:`union`.  Decisions about *when* to emit belong to
+    the emit policies below, not here.
+    """
 
-class _JoinState:
-    def __init__(self, seen: List[List[Any]]):
-        self.seen = seen
+    __slots__ = ("pools",)
+
+    def __init__(self, pools: List[List[Any]]):
+        self.pools = pools
 
     @classmethod
-    def for_side_count(cls, side_count: int) -> "_JoinState":
-        return cls([[] for _ in range(side_count)])
+    def empty(cls, n_sides: int) -> "_SideTable":
+        return cls([[] for _ in range(n_sides)])
 
-    def set_val(self, side: int, value: Any) -> None:
-        self.seen[side] = [value]
+    def absorb(self, side: int, value: Any, mode: str) -> None:
+        pool = self.pools[side]
+        if mode == "product":
+            pool.append(value)
+        elif mode == "last" or not pool:
+            pool[:] = (value,)
 
-    def add_val(self, side: int, value: Any) -> None:
-        self.seen[side].append(value)
+    def union(self, absorbed: "_SideTable", mode: str) -> None:
+        """Fold another table (from a merged-away session window,
+        which opened earlier) into this one: ``first`` lets the
+        earlier window win filled sides, ``last`` keeps this window's
+        sides where filled, ``product`` concatenates everything."""
+        pairs = zip(self.pools, absorbed.pools)
+        if mode == "product":
+            self.pools = [mine + theirs for mine, theirs in pairs]
+        elif mode == "first":
+            self.pools = [theirs or mine for mine, theirs in pairs]
+        else:  # last
+            self.pools = [mine or theirs for mine, theirs in pairs]
 
-    def is_set(self, side: int) -> bool:
-        return len(self.seen[side]) > 0
+    def complete(self) -> bool:
+        return all(self.pools)
 
-    def all_set(self) -> bool:
-        return all(len(vals) > 0 for vals in self.seen)
+    def rows(self) -> List[Tuple]:
+        """Every combination of one value per side, ``None`` standing
+        in for sides with no value yet."""
+        filled = [pool if pool else (None,) for pool in self.pools]
+        return list(itertools.product(*filled))
 
-    def astuples(self) -> List[Tuple]:
-        return list(
-            itertools.product(
-                *(vals if vals else _LONE_NONE for vals in self.seen)
-            )
-        )
-
-    def clear(self) -> None:
-        self.seen = [[] for _ in self.seen]
+    def reset(self) -> None:
+        for pool in self.pools:
+            del pool[:]
 
     def __eq__(self, other: Any) -> bool:
-        return isinstance(other, _JoinState) and self.seen == other.seen
+        return isinstance(other, _SideTable) and self.pools == other.pools
 
     def __repr__(self) -> str:
-        return f"_JoinState({self.seen!r})"
+        return f"_SideTable({self.pools!r})"
+
+
+class _EmitPolicy:
+    """When a join key's table emits rows downstream and whether its
+    state survives the emission.  The base policy never emits."""
+
+    __slots__ = ()
+
+    def after_absorb(self, table: _SideTable) -> Tuple[Iterable[Tuple], bool]:
+        return (_EMPTY, StatefulLogic.RETAIN)
+
+    def at_eof(self, table: _SideTable) -> Tuple[Iterable[Tuple], bool]:
+        return (_EMPTY, StatefulLogic.RETAIN)
+
+
+class _EmitWhenComplete(_EmitPolicy):
+    """Emit (and reset) the first time every side has a value."""
+
+    def after_absorb(self, table: _SideTable) -> Tuple[Iterable[Tuple], bool]:
+        if table.complete():
+            return (table.rows(), StatefulLogic.DISCARD)
+        return (_EMPTY, StatefulLogic.RETAIN)
+
+
+class _EmitEveryChange(_EmitPolicy):
+    """Emit the (possibly partial) rows after every absorbed value."""
+
+    def after_absorb(self, table: _SideTable) -> Tuple[Iterable[Tuple], bool]:
+        return (table.rows(), StatefulLogic.RETAIN)
+
+
+class _EmitAtEof(_EmitPolicy):
+    """Hold everything until the stream ends, then flush."""
+
+    def at_eof(self, table: _SideTable) -> Tuple[Iterable[Tuple], bool]:
+        return (table.rows(), StatefulLogic.DISCARD)
+
+
+_EMIT_POLICIES: Dict[str, _EmitPolicy] = {
+    "complete": _EmitWhenComplete(),
+    "running": _EmitEveryChange(),
+    "final": _EmitAtEof(),
+}
 
 
 @dataclass
-class _JoinLogic(StatefulLogic[Tuple[int, Any], Tuple, _JoinState]):
+class _JoinLogic(StatefulLogic[Tuple[int, Any], Tuple, _SideTable]):
     insert_mode: str
-    emit_mode: str
-    state: _JoinState
+    policy: _EmitPolicy
+    table: _SideTable
 
     def on_item(self, value: Tuple[int, Any]) -> Tuple[Iterable[Tuple], bool]:
         side, side_value = value
-        if self.insert_mode == "first":
-            if not self.state.is_set(side):
-                self.state.set_val(side, side_value)
-        elif self.insert_mode == "last":
-            self.state.set_val(side, side_value)
-        else:  # product
-            self.state.add_val(side, side_value)
-
-        if self.emit_mode == "complete" and self.state.all_set():
-            return (self.state.astuples(), StatefulLogic.DISCARD)
-        if self.emit_mode == "running":
-            return (self.state.astuples(), StatefulLogic.RETAIN)
-        return (_EMPTY, StatefulLogic.RETAIN)
+        self.table.absorb(side, side_value, self.insert_mode)
+        return self.policy.after_absorb(self.table)
 
     def on_eof(self) -> Tuple[Iterable[Tuple], bool]:
-        if self.emit_mode == "final":
-            return (self.state.astuples(), StatefulLogic.DISCARD)
-        return (_EMPTY, StatefulLogic.RETAIN)
+        return self.policy.at_eof(self.table)
 
-    def snapshot(self) -> _JoinState:
-        return copy.deepcopy(self.state)
+    def snapshot(self) -> _SideTable:
+        return copy.deepcopy(self.table)
 
 
 @operator
-def _join_label_merge(
+def _tag_sides(
     step_id: str,
     *ups: KeyedStream[Any],
 ) -> KeyedStream[Tuple[int, Any]]:
-    labeled = []
-    for i, up in enumerate(ups):
-        labeled.append(
-            map_value(f"label_{i}", up, lambda v, _i=i: (_i, v))
-        )
-    return merge("merge", *labeled)
+    """Tag each upstream's values with their side index and merge."""
+    tagged = [
+        map_value(f"side_{i}", up, lambda v, _i=i: (_i, v))
+        for i, up in enumerate(ups)
+    ]
+    return merge("merge", *tagged)
 
 
 @operator
@@ -1305,18 +1357,19 @@ def join(
         raise ValueError(msg)
 
     side_count = len(sides)
+    policy = _EMIT_POLICIES[emit_mode]
 
     def shim_builder(
-        resume_state: Optional[_JoinState],
+        resume_state: Optional[_SideTable],
     ) -> _JoinLogic:
-        state = (
+        table = (
             resume_state
             if resume_state is not None
-            else _JoinState.for_side_count(side_count)
+            else _SideTable.empty(side_count)
         )
-        return _JoinLogic(insert_mode, emit_mode, state)
+        return _JoinLogic(insert_mode, policy, table)
 
-    merged = _join_label_merge("add_names", *sides)
+    merged = _tag_sides("tag", *sides)
     return stateful("join", merged, shim_builder)
 
 

@@ -48,7 +48,7 @@ from bytewax_tpu.operators import (
     StatefulBatchLogic,
     _get_system_utc,
     _identity,
-    _JoinState,
+    _SideTable,
     _untyped_none,
 )
 from bytewax_tpu.utils import partition
@@ -1378,58 +1378,38 @@ def count_window(
 
 
 @dataclass
-class _JoinWindowLogic(WindowLogic[Tuple[int, Any], Tuple, _JoinState]):
+class _JoinWindowLogic(WindowLogic[Tuple[int, Any], Tuple, _SideTable]):
     insert_mode: JoinInsertMode
     emit_mode: JoinEmitMode
-    state: _JoinState
+    table: _SideTable
 
-    def _check_emit(self) -> Iterable[Tuple]:
-        if self.emit_mode == "complete" and self.state.all_set():
-            rows = self.state.astuples()
-            self.state.clear()
+    def _after_change(self) -> Iterable[Tuple]:
+        if self.emit_mode == "complete" and self.table.complete():
+            rows = self.table.rows()
+            self.table.reset()
             return rows
         if self.emit_mode == "running":
-            return self.state.astuples()
+            return self.table.rows()
         return _EMPTY
 
     def on_value(self, value: Tuple[int, Any]) -> Iterable[Tuple]:
         side, side_value = value
-        if self.insert_mode == "first":
-            if not self.state.is_set(side):
-                self.state.set_val(side, side_value)
-        elif self.insert_mode == "last":
-            self.state.set_val(side, side_value)
-        else:
-            self.state.add_val(side, side_value)
-
-        return self._check_emit()
+        self.table.absorb(side, side_value, self.insert_mode)
+        return self._after_change()
 
     def on_merge(self, original: "_JoinWindowLogic") -> Iterable[Tuple]:
-        # Absorb the merged-away window's sides using the same algebra
-        # as the reference (windowing.py:1879-1890): "first" lets the
-        # absorbed window fill sides, "last" keeps this window's sides
-        # where set, "product" concatenates everything.
-        mine = self.state.seen
-        theirs = original.state.seen
-        if self.insert_mode == "first":
-            self.state.seen = [
-                t if t else m for m, t in zip(mine, theirs)
-            ]
-        elif self.insert_mode == "last":
-            self.state.seen = [
-                m if m else t for m, t in zip(mine, theirs)
-            ]
-        else:
-            self.state.seen = [m + t for m, t in zip(mine, theirs)]
-        return self._check_emit()
+        # Session-merge algebra matching the reference
+        # (windowing.py:1879-1890); see _SideTable.union.
+        self.table.union(original.table, self.insert_mode)
+        return self._after_change()
 
     def on_close(self) -> Iterable[Tuple]:
         if self.emit_mode == "final":
-            return self.state.astuples()
+            return self.table.rows()
         return _EMPTY
 
-    def snapshot(self) -> _JoinState:
-        return copy.deepcopy(self.state)
+    def snapshot(self) -> _SideTable:
+        return copy.deepcopy(self.table)
 
 
 @operator
@@ -1455,7 +1435,7 @@ def join_window(
         raise ValueError(msg)
 
     side_count = len(sides)
-    merged = op._join_label_merge("add_names", *sides)
+    merged = op._tag_sides("tag", *sides)
 
     # The merged stream carries (side, value) pairs; an EventClock
     # defined on bare values needs unwrapping.
@@ -1474,14 +1454,14 @@ def join_window(
         )
 
     def shim_builder(
-        resume_state: Optional[_JoinState],
+        resume_state: Optional[_SideTable],
     ) -> _JoinWindowLogic:
-        state = (
+        table = (
             resume_state
             if resume_state is not None
-            else _JoinState.for_side_count(side_count)
+            else _SideTable.empty(side_count)
         )
-        return _JoinWindowLogic(insert_mode, emit_mode, state)
+        return _JoinWindowLogic(insert_mode, emit_mode, table)
 
     return window(
         "window", merged, clock, windower, shim_builder, ordered=ordered

@@ -243,6 +243,9 @@ def _cluster_test_main() -> None:
     procs = []
     for proc_id in range(args.processes):
         env = dict(os.environ)
+        # The children must rebind the ports this parent is holding;
+        # production binds stay exclusive (see engine/comm.py).
+        env["BYTEWAX_TPU_REUSEPORT"] = "1"
         env["BYTEWAX_ADDRESSES"] = ";".join(addresses)
         env["BYTEWAX_PROCESS_ID"] = str(proc_id)
         if args.workers_per_process:
