@@ -219,6 +219,59 @@ def _run_windowing_columnar(
     return n_rows / dt
 
 
+def _run_windowing_session(n_rows: int, batch_rows: int) -> float:
+    """Session-windowed count on columnar batches (device gap-merge
+    scan): 2 keys, ~1 event/sec per key with a >gap jump every ~1000
+    events so sessions keep closing; returns events/sec."""
+    from datetime import timedelta
+
+    import numpy as np
+
+    import bytewax_tpu.operators as op
+    import bytewax_tpu.operators.windowing as w
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine.arrays import ArrayBatch
+    from bytewax_tpu.models.brc import ArrayBatchSource
+    from bytewax_tpu.models.windowing_bench import ALIGN_TO
+    from bytewax_tpu.operators.windowing import EventClock, SessionWindower
+    from bytewax_tpu.testing import TestingSink, run_main
+
+    rng = np.random.RandomState(42)
+    base = np.datetime64(ALIGN_TO.replace(tzinfo=None), "us")
+    # Mostly 1s steps with a 120s (> gap) jump every ~1000 rows.
+    steps = np.ones(n_rows, dtype=np.int64)
+    steps[rng.rand(n_rows) < 0.001] = 120
+    secs = np.cumsum(steps)
+    batches = []
+    for i in range(0, n_rows, batch_rows):
+        m = min(batch_rows, n_rows - i)
+        batches.append(
+            ArrayBatch(
+                {
+                    "key": rng.randint(0, 2, size=m).astype(str),
+                    "ts": base + secs[i : i + m].astype("timedelta64[s]"),
+                }
+            )
+        )
+    clock = EventClock(
+        ts_getter=lambda x: x, wait_for_system_duration=timedelta(0)
+    )
+    windower = SessionWindower(gap=timedelta(seconds=60))
+    out = []
+    flow = Dataflow("sessbench")
+    s = op.input("in", flow, ArrayBatchSource(batches))
+    wo = w.count_window("count", s, clock, windower, key=lambda x: x)
+    op.output("out", wo.down, TestingSink(out))
+    os.environ["BYTEWAX_TPU_ACCEL"] = "1"
+    try:
+        t0 = time.perf_counter()
+        run_main(flow)
+        dt = time.perf_counter() - t0
+    finally:
+        os.environ.pop("BYTEWAX_TPU_ACCEL", None)
+    return n_rows / dt
+
+
 def _run_window_close_p99(n_batches: int = 200, batch_size: int = 1000):
     """p99 window-close latency: wall time from the source emitting
     the batch whose events push the watermark past a window's close to
@@ -433,16 +486,28 @@ def main() -> None:
 
     win_ref = _run_windowing_host(100_000, 10)  # the reference shape
     win_accel_rows = int(os.environ.get("BENCH_WIN_ROWS", 4_000_000))
-    _run_windowing_columnar(1 << 18, 1 << 18, accel=True)  # warm
+    # Warm both key encodings at the timed batch shape so neither
+    # timed number pays the other's jit compiles.
+    _run_windowing_columnar(1 << 19, 1 << 19, accel=True)
+    _run_windowing_columnar(1 << 19, 1 << 19, accel=True, dict_keys=False)
     win_accel = max(
         _run_windowing_columnar(win_accel_rows, 1 << 19, accel=True)
         for _ in range(2)
     )
-    win_accel_str = _run_windowing_columnar(
-        min(win_accel_rows, 1 << 21), 1 << 19, accel=True, dict_keys=False
+    win_accel_str = max(
+        _run_windowing_columnar(
+            min(win_accel_rows, 1 << 21), 1 << 19, accel=True,
+            dict_keys=False,
+        )
+        for _ in range(2)
     )
     win_host = _run_windowing_columnar(
         min(win_accel_rows, 1 << 21), 1 << 19, accel=False
+    )
+    _run_windowing_session(1 << 19, 1 << 19)  # warm at the timed shape
+    win_session = max(
+        _run_windowing_session(min(win_accel_rows, 1 << 21), 1 << 19)
+        for _ in range(2)
     )
     p99_s, n_closes = _run_window_close_p99()
     wc_rate = _run_wordcount(50_000)
@@ -454,6 +519,7 @@ def main() -> None:
         "windowing_accel_strkeys_events_per_sec": round(win_accel_str),
         "windowing_host_events_per_sec": round(win_host),
         "windowing_accel_vs_host": round(win_accel / win_host, 2),
+        "windowing_session_events_per_sec": round(win_session),
         "window_close_p99_ms": (
             round(p99_s * 1e3, 3) if p99_s is not None else None
         ),

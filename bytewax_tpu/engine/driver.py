@@ -26,7 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from bytewax_tpu.dataflow import Dataflow, Operator
-from bytewax_tpu.engine.arrays import ArrayBatch
+from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
 from bytewax_tpu.engine.flatten import Plan, flatten
 from bytewax_tpu.engine.recovery_store import RecoveryStore, ResumeFrom
 from bytewax_tpu.engine.xla import AccelSpec, DeviceAggState, NonNumericValues
@@ -35,7 +35,10 @@ from bytewax_tpu.inputs import (
     DynamicSource,
     FixedPartitionedSource,
 )
-from bytewax_tpu.native import group_kv as _native_group_kv
+from bytewax_tpu.native import (
+    bucket_adler as _native_bucket_adler,
+    group_kv as _native_group_kv,
+)
 from bytewax_tpu.tracing import span as _span, spans_active as _spans_active
 from bytewax_tpu.outputs import DynamicSink, FixedPartitionedSink
 
@@ -51,6 +54,17 @@ def _route_hash(key: str) -> int:
     """Deterministic cross-process key hash (like the reference's use
     of a consistent hash for routing; builtin ``hash`` is salted)."""
     return zlib.adler32(key.encode("utf-8"))
+
+
+def _route_hashes_of(strs) -> np.ndarray:
+    """Vectorized ``_route_hash`` over an iterable of keys (hashes
+    only the iterable — callers hash unique keys / vocab entries, not
+    every row)."""
+    return np.fromiter(
+        (zlib.adler32(str(s).encode("utf-8")) for s in strs),
+        dtype=np.int64,
+        count=len(strs),
+    )
 
 
 def _now() -> datetime:
@@ -371,20 +385,48 @@ class _RedistributeRt(_OpRt):
     def process(self, port: str, entries: List[Entry]) -> None:
         driver = self.driver
         w_count = driver.worker_count
-        buckets: Dict[int, List[Any]] = {}
-        for _w, items in entries:
-            if isinstance(items, ArrayBatch):
-                items = items.to_pylist()
-            for item in items:
-                buckets.setdefault(self._rr % w_count, []).append(item)
-                self._rr += 1
         stream_id = self.op.downs["down"].stream_id
-        for w, items in buckets.items():
+
+        def dispatch(w: int, group: Any) -> None:
             if driver.is_local(w):
-                self.emit("down", (w, items))
+                self.emit("down", (w, group))
             else:
-                self._count_out(w, len(items))
-                driver.ship_route(stream_id, (w, items))
+                self._count_out(w, len(group))
+                driver.ship_route(stream_id, (w, group))
+
+        for _w, items in entries:
+            n = len(items)
+            if not n:
+                continue
+            start = self._rr
+            self._rr = (start + n) % w_count
+            if isinstance(items, ArrayBatch):
+                # Columnar rebalance: strided column views per lane —
+                # the batch stays columnar through the rebalance.
+                for w in range(w_count):
+                    off = (w - start) % w_count
+                    if off >= n:
+                        continue
+                    dispatch(
+                        w,
+                        ArrayBatch(
+                            {
+                                name: np.asarray(col)[off::w_count]
+                                for name, col in items.cols.items()
+                            },
+                            key_vocab=items.key_vocab,
+                            value_scale=items.value_scale,
+                        ),
+                    )
+                continue
+            # Item i of this delivery goes to lane (start + i) %
+            # w_count; one C-level slice per lane instead of a Python
+            # append per item.
+            for w in range(w_count):
+                off = (w - start) % w_count
+                if off >= n:
+                    continue
+                dispatch(w, items[off::w_count])
 
 
 class _InspectDebugRt(_OpRt):
@@ -418,6 +460,9 @@ class _StatefulBatchRt(_OpRt):
         self.logics: Dict[str, Any] = {}
         self.sched: Dict[str, datetime] = {}
         self.awoken: Set[str] = set()
+        # Cached per-vocab route hashes for columnar cluster splits.
+        self._vh_ref: Any = None
+        self._vh: Optional[np.ndarray] = None
         # Recognized aggregation shapes fold on device instead of in
         # per-key Python logics (annotated by the flatten-time
         # lowering pass; same snapshots, same EOF emission order).
@@ -425,10 +470,7 @@ class _StatefulBatchRt(_OpRt):
         self.wagg = None
         spec = op.conf.get("_accel")
         if driver.accel:
-            from bytewax_tpu.engine.window_accel import (
-                DeviceWindowAggState,
-                WindowAccelSpec,
-            )
+            from bytewax_tpu.engine.window_accel import WindowAccelSpec
 
             if isinstance(spec, AccelSpec):
                 from bytewax_tpu.engine.sharded_state import make_agg_state
@@ -437,7 +479,9 @@ class _StatefulBatchRt(_OpRt):
                 # device; single-device slot table otherwise.
                 self.agg = make_agg_state(spec.kind)
             elif isinstance(spec, WindowAccelSpec):
-                self.wagg = DeviceWindowAggState(spec)
+                # Sliding/tumbling or session device windower, per
+                # the spec subtype.
+                self.wagg = spec.make_state()
         resumed = {
             key: state
             for key, state in driver.resume_states(op.step_id).items()
@@ -502,11 +546,68 @@ class _StatefulBatchRt(_OpRt):
         for w, items in out.items():
             self.emit("down", (w, items))
 
+    def _batch_dests(
+        self, batch: ArrayBatch, w_count: int
+    ) -> Optional[np.ndarray]:
+        """Per-row home worker of a columnar batch, computed with one
+        table lookup (hashes touch unique keys / vocab entries only);
+        None when the batch has no key column to route on."""
+        if "key_id" in batch.cols and batch.key_vocab is not None:
+            vocab = batch.key_vocab
+            # Identity AND length: a list vocab grown in place keeps
+            # its identity (VocabMap deliberately tolerates that), so
+            # the hash cache must refresh when the length moves.
+            if vocab is not self._vh_ref or len(vocab) != len(self._vh):
+                arr = np.asarray(vocab)
+                self._vh = _route_hashes_of(arr.tolist())
+                self._vh_ref = vocab
+            ids = batch.numpy("key_id")
+            return (self._vh % w_count)[ids]
+        if "key" in batch.cols:
+            keys = batch.numpy("key")
+            inverse, uniq = factorize_keys(keys)
+            return (_route_hashes_of(uniq.tolist()) % w_count)[inverse]
+        return None
+
+    def _split_remote_columnar(
+        self, w: int, batch: ArrayBatch, local: List[Entry]
+    ) -> bool:
+        """Split one columnar delivery by destination process, keeping
+        every piece columnar (the device fast path survives the
+        cluster exchange); False when the batch can't be routed
+        columnar and must degrade to items."""
+        driver = self.driver
+        dests = self._batch_dests(batch, driver.worker_count)
+        if dests is None:
+            return False
+        local_mask = (dests >= driver.local_lo) & (dests < driver.local_hi)
+        if local_mask.all():
+            local.append((w, batch))
+            return True
+
+        def sub(mask: np.ndarray) -> ArrayBatch:
+            return ArrayBatch(
+                {name: np.asarray(col)[mask] for name, col in batch.cols.items()},
+                key_vocab=batch.key_vocab,
+                value_scale=batch.value_scale,
+            )
+
+        if local_mask.any():
+            local.append((w, sub(local_mask)))
+        remote_procs = np.unique(dests[~local_mask] // driver.wpp)
+        for proc in remote_procs.tolist():
+            lo = proc * driver.wpp
+            mask = (dests >= lo) & (dests < lo + driver.wpp)
+            driver.ship_deliver(self.idx, "up", (lo, sub(mask)))
+        return True
+
     def _split_remote(self, entries: List[Entry]) -> List[Entry]:
         """In a cluster, re-group each delivery's rows by the home
         worker of their key and ship non-local groups to their owner
         (the reference's routed_exchange, src/timely.rs:806-812);
-        returns the locally-owned remainder."""
+        returns the locally-owned remainder.  Columnar batches split
+        columnar (vectorized destinations, one sub-batch per process);
+        item lists bucket in one native pass when available."""
         driver = self.driver
         if driver.comm is None:
             return entries
@@ -514,12 +615,29 @@ class _StatefulBatchRt(_OpRt):
         local: List[Entry] = []
         for _w, items in entries:
             if isinstance(items, ArrayBatch):
+                if self._split_remote_columnar(_w, items, local):
+                    continue
                 items = items.to_pylist()
-            buckets: Dict[int, List[Any]] = {}
-            for item in items:
-                k, _v = _extract_kv(item, self.op.step_id)
-                buckets.setdefault(_route_hash(k) % w_count, []).append(item)
-            for w, group in buckets.items():
+            buckets: Optional[List[List[Any]]] = None
+            if type(items) is list:
+                try:
+                    buckets = _native_bucket_adler(items, w_count)
+                except TypeError:
+                    # Rows that are not exact str-keyed 2-tuples take
+                    # the general loop below for its permissive
+                    # unpacking and step-qualified errors.
+                    buckets = None
+            if buckets is None:
+                by_w: Dict[int, List[Any]] = {}
+                for item in items:
+                    k, _v = _extract_kv(item, self.op.step_id)
+                    by_w.setdefault(
+                        _route_hash(k) % w_count, []
+                    ).append(item)
+                buckets = [by_w.get(w, []) for w in range(w_count)]
+            for w, group in enumerate(buckets):
+                if not group:
+                    continue
                 if driver.is_local(w):
                     local.append((w, group))
                 else:
@@ -774,6 +892,14 @@ class _OutputRt(_OpRt):
                 msg = f"sink of step {op.step_id!r} has no partitions"
                 raise ValueError(msg)
             self.part_fn = sink.part_fn
+            # The default part_fn is adler32-of-key, which the native
+            # bucketer computes in one pass over the whole delivery —
+            # the reference flags this exact per-item exchange closure
+            # as a hot spot (src/outputs.rs:189-198).
+            self._default_part_fn = (
+                getattr(type(sink), "part_fn", None)
+                is FixedPartitionedSink.part_fn
+            )
             self.part_owner = {
                 name: i % driver.worker_count
                 for i, name in enumerate(self.part_names)
@@ -813,20 +939,40 @@ class _OutputRt(_OpRt):
                     items = items.to_pylist()
                 buckets: Dict[str, List[Any]] = {}
                 ship: Dict[int, List[Any]] = {}
-                for item in items:
-                    k, v = _extract_kv(item, self.op.step_id)
+                groups: Optional[List[List[Any]]] = None
+                if self._default_part_fn and type(items) is list:
                     try:
-                        idx = self.part_fn(k) % count
-                    except BaseException as ex:  # noqa: BLE001
-                        _reraise(self.op.step_id, "`part_fn`", ex)
-                    name = self.part_names[idx]
-                    owner = self.part_owner[name]
-                    if driver.is_local(owner):
-                        buckets.setdefault(name, []).append(v)
-                    else:
-                        # Ship the original (key, value) item to the
-                        # partition's owner; it re-runs part_fn there.
-                        ship.setdefault(owner, []).append(item)
+                        # One native pass replaces a part_fn call per
+                        # item for the default adler32 routing.
+                        groups = _native_bucket_adler(items, count)
+                    except TypeError:
+                        groups = None
+                if groups is not None:
+                    for idx, group in enumerate(groups):
+                        if not group:
+                            continue
+                        name = self.part_names[idx]
+                        owner = self.part_owner[name]
+                        if driver.is_local(owner):
+                            buckets[name] = [item[1] for item in group]
+                        else:
+                            ship.setdefault(owner, []).extend(group)
+                else:
+                    for item in items:
+                        k, v = _extract_kv(item, self.op.step_id)
+                        try:
+                            idx = self.part_fn(k) % count
+                        except BaseException as ex:  # noqa: BLE001
+                            _reraise(self.op.step_id, "`part_fn`", ex)
+                        name = self.part_names[idx]
+                        owner = self.part_owner[name]
+                        if driver.is_local(owner):
+                            buckets.setdefault(name, []).append(v)
+                        else:
+                            # Ship the original (key, value) item to
+                            # the partition's owner; it re-runs
+                            # part_fn there.
+                            ship.setdefault(owner, []).append(item)
                 for owner, group in ship.items():
                     driver.ship_deliver(self.idx, "up", (owner, group))
                 for name, values in buckets.items():

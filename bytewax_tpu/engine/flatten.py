@@ -54,13 +54,19 @@ def _window_accel_spec(op: Operator):
     carrying explicit ``key``/``ts``/``value`` columns run on device
     — itemized deliveries can't statically promise numeric,
     timestamp-bearing values, so the runtime falls back to the host
-    tier on first contact with them.  Sessions and custom/fake clocks
-    always stay host-side.
+    tier on first contact with them.  Session windows lower too
+    (key-local gap-merge scan, ``SessionAccelSpec``) when the
+    merger is the kind's own combine; custom/fake clocks always
+    stay host-side.
     """
-    from bytewax_tpu.engine.window_accel import WindowAccelSpec
+    from bytewax_tpu.engine.window_accel import (
+        SessionAccelSpec,
+        WindowAccelSpec,
+    )
     from bytewax_tpu.operators import _get_system_utc, _identity
     from bytewax_tpu.operators.windowing import (
         EventClock,
+        SessionWindower,
         SlidingWindower,
         TumblingWindower,
     )
@@ -130,6 +136,31 @@ def _window_accel_spec(op: Operator):
         length, offset = windower.length, windower.length
     elif isinstance(windower, SlidingWindower):
         length, offset = windower.length, windower.offset
+    elif isinstance(windower, SessionWindower):
+        # Sessions merge, so the device tier's slot-set combine must
+        # be the kind's own merge: require the operator's merger to
+        # be the marked reducer/fold's combine (count_window's merge
+        # is addition by construction).
+        merger = op.conf.get("merger")
+        if op.name == "fold_window":
+            from bytewax_tpu.xla import WindowFold
+
+            if isinstance(folder, WindowFold):
+                if merger is not folder.merge:
+                    return None
+            elif merger is not folder:
+                return None
+        elif op.name == "reduce_window" and merger not in (
+            None,
+            op.conf.get("reducer"),
+        ):
+            return None
+        return SessionAccelSpec(
+            kind,
+            clock.ts_getter,
+            windower.gap,
+            clock.wait_for_system_duration,
+        )
     else:
         return None
     return WindowAccelSpec(

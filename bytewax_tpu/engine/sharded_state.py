@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from bytewax_tpu.engine.arrays import ArrayBatch, VocabMap
+from bytewax_tpu.engine.arrays import ArrayBatch, KeyEncoder, VocabMap
 from bytewax_tpu.engine.xla import (
     DeviceAggState,
     NonNumericValues,
@@ -130,6 +130,10 @@ class ShardedAggState:
         self._steps: Dict[Tuple[int, int, int, Any], Any] = {}
         # Dictionary-encoded fast path: external id -> wire key id.
         self._vocab = VocabMap(dtype=np.int32)
+        # Automatic encoder for plain string key columns plus the
+        # kid -> key reverse map it needs for touched-key reporting.
+        self._enc = KeyEncoder()
+        self._kid_key: Dict[int, str] = {}
 
     # -- key placement -----------------------------------------------------
 
@@ -152,6 +156,7 @@ class ShardedAggState:
             self._shard_fill[shard] += 1
         kid = slot * self.n_shards + shard
         self.key_to_kid[key] = kid
+        self._kid_key[kid] = key
         return kid
 
     def discard(self, key: str) -> None:
@@ -159,6 +164,8 @@ class ShardedAggState:
         if kid is not None:
             shard, slot = kid % self.n_shards, kid // self.n_shards
             self._free[shard].append(slot)
+            self._kid_key.pop(kid, None)
+            self._enc.drop(key)
 
     def _global_idx(self, kid: int) -> int:
         shard, slot = kid % self.n_shards, kid // self.n_shards
@@ -254,16 +261,25 @@ class ShardedAggState:
                 values = values.astype(np.int32)
             if self._fields is None:
                 self.dtype = jnp.int32
-        elif self.dtype == jnp.int32:
+        elif self.dtype == jnp.int32 and len(values):
             # Mirrors the value_scale guard: a float batch after the
             # accumulator locked to int32 would otherwise be silently
             # truncated by the host-side cast into the int32 carrier.
-            msg = (
-                "float values arrived after earlier batches locked "
-                "this step's device state to an integer dtype; pass a "
-                "plain Python reducer for mixed int/float streams"
-            )
-            raise TypeError(msg)
+            # Integral in-range floats (e.g. the count path's ones
+            # after resuming an int snapshot) cast losslessly and
+            # pass through.
+            if (
+                np.any(values % 1)
+                or values.max() > np.iinfo(np.int32).max
+                or values.min() < np.iinfo(np.int32).min
+            ):
+                msg = (
+                    "non-integral float values arrived after earlier "
+                    "batches locked this step's device state to an "
+                    "integer dtype; pass a plain Python reducer for "
+                    "mixed int/float streams"
+                )
+                raise TypeError(msg)
         return values
 
     # -- updates -------------------------------------------------------------
@@ -326,12 +342,11 @@ class ShardedAggState:
             )
             raise NonNumericValues(msg)
         values = self._pick_dtype(values)
-        uniq, inverse = np.unique(keys, return_inverse=True)
-        kid_of_uniq = np.empty(len(uniq), dtype=np.int32)
-        for j, k in enumerate(uniq):
-            kid_of_uniq[j] = self.alloc(str(k))
-        self._dispatch(kid_of_uniq[inverse], values)
-        return [str(k) for k in uniq]
+        kids = self._enc.encode(
+            keys, lambda ks: [self.alloc(k) for k in ks]
+        )
+        self._dispatch(kids.astype(np.int32, copy=False), values)
+        return [self._kid_key[k] for k in np.unique(kids).tolist()]
 
     def _sync_vocab(self, ids: np.ndarray, vocab: np.ndarray) -> np.ndarray:
         """Assign wire ids for newly-seen external vocabulary ids;
@@ -452,6 +467,8 @@ class ShardedAggState:
         self._free = [[] for _ in range(self.n_shards)]
         self._fields = None
         self._vocab = VocabMap(dtype=np.int32)
+        self._enc.clear()
+        self._kid_key.clear()
         return out
 
     def keys(self) -> List[str]:

@@ -23,7 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from bytewax_tpu.engine.arrays import VocabMap
+from bytewax_tpu.engine.arrays import KeyEncoder, VocabMap
 
 __all__ = ["DeviceWindowAggState", "WindowAccelSpec"]
 
@@ -65,8 +65,40 @@ class WindowAccelSpec:
         self.offset_us = offset.total_seconds() * _US
         self.wait_us = wait.total_seconds() * _US
 
+    def make_state(self) -> "DeviceWindowAggState":
+        return DeviceWindowAggState(self)
+
     def __repr__(self) -> str:
         return f"WindowAccelSpec({self.kind!r})"
+
+
+class SessionAccelSpec(WindowAccelSpec):
+    """Flatten-time annotation: lower this session-windowed fold to
+    device (gap-merged sessions, reference semantics:
+    ``/root/reference/pysrc/bytewax/operators/windowing.py:688-806``)."""
+
+    def __init__(
+        self,
+        kind: str,
+        ts_getter: Callable[[Any], datetime],
+        gap: timedelta,
+        wait: timedelta,
+    ):
+        self.kind = kind
+        self.ts_getter = ts_getter
+        self.gap_us = gap.total_seconds() * _US
+        self.wait_us = wait.total_seconds() * _US
+        # Unused sliding fields (the base __init__ computes its
+        # static expansion factor from them).
+        self.align_us = 0.0
+        self.length_us = 1.0
+        self.offset_us = 1.0
+
+    def make_state(self) -> "DeviceSessionAggState":
+        return DeviceSessionAggState(self)
+
+    def __repr__(self) -> str:
+        return f"SessionAccelSpec({self.kind!r})"
 
 
 class DeviceWindowAggState:
@@ -104,6 +136,8 @@ class DeviceWindowAggState:
         self._open_cache = None
         # Dictionary-encoded fast path: external id -> internal kid.
         self._vocab = VocabMap(dtype=np.int64)
+        # Automatic encoder for plain string key columns.
+        self._enc = KeyEncoder()
 
     # -- clock -------------------------------------------------------------
 
@@ -151,10 +185,9 @@ class DeviceWindowAggState:
                 batch.numpy("key_id").astype(np.int64), batch.key_vocab
             )
         else:
-            keys_col = batch.numpy("key")
-            uniq_keys, inverse = np.unique(keys_col, return_inverse=True)
-            kid_of_uniq = self._key_ids_for([str(k) for k in uniq_keys])
-            kids = kid_of_uniq[inverse]
+            kids = self._enc.encode(
+                batch.numpy("key"), self._key_ids_for
+            )
         ts_col = batch.numpy("ts")
         if np.issubdtype(ts_col.dtype, np.datetime64):
             ts_us = ts_col.astype("datetime64[us]").astype(np.int64).astype(
@@ -209,44 +242,61 @@ class DeviceWindowAggState:
         kids_sorted = kids[order]
         eff_sorted = eff[order]
         seg_kids, seg_starts = np.unique(kids_sorted, return_index=True)
-        seg_ends = np.append(seg_starts[1:], n)
-        wm_sorted = np.empty(n, dtype=np.float64)
-        for kid, lo, hi in zip(
-            seg_kids.tolist(), seg_starts.tolist(), seg_ends.tolist()
-        ):
-            carry = self.base_us[kid] + (now_us - self.sys_at_base[kid])
-            prefix = np.maximum.accumulate(eff_sorted[lo:hi])
-            np.maximum(prefix, carry, out=wm_sorted[lo:hi])
-            new_base = prefix[-1]
-            if new_base > self.base_us[kid]:
-                self.base_us[kid] = new_base
-                self.sys_at_base[kid] = now_us
+        seg_counts = np.diff(np.append(seg_starts, n))
+        n_seg = len(seg_kids)
+        carry = self.base_us[seg_kids] + (now_us - self.sys_at_base[seg_kids])
+
+        # Segmented prefix max with no per-key Python: shift each
+        # key's rows into its own disjoint value band (band width >
+        # the value span), run ONE global cummax — later bands
+        # dominate earlier ones, so the running max never leaks
+        # across segments — and shift back.  Exact only in integer
+        # arithmetic below 2^53, which the hot columnar path
+        # (datetime64[us] timestamps) always is; fractional
+        # microseconds or astronomically-spread batches take the
+        # per-segment loop so watermark equality stays bit-exact.
+        lo_val = float(eff_sorted.min()) if n else 0.0
+        band = float(eff_sorted.max()) - lo_val + 1.0 if n else 1.0
+        integral = n == 0 or (
+            band == np.floor(band)
+            and not np.any(eff_sorted % 1.0)
+        )
+        if integral and n_seg * band < float(1 << 53):
+            seg_of_row = np.repeat(
+                np.arange(n_seg, dtype=np.int64), seg_counts
+            )
+            off = seg_of_row * band
+            prefix = (
+                np.maximum.accumulate((eff_sorted - lo_val) + off) - off
+            ) + lo_val
+            wm_sorted = np.maximum(prefix, carry[seg_of_row])
+            seg_max = np.maximum.reduceat(eff_sorted, seg_starts)
+        else:
+            seg_ends = np.append(seg_starts[1:], n)
+            wm_sorted = np.empty(n, dtype=np.float64)
+            seg_max = np.empty(n_seg, dtype=np.float64)
+            for j, (lo, hi) in enumerate(
+                zip(seg_starts.tolist(), seg_ends.tolist())
+            ):
+                prefix = np.maximum.accumulate(eff_sorted[lo:hi])
+                np.maximum(prefix, carry[j], out=wm_sorted[lo:hi])
+                seg_max[j] = prefix[-1]
+        advanced = seg_max > self.base_us[seg_kids]
+        if advanced.any():
+            moved = seg_kids[advanced]
+            self.base_us[moved] = seg_max[advanced]
+            self.sys_at_base[moved] = now_us
         wm_rows = np.empty(n, dtype=np.float64)
         wm_rows[order] = wm_sorted
         late_mask = ts_us < wm_rows
 
         events: List[Tuple[str, Tuple[int, str, Any]]] = []
         if late_mask.any():
-            late_rows = np.nonzero(late_mask)[0]
-            wid_hi = np.floor(
-                (ts_us[late_rows] - spec.align_us) / spec.offset_us
-            ).astype(np.int64)
-            for i, row in zip(range(len(late_rows)), late_rows):
-                key = self.keys[int(kids[row])]
-                ts_row = ts_us[row]
-                for wid in range(
-                    int(wid_hi[i]) - self.expand + 1, int(wid_hi[i]) + 1
-                ):
-                    # Same in-window bound as the on-time path; for
-                    # offsets that don't divide length, not every wid
-                    # in the static range contains the timestamp.
-                    if (
-                        ts_row
-                        < spec.align_us
-                        + wid * spec.offset_us
-                        + spec.length_us
-                    ):
-                        events.append((key, (wid, "L", values[row])))
+            events.extend(
+                self._late_events(
+                    np.nonzero(late_mask)[0], kids, ts_us, values
+                )
+            )
 
         ok = ~late_mask
         if ok.any():
@@ -256,10 +306,44 @@ class DeviceWindowAggState:
                 vals_ok = np.ones(int(ok.sum()), dtype=np.float64)
             else:
                 vals_ok = np.asarray(values)[ok]  # keep dtype for exact ints
-            self._fold_rows(kids_ok, ts_ok, vals_ok)
+            self._absorb(kids_ok, ts_ok, vals_ok)
 
         events.extend(self._close_due(now_us))
         return events
+
+    def _late_events(
+        self, late_rows: np.ndarray, kids: np.ndarray, ts_us: np.ndarray, values
+    ) -> List[Tuple[str, Tuple[int, str, Any]]]:
+        """Window-id attribution for late rows (sliding arithmetic;
+        the session subclass reports the late-session sentinel)."""
+        spec = self.spec
+        events = []
+        wid_hi = np.floor(
+            (ts_us[late_rows] - spec.align_us) / spec.offset_us
+        ).astype(np.int64)
+        for i, row in zip(range(len(late_rows)), late_rows):
+            key = self.keys[int(kids[row])]
+            ts_row = ts_us[row]
+            for wid in range(
+                int(wid_hi[i]) - self.expand + 1, int(wid_hi[i]) + 1
+            ):
+                # Same in-window bound as the on-time path; for
+                # offsets that don't divide length, not every wid
+                # in the static range contains the timestamp.
+                if (
+                    ts_row
+                    < spec.align_us
+                    + wid * spec.offset_us
+                    + spec.length_us
+                ):
+                    events.append((key, (wid, "L", values[row])))
+        return events
+
+    def _absorb(
+        self, kids_ok: np.ndarray, ts_ok: np.ndarray, vals_ok: np.ndarray
+    ) -> None:
+        """Route on-time rows into windows and fold them on device."""
+        self._fold_rows(kids_ok, ts_ok, vals_ok)
 
     def _fold_rows(
         self, kids_ok: np.ndarray, ts_ok: np.ndarray, vals_ok: np.ndarray
@@ -460,37 +544,353 @@ class DeviceWindowAggState:
             )
         return out
 
-    def load(self, key: str, snap: Any) -> None:
-        """Resume from a host-tier ``_WindowSnapshot``."""
-        kids = self._key_ids_for([key])
-        kid = int(kids[0])
+    def _load_clock(self, kid: int, snap: Any) -> None:
         cs = snap.clock_state
         if cs is not None:
             self.base_us[kid] = _to_us(cs.watermark_base)
             self.sys_at_base[kid] = _to_us(cs.system_time_of_max_event)
+
+    def _replay_queue(self, kid: int, snap: Any) -> None:
+        """A host-tier ordered=True logic keeps on-time values whose
+        ts is still ahead of the watermark in ``queue``, to apply in
+        timestamp order once due.  The device tier folds eagerly (its
+        folds are commutative), so replay them into their windows now
+        — the host never late-drops queued entries, so neither do we.
+        Window closes happen on the next batch / notify via the
+        restored watermark base."""
+        queue = getattr(snap, "queue", None)
+        if not queue:
+            return
+        ts_q = np.fromiter(
+            (_to_us(ts) for _v, ts in queue),
+            dtype=np.float64,
+            count=len(queue),
+        )
+        if self.spec.kind == "count":
+            vals_q = np.ones(len(queue), dtype=np.float64)
+        else:
+            vals_q = np.asarray([v for v, _ts in queue])
+        self._absorb(
+            np.full(len(queue), kid, dtype=np.int64), ts_q, vals_q
+        )
+
+    def load(self, key: str, snap: Any) -> None:
+        """Resume from a host-tier ``_WindowSnapshot``."""
+        kids = self._key_ids_for([key])
+        kid = int(kids[0])
+        self._load_clock(kid, snap)
         for wid, meta in snap.windower_state.opened.items():
             self.open_close_us[(kid, wid)] = _to_us(meta.close_time)
         self._open_cache = None
         for wid, state in snap.logic_states.items():
             self.agg.load(f"{key}\x00{wid}", state)
-        # A host-tier ordered=True logic keeps on-time values whose ts
-        # is still ahead of the watermark in `queue`, to apply in
-        # timestamp order once due.  The device tier folds eagerly
-        # (its folds are commutative), so replay them into their
-        # windows now — the host never late-drops queued entries, so
-        # neither do we.  Window closes happen on the next batch /
-        # notify via the restored watermark base.
-        queue = getattr(snap, "queue", None)
-        if queue:
-            ts_q = np.fromiter(
-                (_to_us(ts) for _v, ts in queue),
-                dtype=np.float64,
-                count=len(queue),
+        self._replay_queue(kid, snap)
+
+
+class DeviceSessionAggState(DeviceWindowAggState):
+    """Session windows on the device tier: key-local gap merges.
+
+    The heavy per-row work stays vectorized/on-device: rows are
+    lexsorted by (key, timestamp), contiguous runs (consecutive
+    timestamps within ``gap``) are found with one vectorized diff,
+    each run folds into ONE device slot via the same scatter-combine
+    as sliding windows, and only per-RUN work (session create /
+    extend / gap-merge bookkeeping, ``WindowMetadata.merged_ids``)
+    runs in host Python — O(runs + open sessions), not O(rows).
+
+    A session's accumulator is the combine of its slot set; merging
+    two sessions is list concatenation (no device roundtrip), and
+    the combine happens host-side at close/snapshot over a handful
+    of scalars.
+
+    Documented deviations from the host tier (cosmetic — the merged
+    intervals, membership, and values are identical):
+
+    - New session ids are assigned in timestamp order within each
+      delivered batch; the host tier assigns in arrival order.
+    - A merge's surviving id is the earliest-open pre-merge session;
+      the host tier's can differ when a single value extends several
+      sessions downward at once.
+
+    Reference session semantics:
+    ``/root/reference/pysrc/bytewax/operators/windowing.py:688-806``.
+    """
+
+    def __init__(self, spec: SessionAccelSpec):
+        super().__init__(spec)
+        #: kid -> wid -> [open_us, close_us, merged_ids set]
+        self.sessions: Dict[int, Dict[int, list]] = {}
+        #: kid -> next session id (never reset: session ids must not
+        #: be reused, matching the host windower's never-empty state)
+        self.next_wid: Dict[int, int] = {}
+        #: (kid, wid) -> device slot keys whose combine is the
+        #: session's accumulator
+        self.session_slots: Dict[Tuple[int, int], List[str]] = {}
+        self._slot_seq = 0
+        # For sessions, ``open_close_us`` holds each session's DUE
+        # time (close + gap) so the base class's vectorized due scan
+        # and ``notify_at`` apply unchanged; emission recovers the
+        # close time by subtracting the gap.
+
+    # -- session bookkeeping (per run, host Python) ------------------------
+
+    def _place_run(self, kid: int, lo_us: float, hi_us: float) -> int:
+        """Create/extend/merge sessions for one run of rows; returns
+        the session id the run folds into."""
+        gap = self.spec.gap_us
+        sess = self.sessions.setdefault(kid, {})
+        overlapping = [
+            wid
+            for wid, s in sess.items()
+            if not (hi_us < s[0] - gap or lo_us > s[1] + gap)
+        ]
+        if not overlapping:
+            wid = self.next_wid.get(kid, 0)
+            self.next_wid[kid] = wid + 1
+            sess[wid] = [lo_us, hi_us, set()]
+            self.session_slots[(kid, wid)] = []
+            self.open_close_us[(kid, wid)] = hi_us + gap
+            self._open_cache = None
+            return wid
+        winner = min(overlapping, key=lambda w: sess[w][0])
+        s = sess[winner]
+        s[0] = min(s[0], lo_us)
+        s[1] = max(s[1], hi_us)
+        for other in overlapping:
+            if other == winner:
+                continue
+            o = sess.pop(other)
+            s[0] = min(s[0], o[0])
+            s[1] = max(s[1], o[1])
+            # The host records only the absorbed window's id (its own
+            # merged_ids are dropped): windowing.py _merge_overlapping.
+            s[2].add(other)
+            self.session_slots[(kid, winner)].extend(
+                self.session_slots.pop((kid, other))
             )
-            if self.spec.kind == "count":
-                vals_q = np.ones(len(queue), dtype=np.float64)
+            del self.open_close_us[(kid, other)]
+        self.open_close_us[(kid, winner)] = s[1] + gap
+        self._open_cache = None
+        return winner
+
+    # -- hook overrides -----------------------------------------------------
+
+    def _late_events(
+        self, late_rows: np.ndarray, kids: np.ndarray, ts_us: np.ndarray, values
+    ) -> List[Tuple[str, Tuple[int, str, Any]]]:
+        # Session membership depends on other values, so a late value
+        # can't name a specific session (host: late_for -> sentinel).
+        from bytewax_tpu.operators.windowing import LATE_SESSION_ID
+
+        return [
+            (
+                self.keys[int(kids[row])],
+                (LATE_SESSION_ID, "L", values[row]),
+            )
+            for row in late_rows
+        ]
+
+    def _absorb(
+        self, kids_ok: np.ndarray, ts_ok: np.ndarray, vals_ok: np.ndarray
+    ) -> None:
+        n = len(ts_ok)
+        if not n:
+            return
+        order = np.lexsort((ts_ok, kids_ok))
+        k = kids_ok[order]
+        t = ts_ok[order]
+        v = np.asarray(vals_ok)[order]
+        # Runs: maximal (key, ts-sorted) stretches with consecutive
+        # gaps <= gap.  Runs are disjoint and processed in ts order
+        # per key, so a run that bridges two existing sessions via
+        # transitive extension is handled by _place_run seeing the
+        # already-extended interval.
+        new_run = np.empty(n, dtype=bool)
+        new_run[0] = True
+        np.logical_or(
+            k[1:] != k[:-1],
+            (t[1:] - t[:-1]) > self.spec.gap_us,
+            out=new_run[1:],
+        )
+        run_of_row = np.cumsum(new_run) - 1
+        starts = np.nonzero(new_run)[0]
+        ends = np.append(starts[1:], n) - 1
+        slot_of_run = np.empty(len(starts), dtype=np.int32)
+        for r in range(len(starts)):
+            kid = int(k[starts[r]])
+            wid = self._place_run(kid, float(t[starts[r]]), float(t[ends[r]]))
+            # Fold into the session's existing slot when it has one:
+            # a continuously-active session must stay O(1) state, not
+            # accumulate a slot per batch.  (Extra slots only ever
+            # come from merges, which concatenate lists.)
+            slots = self.session_slots[(kid, wid)]
+            if slots:
+                slot_key = slots[0]
             else:
-                vals_q = np.asarray([v for v, _ts in queue])
-            self._fold_rows(
-                np.full(len(queue), kid, dtype=np.int64), ts_q, vals_q
+                slot_key = f"{self.keys[kid]}\x00{wid}\x00{self._slot_seq}"
+                self._slot_seq += 1
+                slots.append(slot_key)
+            slot_of_run[r] = self.agg.alloc(slot_key)
+        self.agg.update_ids(slot_of_run[run_of_row], v)
+
+    def _combine(self, snaps: List[Any]) -> Any:
+        """Combine slot accumulators host-side (kind algebra over a
+        handful of scalars)."""
+        kind = self.spec.kind
+        snaps = [s for s in snaps if s is not None]
+        if not snaps:
+            return None
+        acc = snaps[0]
+        for s in snaps[1:]:
+            if kind in ("sum", "count"):
+                acc = acc + s
+            elif kind == "min":
+                acc = min(acc, s)
+            elif kind == "max":
+                acc = max(acc, s)
+            elif kind == "mean":
+                acc = (acc[0] + s[0], acc[1] + s[1])
+            else:  # stats
+                acc = (
+                    min(acc[0], s[0]),
+                    max(acc[1], s[1]),
+                    acc[2] + s[2],
+                    acc[3] + s[3],
+                )
+        return acc
+
+    def _session_acc(self, kid: int, wid: int, discard: bool) -> Any:
+        slot_keys = self.session_slots[(kid, wid)]
+        acc = self._combine(
+            [s for _k, s in self.agg.snapshots_for(slot_keys)]
+        )
+        if discard:
+            for sk in slot_keys:
+                self.agg.discard(sk)
+            del self.session_slots[(kid, wid)]
+        return acc
+
+    def _close_due(self, now_us: float) -> List[Tuple[str, Tuple[int, str, Any]]]:
+        if not self.open_close_us:
+            return []
+        kids_arr, wids_arr, dues_arr = self._open_arrays()
+        wm = self.base_us[kids_arr] + (now_us - self.sys_at_base[kids_arr])
+        # Strict: a session closes when the watermark passes close +
+        # gap (host: close_time < watermark - gap), not at equality.
+        due_rows = np.nonzero(dues_arr < wm)[0]
+        if not len(due_rows):
+            return []
+        from bytewax_tpu.operators.windowing import WindowMetadata
+
+        events = []
+        for i in due_rows:
+            kid, wid = int(kids_arr[i]), int(wids_arr[i])
+            key = self.keys[kid]
+            acc = self._session_acc(kid, wid, discard=True)
+            s = self.sessions[kid].pop(wid)
+            del self.open_close_us[(kid, wid)]
+            events.append((key, (wid, "E", self._finalize_one(acc))))
+            meta = WindowMetadata(
+                datetime.fromtimestamp(s[0] / _US, tz=timezone.utc),
+                datetime.fromtimestamp(s[1] / _US, tz=timezone.utc),
+                set(s[2]),
             )
+            events.append((key, (wid, "M", meta)))
+        self._open_cache = None
+        return events
+
+    # -- recovery -----------------------------------------------------------
+
+    def snapshots_for(self, keys: List[str]):
+        """Host-tier ``_WindowSnapshot``-compatible snapshots with
+        session windower state.  Session state is never discarded
+        once a key exists (ids must not be reused — host parity)."""
+        from bytewax_tpu.operators.windowing import (
+            WindowMetadata,
+            _EventClockState,
+            _SessionWindowerState,
+            _WindowSnapshot,
+        )
+
+        out = []
+        for key in keys:
+            kid = self.key_ids.get(key)
+            if kid is None:
+                out.append((key, None))
+                continue
+            sess = self.sessions.get(kid, {})
+            metas = {
+                wid: WindowMetadata(
+                    datetime.fromtimestamp(s[0] / _US, tz=timezone.utc),
+                    datetime.fromtimestamp(s[1] / _US, tz=timezone.utc),
+                    set(s[2]),
+                )
+                for wid, s in sess.items()
+            }
+            states = {
+                wid: self._session_acc(kid, wid, discard=False)
+                for wid in sess
+            }
+            base = self.base_us[kid]
+            clock_state = _EventClockState(
+                system_time_of_max_event=datetime.fromtimestamp(
+                    self.sys_at_base[kid] / _US, tz=timezone.utc
+                ),
+                watermark_base=(
+                    datetime.fromtimestamp(base / _US, tz=timezone.utc)
+                    if np.isfinite(base)
+                    else datetime.min.replace(tzinfo=timezone.utc)
+                ),
+            )
+            out.append(
+                (
+                    key,
+                    _WindowSnapshot(
+                        clock_state,
+                        _SessionWindowerState(
+                            next_id=self.next_wid.get(kid, 0),
+                            sessions=metas,
+                            merge_queue=[],
+                        ),
+                        states,
+                        [],
+                    ),
+                )
+            )
+        return out
+
+    def load(self, key: str, snap: Any) -> None:
+        """Resume from a host-tier session ``_WindowSnapshot``."""
+        kid = int(self._key_ids_for([key])[0])
+        self._load_clock(kid, snap)
+        st = snap.windower_state
+        self.next_wid[kid] = st.next_id
+        sess = self.sessions.setdefault(kid, {})
+        gap = self.spec.gap_us
+        for wid, meta in st.sessions.items():
+            sess[wid] = [
+                _to_us(meta.open_time),
+                _to_us(meta.close_time),
+                set(meta.merged_ids),
+            ]
+            self.session_slots[(kid, wid)] = []
+            self.open_close_us[(kid, wid)] = _to_us(meta.close_time) + gap
+        self._open_cache = None
+        # A snapshot taken between a windower merge and the logic
+        # merge has the sessions dict merged but logic states still
+        # split per pre-merge id; resolve each state to its surviving
+        # session (chasing chained merges).
+        into = dict(st.merge_queue)
+        for wid, state in snap.logic_states.items():
+            target = wid
+            seen = set()
+            while target in into and target not in seen:
+                seen.add(target)
+                target = into[target]
+            if target not in sess:
+                continue
+            slot_key = f"{key}\x00{target}\x00{self._slot_seq}"
+            self._slot_seq += 1
+            self.agg.load(slot_key, state)
+            self.session_slots[(kid, target)].append(slot_key)
+        self._replay_queue(kid, snap)

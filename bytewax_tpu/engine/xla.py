@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bytewax_tpu.engine.arrays import ArrayBatch, VocabMap
+from bytewax_tpu.engine.arrays import ArrayBatch, KeyEncoder, VocabMap
 from bytewax_tpu.ops.segment import (
     AGG_KINDS,
     init_fields,
@@ -116,6 +116,9 @@ class DeviceAggState:
         # host ships per batch.
         self._vocab = VocabMap(dtype=np.int32)
         self._dev_map = None
+        # Automatic encoder for plain string key columns: steady
+        # state is one searchsorted per batch, no per-row hashing.
+        self._enc = KeyEncoder()
 
     # -- slot management ---------------------------------------------------
 
@@ -177,6 +180,7 @@ class DeviceAggState:
         if slot is not None:
             self.slot_keys[slot] = None  # type: ignore[call-overload]
             self._free.append(slot)
+            self._enc.drop(key)
 
     def _apply_resets(self) -> None:
         if self._fields is None:
@@ -194,13 +198,6 @@ class DeviceAggState:
         for name, (init, _op) in self.kind.fields.items():
             self._fields[name] = self._fields[name].at[slots].set(init)
         self._pending_reset.clear()
-
-    def _slots_for(self, keys: np.ndarray) -> np.ndarray:
-        uniq, inverse = np.unique(keys, return_inverse=True)
-        slot_of_uniq = np.empty(len(uniq), dtype=np.int32)
-        for j, k in enumerate(uniq):
-            slot_of_uniq[j] = self.alloc(str(k))
-        return slot_of_uniq[inverse]
 
     def update_slots(self, slot_ids: np.ndarray, values: np.ndarray) -> None:
         """Fold rows into pre-allocated slots (fast path for callers
@@ -235,16 +232,25 @@ class DeviceAggState:
                 values = values.astype(np.int32)
             if self._fields is None:
                 self.dtype = jnp.int32
-        elif self.dtype == jnp.int32:
+        elif self.dtype == jnp.int32 and len(values):
             # Mirrors the value_scale guard: a float batch after the
             # accumulator locked to int32 would otherwise be silently
             # truncated by the host-side cast into the int32 carrier.
-            msg = (
-                "float values arrived after earlier batches locked "
-                "this step's device state to an integer dtype; pass a "
-                "plain Python reducer for mixed int/float streams"
-            )
-            raise TypeError(msg)
+            # Integral in-range floats (e.g. the count path's ones
+            # after resuming an int snapshot) cast losslessly and
+            # pass through.
+            if (
+                np.any(values % 1)
+                or values.max() > np.iinfo(np.int32).max
+                or values.min() < np.iinfo(np.int32).min
+            ):
+                msg = (
+                    "non-integral float values arrived after earlier "
+                    "batches locked this step's device state to an "
+                    "integer dtype; pass a plain Python reducer for "
+                    "mixed int/float streams"
+                )
+                raise TypeError(msg)
         return values
 
     def update(self, keys: np.ndarray, values: np.ndarray) -> List[str]:
@@ -259,10 +265,14 @@ class DeviceAggState:
             )
             raise NonNumericValues(msg)
         values = self._pick_dtype(values)
-        slot_ids = self._slots_for(keys)
+        row_slots = self._enc.encode(
+            keys, lambda ks: [self.alloc(k) for k in ks]
+        )
         self._ensure_fields()
-        self._scatter(slot_ids, values)
-        return [str(k) for k in np.unique(keys)]
+        self._scatter(row_slots.astype(np.int32, copy=False), values)
+        return [
+            self.slot_keys[s] for s in np.unique(row_slots).tolist()
+        ]
 
     def _scatter(self, slot_ids: np.ndarray, values: np.ndarray) -> None:
         n = len(values)
@@ -443,6 +453,7 @@ class DeviceAggState:
         self._fields = None
         self._vocab = VocabMap(dtype=np.int32)
         self._dev_map = None
+        self._enc.clear()
         return out
 
     def keys(self) -> List[str]:

@@ -18,7 +18,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-__all__ = ["BrcParser", "group_kv", "is_available", "lib"]
+__all__ = ["BrcParser", "bucket_adler", "group_kv", "is_available", "lib"]
 
 _HERE = Path(__file__).parent
 _SRC = _HERE / "io_native.cpp"
@@ -111,6 +111,26 @@ def group_kv(items):
             except Exception:  # noqa: BLE001 — no toolchain: stay Python
                 return None
     return _host_ops.group_kv(items)
+
+
+def bucket_adler(items, n_buckets):
+    """Bucket ``(str key, value)`` tuples by ``adler32(key utf-8) %
+    n_buckets`` in one C pass — the keyed-exchange / default part_fn
+    routing loop.  Returns a list of ``n_buckets`` lists of the
+    original items, or ``None`` when the native module is not
+    available.  Raises TypeError on rows that are not exact str-keyed
+    2-tuples — callers must fall back on that too."""
+    global _host_ops, _host_ops_tried
+    if _host_ops is None:
+        if _host_ops_tried:
+            return None
+        with _lock:
+            _host_ops_tried = True
+            try:
+                _host_ops = _build_ext(_HERE / "host_ops.c", "host_ops")
+            except Exception:  # noqa: BLE001 — no toolchain: stay Python
+                return None
+    return _host_ops.bucket_adler(items, n_buckets)
 
 
 def _build() -> Optional[ctypes.CDLL]:

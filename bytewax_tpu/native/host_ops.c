@@ -64,9 +64,95 @@ group_kv(PyObject *self, PyObject *args)
     return groups;
 }
 
+/* zlib-compatible adler32 over a short buffer (keys are short; the
+ * blocked deferral trick zlib uses for long inputs is not worth it
+ * here).  Matches zlib.adler32(data) with the default start of 1. */
+static unsigned long
+adler32_key(const char *buf, Py_ssize_t len)
+{
+    unsigned long a = 1, b = 0;
+    for (Py_ssize_t i = 0; i < len; i++) {
+        a += (unsigned char)buf[i];
+        if (a >= 65521) {
+            a -= 65521;
+        }
+        b += a;
+        if (b >= 65521) {
+            b -= 65521;
+        }
+    }
+    return (b << 16) | a;
+}
+
+/* Bucket a list of (str key, value) 2-tuples by
+ * adler32(key utf-8) % n_buckets in one C pass; returns a list of
+ * n_buckets lists of the original items.  This is the keyed-exchange
+ * and default-part_fn routing loop — the exact hot spot the
+ * reference flags in its own output driver. */
+static PyObject *
+bucket_adler(PyObject *self, PyObject *args)
+{
+    PyObject *items;
+    Py_ssize_t n_buckets;
+    if (!PyArg_ParseTuple(args, "On", &items, &n_buckets)) {
+        return NULL;
+    }
+    if (!PyList_Check(items)) {
+        PyErr_SetString(PyExc_TypeError, "items must be a list");
+        return NULL;
+    }
+    if (n_buckets <= 0) {
+        PyErr_SetString(PyExc_ValueError, "n_buckets must be positive");
+        return NULL;
+    }
+    PyObject *buckets = PyList_New(n_buckets);
+    if (buckets == NULL) {
+        return NULL;
+    }
+    for (Py_ssize_t w = 0; w < n_buckets; w++) {
+        PyObject *lst = PyList_New(0);
+        if (lst == NULL) {
+            Py_DECREF(buckets);
+            return NULL;
+        }
+        PyList_SET_ITEM(buckets, w, lst);
+    }
+    Py_ssize_t n = PyList_GET_SIZE(items);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GET_ITEM(items, i); /* borrowed */
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2) {
+            Py_DECREF(buckets);
+            PyErr_SetString(PyExc_TypeError,
+                            "row is not a (key, value) 2-tuple");
+            return NULL;
+        }
+        PyObject *k = PyTuple_GET_ITEM(item, 0);
+        if (!PyUnicode_Check(k)) {
+            Py_DECREF(buckets);
+            PyErr_SetString(PyExc_TypeError, "key is not a str");
+            return NULL;
+        }
+        Py_ssize_t klen;
+        const char *kbuf = PyUnicode_AsUTF8AndSize(k, &klen);
+        if (kbuf == NULL) {
+            Py_DECREF(buckets);
+            return NULL;
+        }
+        Py_ssize_t w = (Py_ssize_t)(adler32_key(kbuf, klen)
+                                    % (unsigned long)n_buckets);
+        if (PyList_Append(PyList_GET_ITEM(buckets, w), item) < 0) {
+            Py_DECREF(buckets);
+            return NULL;
+        }
+    }
+    return buckets;
+}
+
 static PyMethodDef HostOpsMethods[] = {
     {"group_kv", group_kv, METH_VARARGS,
      "Group a list of (str key, value) tuples into {key: [values]}."},
+    {"bucket_adler", bucket_adler, METH_VARARGS,
+     "Bucket (str key, value) tuples by adler32(key) %% n_buckets."},
     {NULL, NULL, 0, NULL},
 };
 

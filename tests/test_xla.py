@@ -281,3 +281,44 @@ def test_vocab_must_be_append_only():
                 key_vocab=v2,
             )
         )
+
+
+def test_redistributed_columnar_batch_reaches_accel(monkeypatch):
+    # Strided per-lane column views from a columnar redistribute must
+    # still run the device-accelerated keyed fold (KeyEncoder compacts
+    # non-contiguous key columns before its dtype view).
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
+    import bytewax_tpu.operators as op
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine.arrays import ArrayBatch
+    from bytewax_tpu.xla import SUM
+
+    keys = np.array([f"k{i % 3}" for i in range(300)])
+    batch = ArrayBatch({"key": keys, "value": np.ones(300)})
+    out = []
+    flow = Dataflow("test_df")
+    s = op.input("inp", flow, ArraySource([batch]))
+    s = op.redistribute("shuffle", s)
+    r = op.reduce_final("sum", s, SUM)
+    op.output("out", r, TestingSink(out))
+    from bytewax_tpu.testing import cluster_main
+
+    cluster_main(flow, [], 0, worker_count_per_proc=2)
+    assert sorted(out) == [("k0", 100.0), ("k1", 100.0), ("k2", 100.0)]
+
+
+def test_key_encoder_empty_first_batch():
+    # An empty delivery must not install its (arbitrary) dtype kind
+    # as the encoder's seen-set; later real batches keep the
+    # steady-state fast path.
+    from bytewax_tpu.engine.arrays import KeyEncoder
+
+    enc = KeyEncoder()
+    assert len(enc.encode(np.array([], dtype=object), lambda ks: [])) == 0
+    assert enc._sorted is None
+    ids = enc.encode(np.array(["a", "b", "a"]), lambda ks: [10, 11])
+    assert ids.tolist() == [10, 11, 10]
+    assert enc._sorted is not None and enc._sorted.dtype.kind == "U"
+    # Steady state: no allocs for seen keys.
+    ids2 = enc.encode(np.array(["b", "a"]), lambda ks: 1 / 0)
+    assert ids2.tolist() == [11, 10]
