@@ -162,6 +162,12 @@ def factorize_keys(arr: np.ndarray):
             _factorize = False
     if _factorize:
         codes, uniq = _factorize(arr)
+        if len(codes) and codes.min() < 0:
+            # pandas maps None/NaN keys to code -1, which negative
+            # indexing would silently attribute to the LAST unique
+            # key; fail loudly like the np.unique path does.
+            msg = "key column contains null (None/NaN) keys"
+            raise TypeError(msg)
         return codes, np.asarray(uniq)
     uniq, codes = np.unique(arr, return_inverse=True)
     return codes, uniq

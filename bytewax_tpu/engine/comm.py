@@ -24,6 +24,14 @@ __all__ = ["Comm"]
 
 _LEN = struct.Struct("<Q")
 _DIAL_TIMEOUT_S = 30.0
+#: In-band liveness frame, swallowed before delivery.
+_HB = ("__bytewax_tpu_hb__",)
+#: Default heartbeat interval (seconds); a peer silent for
+#: ``_HB_MISS`` intervals is declared dead.  The default is
+#: deliberately long: a process inside a first XLA compile sends
+#: nothing for tens of seconds and must not be declared dead.
+_HB_DEFAULT_S = 30.0
+_HB_MISS = 2.5
 #: Default per-peer raw receive-buffer cap; reading from a peer
 #: pauses above it and resumes once its frames are parsed out, so a
 #: fast producer sees TCP backpressure instead of ballooning this
@@ -64,6 +72,18 @@ class Comm:
         #: High-water mark of any single peer's raw rx buffer (bytes);
         #: test/observability hook.
         self.rx_peak = 0
+        #: Heartbeat interval (s); 0 disables liveness checking.
+        #: Detection bound: a peer silent for ``_HB_MISS`` intervals
+        #: is declared dead — catches frozen/half-open peers that a
+        #: TCP close would never report.
+        self._hb = float(
+            os.environ.get("BYTEWAX_TPU_HEARTBEAT_S", _HB_DEFAULT_S)
+        )
+        #: Per-peer last-send instants: liveness is judged per peer,
+        #: so idleness must be tracked (and heartbeats sent) per peer
+        #: — chatting with one peer must not starve the others.
+        self._last_tx: dict = {}
+        self._last_rx: dict = {}
 
         host, _, port = addresses[proc_id].rpartition(":")
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -124,6 +144,9 @@ class Comm:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._socks[peer] = sock
         self._rx_buf[peer] = bytearray()
+        now = time.monotonic()
+        self._last_rx[peer] = now
+        self._last_tx[peer] = now
         self._sel.register(sock, selectors.EVENT_READ, peer)
 
     def send(self, dest: int, msg: Any) -> None:
@@ -133,6 +156,7 @@ class Comm:
         payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
         data = memoryview(_LEN.pack(len(payload)) + payload)
         sock = self._socks[dest]
+        self._last_tx[dest] = time.monotonic()
         while data:
             try:
                 sent = sock.send(data)
@@ -182,7 +206,10 @@ class Comm:
                 break
             frame = bytes(buf[_LEN.size : _LEN.size + length])
             del buf[: _LEN.size + length]
-            out.append((peer, pickle.loads(frame)))
+            msg = pickle.loads(frame)
+            if msg == _HB:
+                continue  # liveness only; never delivered
+            out.append((peer, msg))
         self._maybe_resume(peer)
 
     def _drain_into_buffers(self, timeout: float, mid_send: bool = False) -> None:
@@ -210,6 +237,7 @@ class Comm:
                         break
                     buf = self._rx_buf[peer]
                     buf.extend(chunk)
+                    self._last_rx[peer] = time.monotonic()
                     if len(buf) > self.rx_peak:
                         self.rx_peak = len(buf)
                     if len(buf) >= self._rx_cap:
@@ -229,8 +257,42 @@ class Comm:
         A closed peer's already-buffered frames (e.g. its final
         close/abort broadcast) are delivered before the disconnect is
         raised on a later call.
+
+        Also the liveness pump: sends a heartbeat frame to every peer
+        when this process has been send-idle for an interval, and
+        declares a peer dead after ``_HB_MISS`` silent intervals —
+        bounded detection of frozen/half-open peers that never send a
+        TCP close (``BYTEWAX_TPU_HEARTBEAT_S``; 0 disables).
         """
         self._drain_into_buffers(timeout)
+        if self._hb > 0:
+            # After the drain, so buffered-but-unread bytes can never
+            # masquerade as peer silence.
+            now = time.monotonic()
+            for peer in list(self._socks):
+                if (
+                    peer not in self._closed
+                    and now - self._last_tx[peer] >= self._hb
+                ):
+                    self.send(peer, _HB)
+            limit = self._hb * _HB_MISS
+            for peer, last in self._last_rx.items():
+                if peer in self._closed or peer in self._paused:
+                    continue
+                if peer not in self._socks:
+                    continue
+                if now - last > limit:
+                    who = (
+                        "cluster coordinator (process 0)"
+                        if peer == 0
+                        else f"cluster peer {peer}"
+                    )
+                    msg = (
+                        f"{who} sent nothing for {now - last:.1f}s "
+                        f"(> {limit:.1f}s heartbeat limit); assuming "
+                        "it is dead or frozen"
+                    )
+                    raise ConnectionError(msg)
         out: List[Tuple[int, Any]]
         if self._pending:
             out, self._pending = self._pending, []

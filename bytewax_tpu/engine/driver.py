@@ -482,26 +482,31 @@ class _StatefulBatchRt(_OpRt):
                 # Sliding/tumbling or session device windower, per
                 # the spec subtype.
                 self.wagg = spec.make_state()
-        resumed = {
-            key: state
-            for key, state in driver.resume_states(op.step_id).items()
-            if driver.is_local(_route_hash(key) % driver.worker_count)
-        }
-        if self.agg is not None:
-            for key, state in resumed.items():
-                self.agg.load(key, state)
-        elif self.wagg is not None:
-            for key, state in resumed.items():
+        # Stream resumed states in store pages (never materialize the
+        # whole keyed state as one dict — reference pages its resume
+        # reads too, src/recovery.rs:817-882).  Device agg state
+        # installs per page with one scatter per field (a per-key
+        # load is a jax dispatch per key).  Eagerly rebuilding host
+        # logics per resumed key keeps EOF-driven emission
+        # (fold_final etc.) firing even with no new input (reference:
+        # src/operators.rs:976-1006).
+        page: List[Tuple[str, Any]] = []
+        for key, state in driver.iter_resume_states(op.step_id):
+            if not driver.is_local(_route_hash(key) % driver.worker_count):
+                continue
+            if self.agg is not None:
+                page.append((key, state))
+                if len(page) >= 4096:
+                    self.agg.load_many(page)
+                    page = []
+            elif self.wagg is not None:
                 self.wagg.load(key, state)
-        else:
-            # Eagerly rebuild logics for every resumed key so
-            # EOF-driven emission (fold_final etc.) fires even with no
-            # new input (reference loads snaps into logics at startup:
-            # src/operators.rs:976-1006).
-            for key, state in resumed.items():
+            else:
                 logic = self._build(state)
                 self.logics[key] = logic
                 self._resched(key, logic)
+        if page:
+            self.agg.load_many(page)
 
     def _build(self, state: Optional[Any]) -> Any:
         try:
@@ -896,8 +901,11 @@ class _OutputRt(_OpRt):
             # bucketer computes in one pass over the whole delivery —
             # the reference flags this exact per-item exchange closure
             # as a hot spot (src/outputs.rs:189-198).
+            # Compare the bound method's underlying function so an
+            # instance-level part_fn override is respected (a plain
+            # function assigned on the instance has no __func__).
             self._default_part_fn = (
-                getattr(type(sink), "part_fn", None)
+                getattr(sink.part_fn, "__func__", None)
                 is FixedPartitionedSink.part_fn
             )
             self.part_owner = {
@@ -1135,7 +1143,23 @@ class _Driver:
         if recovery_config is not None:
             self.store = RecoveryStore(recovery_config.db_dir)
             resume = self.store.resume_from()
-            self._loads = self.store.load_snaps(resume.resume_epoch)
+            # Eagerly load only input/output partition states (a
+            # bounded handful, needed at build_part time); unbounded
+            # keyed stateful snapshots stream in store pages via
+            # iter_resume_states instead, so resume memory stays
+            # bounded however large the state.
+            io_steps = [
+                op.step_id
+                for op in self.plan.ops
+                if op.name in ("input", "output")
+            ]
+            if io_steps:
+                self._loads = {
+                    (sid, key): ser
+                    for sid, key, ser in self.store.iter_snaps(
+                        resume.resume_epoch, step_ids=io_steps
+                    )
+                }
             ei = self.epoch_interval.total_seconds()
             backup = recovery_config.backup_interval.total_seconds()
             if ei > 0:
@@ -1178,12 +1202,16 @@ class _Driver:
         ser = self._loads.get((step_id, state_key))
         return pickle.loads(ser) if ser is not None else None
 
-    def resume_states(self, step_id: str) -> Dict[str, Any]:
-        return {
-            key: pickle.loads(ser)
-            for (sid, key), ser in self._loads.items()
-            if sid == step_id
-        }
+    def iter_resume_states(self, step_id: str):
+        """Stream ``(key, state)`` resume pairs for a stateful step in
+        store pages — memory bounded by the page size, not the keyed
+        state size."""
+        if self.store is None:
+            return
+        for _sid, key, ser in self.store.iter_snaps(
+            self.resume.resume_epoch, step_ids=[step_id]
+        ):
+            yield key, pickle.loads(ser)
 
     def route(self, stream_id: str, entry: Entry) -> None:
         for ci, port in self.plan.consumers.get(stream_id, []):

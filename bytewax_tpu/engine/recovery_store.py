@@ -253,25 +253,65 @@ class RecoveryStore:
                 raise InconsistentPartitionsError(msg)
         return resume
 
+    #: Page size for snapshot resume reads (reference pages its
+    #: snapshot SQL the same way: ``src/recovery.rs:817-882``,
+    #: ``:1160-1163``).
+    SNAP_PAGE = 1000
+
+    def iter_snaps(
+        self,
+        before_epoch: int,
+        step_ids: Optional[List[str]] = None,
+        page_size: Optional[int] = None,
+    ):
+        """Yield ``(step_id, state_key, ser_change)`` for the latest
+        state change per (step, key) strictly before an epoch, reading
+        ``page_size`` rows per SQL query (keyset pagination), so
+        resume memory is bounded by the page — not the total state
+        size.  Discard markers are skipped.  Each (step, key) lives in
+        exactly one partition file (snapshots are key-hash
+        partitioned on write), so partitions stream independently."""
+        if page_size is None:
+            page_size = self.SNAP_PAGE
+        conds = ["epoch < ?", "(step_id, state_key) > (?, ?)"]
+        filt = ""
+        if step_ids is not None:
+            filt = "step_id IN (%s)" % ",".join("?" * len(step_ids))
+            conds.append(filt)
+        sql = (
+            "SELECT s.step_id, s.state_key, s.ser_change "
+            "FROM snaps s JOIN ("
+            "  SELECT step_id, state_key, MAX(epoch) AS epoch FROM snaps "
+            f"  WHERE {' AND '.join(conds)} "
+            "  GROUP BY step_id, state_key "
+            "  ORDER BY step_id, state_key LIMIT ?"
+            ") latest ON s.step_id = latest.step_id "
+            "AND s.state_key = latest.state_key "
+            "AND s.epoch = latest.epoch "
+            "ORDER BY s.step_id, s.state_key"
+        )
+        for con in self._cons.values():
+            last = ("", "")
+            while True:
+                args: List = [before_epoch, *last]
+                if step_ids is not None:
+                    args += list(step_ids)
+                rows = con.execute(sql, (*args, page_size)).fetchall()
+                if not rows:
+                    break
+                last = (rows[-1][0], rows[-1][1])
+                for step_id, state_key, ser_change in rows:
+                    if ser_change is not None:
+                        yield step_id, state_key, ser_change
+
     def load_snaps(self, before_epoch: int) -> Dict[Tuple[str, str], bytes]:
         """Load the latest state change per (step, key) strictly before
-        an epoch.  Discard markers remove the key."""
-        out: Dict[Tuple[str, str], bytes] = {}
-        for con in self._cons.values():
-            rows = con.execute(
-                "SELECT s.step_id, s.state_key, s.ser_change "
-                "FROM snaps s JOIN ("
-                "  SELECT step_id, state_key, MAX(epoch) AS epoch FROM snaps "
-                "  WHERE epoch < ? GROUP BY step_id, state_key"
-                ") latest ON s.step_id = latest.step_id "
-                "AND s.state_key = latest.state_key "
-                "AND s.epoch = latest.epoch",
-                (before_epoch,),
-            ).fetchall()
-            for step_id, state_key, ser_change in rows:
-                if ser_change is not None:
-                    out[(step_id, state_key)] = ser_change
-        return out
+        an epoch into one dict.  Prefer :meth:`iter_snaps` for keyed
+        state — this materializes everything at once."""
+        return {
+            (step_id, state_key): ser
+            for step_id, state_key, ser in self.iter_snaps(before_epoch)
+        }
 
     # -- write path --------------------------------------------------------
 

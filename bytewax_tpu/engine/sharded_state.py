@@ -389,34 +389,76 @@ class ShardedAggState:
 
     # -- recovery ------------------------------------------------------------
 
+    def _field_vals(self, state: Any):
+        """Decompose a host-format snapshot into per-field scalars."""
+        kind = self.kind_name
+        if kind in ("sum", "min", "max", "count"):
+            name = "count" if kind == "count" else next(iter(self.kind.fields))
+            return {name: float(state)}
+        if kind == "mean":
+            total, count = state
+            return {"sum": float(total), "count": float(count)}
+        mn, mx, total, count = state  # stats
+        return {
+            "min": float(mn),
+            "max": float(mx),
+            "sum": float(total),
+            "count": float(count),
+        }
+
+    def _maybe_lock_int(self, state: Any) -> None:
+        import jax.numpy as jnp
+
+        if (
+            self.kind_name in ("sum", "min", "max", "count")
+            and isinstance(state, int)
+            and self._fields is None
+        ):
+            self.dtype = jnp.int32
+
     def load(self, key: str, state: Any) -> None:
         """Install a resumed snapshot for a key (host-tier format,
         identical to ``DeviceAggState.load``)."""
         import jax.numpy as jnp
 
-        kind = self.kind_name
-        if kind in ("sum", "min", "max", "count"):
-            name = "count" if kind == "count" else next(iter(self.kind.fields))
-            field_vals = {name: float(state)}
-            if isinstance(state, int) and self._fields is None:
-                self.dtype = jnp.int32
-        elif kind == "mean":
-            total, count = state
-            field_vals = {"sum": float(total), "count": float(count)}
-        else:  # stats
-            mn, mx, total, count = state
-            field_vals = {
-                "min": float(mn),
-                "max": float(mx),
-                "sum": float(total),
-                "count": float(count),
-            }
+        self._maybe_lock_int(state)
+        field_vals = self._field_vals(state)
         kid = self.alloc(key)
         self._ensure_fields()
         idx = self._global_idx(kid)
         for name, val in field_vals.items():
             self._fields[name] = (
                 self._fields[name].at[idx].set(jnp.asarray(val, self.dtype))
+            )
+
+    def load_many(self, items) -> None:
+        """Batched resume: ONE scatter per field per page (mirrors
+        ``DeviceAggState.load_many``).  Wire ids are resolved after
+        every alloc so capacity growth mid-page can't skew the
+        global indices."""
+        import jax
+
+        if not items:
+            return
+        self._maybe_lock_int(items[0][1])
+        names = list(self.kind.fields)
+        cols = {
+            name: np.empty(len(items), dtype=np.dtype(self.dtype))
+            for name in names
+        }
+        kids = []
+        for i, (key, state) in enumerate(items):
+            fv = self._field_vals(state)
+            kids.append(self.alloc(key))
+            for name in names:
+                cols[name][i] = fv[name]
+        self._ensure_fields()
+        idxs = np.fromiter(
+            (self._global_idx(k) for k in kids), dtype=np.int64, count=len(kids)
+        )
+        for name in names:
+            self._fields[name] = (
+                self._fields[name].at[idxs].set(jax.device_put(cols[name]))
             )
 
     def _fetch(self) -> Dict[str, np.ndarray]:

@@ -672,3 +672,133 @@ op.output("out", fmt, FileSink({out_path!r}))
     )
     assert res.returncode == 0, res.stderr[-3000:]
     assert sorted(Path(out_path).read_text().split()) == ["1"] * 8
+
+
+def test_comm_heartbeat_detects_frozen_peer(monkeypatch):
+    # A frozen peer (socket open, nothing sent — no TCP close ever
+    # arrives) must be declared dead within the heartbeat bound
+    # (~2.5 intervals), with a clear coordinator-naming error.
+    import threading
+    import time as _time
+
+    from bytewax_tpu.engine.comm import Comm
+
+    hb = 0.2
+    monkeypatch.setenv("BYTEWAX_TPU_HEARTBEAT_S", str(hb))
+    addrs = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    errors = {}
+    frozen = threading.Event()
+
+    def run_live():
+        comm = Comm(addrs, 1)
+        t0 = _time.monotonic()
+        try:
+            while True:
+                comm.recv_ready(0.02)
+                if _time.monotonic() - t0 > 20:
+                    errors[1] = ("timeout", None)
+                    return
+        except ConnectionError as ex:
+            errors[1] = (str(ex), _time.monotonic() - t0)
+        finally:
+            comm.close()
+
+    def run_frozen():
+        comm = Comm(addrs, 0)
+        # Handshake done; now freeze (no pumping, no close).
+        frozen.wait(timeout=20)
+        comm.close()
+
+    threads = [
+        threading.Thread(target=run_frozen),
+        threading.Thread(target=run_live),
+    ]
+    for t in threads:
+        t.start()
+    threads[1].join(timeout=25)
+    frozen.set()
+    threads[0].join(timeout=5)
+    msg, elapsed = errors[1]
+    assert "coordinator (process 0)" in msg, msg
+    assert "heartbeat" in msg
+    # Detection within the documented bound (plus scheduling slack).
+    assert elapsed is not None and elapsed < hb * 2.5 + 1.0, elapsed
+    assert elapsed > hb * 2.0  # not trigger-happy either
+
+
+def test_comm_heartbeats_keep_idle_cluster_alive(monkeypatch):
+    # Two idle-but-pumping peers exchange heartbeats and survive far
+    # past the detection limit; heartbeat frames are never delivered.
+    import threading
+    import time as _time
+
+    from bytewax_tpu.engine.comm import Comm
+
+    hb = 0.1
+    monkeypatch.setenv("BYTEWAX_TPU_HEARTBEAT_S", str(hb))
+    addrs = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    got = {0: [], 1: []}
+    errors = []
+    done = threading.Barrier(2, timeout=25)
+
+    def run(pid):
+        try:
+            comm = Comm(addrs, pid)
+            deadline = _time.monotonic() + hb * 12
+            while _time.monotonic() < deadline:
+                got[pid].extend(comm.recv_ready(0.02))
+            comm.send(1 - pid, ("real", pid))
+            want = (1 - pid, ("real", 1 - pid))
+            while want not in got[pid]:
+                got[pid].extend(comm.recv_ready(0.02))
+            done.wait()  # both drained before either closes
+            comm.close()
+        except BaseException as ex:  # noqa: BLE001
+            errors.append((pid, ex))
+
+    threads = [threading.Thread(target=run, args=(p,)) for p in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    # Only the real messages arrived; heartbeats were swallowed.
+    assert got[0] == [(1, ("real", 1))]
+    assert got[1] == [(0, ("real", 0))]
+
+
+def test_comm_heartbeat_no_false_positive_on_partial_traffic(monkeypatch):
+    # 3 peers; peer 1 sends real data only to peer 0.  Peer 2 must
+    # keep seeing peer 1's heartbeats (per-peer tx tracking) and
+    # never declare it dead.
+    import threading
+    import time as _time
+
+    from bytewax_tpu.engine.comm import Comm
+
+    hb = 0.15
+    monkeypatch.setenv("BYTEWAX_TPU_HEARTBEAT_S", str(hb))
+    addrs = [f"127.0.0.1:{_free_port()}" for _ in range(3)]
+    errors = []
+    done = threading.Barrier(3, timeout=30)
+
+    def run(pid):
+        try:
+            comm = Comm(addrs, pid)
+            deadline = _time.monotonic() + hb * 15
+            while _time.monotonic() < deadline:
+                if pid == 1:
+                    comm.send(0, ("chatter", pid))
+                comm.recv_ready(0.02)
+                _time.sleep(0.02)
+            done.wait()
+            comm.close()
+        except BaseException as ex:  # noqa: BLE001
+            errors.append((pid, ex))
+
+    threads = [threading.Thread(target=run, args=(p,)) for p in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=40)
+    assert not errors, errors
