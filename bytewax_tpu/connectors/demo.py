@@ -55,7 +55,20 @@ class _RandomMetricPartition(
 
 class RandomMetricSource(FixedPartitionedSource):
     """Demo source of randomly-walking ``(metric_name, value)`` pairs
-    at a fixed interval."""
+    at a fixed interval.
+
+    >>> from datetime import timedelta
+    >>> from bytewax_tpu.connectors.demo import RandomMetricSource
+    >>> from bytewax_tpu.testing import poll_next_batch
+    >>> src = RandomMetricSource(
+    ...     "cpu", interval=timedelta(0), count=3, seed=42
+    ... )
+    >>> src.list_parts()
+    ['cpu']
+    >>> part = src.build_part("demo", "cpu", None)
+    >>> [(k, type(v).__name__) for k, v in poll_next_batch(part)]
+    [('cpu', 'float')]
+    """
 
     def __init__(
         self,

@@ -47,7 +47,24 @@ class _FileSourcePartition(StatefulSourcePartition[str, int]):
 
 class FileSource(FixedPartitionedSource[str, int]):
     """Read a single file line-by-line; resumes exactly at the
-    snapshotted byte offset."""
+    snapshotted byte offset.
+
+    >>> import tempfile, os
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.connectors.files import FileSource
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, run_main
+    >>> with tempfile.TemporaryDirectory() as td:
+    ...     path = os.path.join(td, "lines.txt")
+    ...     _ = open(path, "w").write("one\\ntwo\\n")
+    ...     flow = Dataflow("file_source_eg")
+    ...     s = op.input("inp", flow, FileSource(path))
+    ...     out = []
+    ...     op.output("out", s, TestingSink(out))
+    ...     run_main(flow)
+    >>> out
+    ['one', 'two']
+    """
 
     def __init__(
         self,
@@ -89,7 +106,24 @@ class FileSource(FixedPartitionedSource[str, int]):
 
 class DirSource(FixedPartitionedSource[str, int]):
     """Read all files matching a glob in a directory, line-by-line;
-    each unique file is a partition (the unit of parallelism)."""
+    each unique file is a partition (the unit of parallelism).
+
+    >>> import tempfile, os
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.connectors.files import DirSource
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, run_main
+    >>> with tempfile.TemporaryDirectory() as td:
+    ...     _ = open(os.path.join(td, "a.log"), "w").write("x\\n")
+    ...     _ = open(os.path.join(td, "b.log"), "w").write("y\\n")
+    ...     flow = Dataflow("dir_source_eg")
+    ...     s = op.input("inp", flow, DirSource(td, glob_pat="*.log"))
+    ...     out = []
+    ...     op.output("out", s, TestingSink(out))
+    ...     run_main(flow)
+    >>> sorted(out)
+    ['x', 'y']
+    """
 
     def __init__(
         self,
@@ -175,6 +209,22 @@ class CSVSource(FixedPartitionedSource[Dict[str, str], int]):
 
     Equivalent to a :class:`FileSource` followed by ``csv.DictReader``,
     but resumable by byte offset.
+
+    >>> import tempfile, os
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.connectors.files import CSVSource
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, run_main
+    >>> with tempfile.TemporaryDirectory() as td:
+    ...     path = os.path.join(td, "rows.csv")
+    ...     _ = open(path, "w").write("name,score\\nalice,10\\n")
+    ...     flow = Dataflow("csv_source_eg")
+    ...     s = op.input("inp", flow, CSVSource(path))
+    ...     out = []
+    ...     op.output("out", s, TestingSink(out))
+    ...     run_main(flow)
+    >>> out
+    [{'name': 'alice', 'score': '10'}]
     """
 
     def __init__(
@@ -235,6 +285,21 @@ class FileSink(FixedPartitionedSink[str, int]):
     Items must be ``(key, value)`` 2-tuples with string-able values.
     The file is truncated back to the last snapshot on resume, so
     duplicates are prevented.
+
+    >>> import tempfile, os
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.connectors.files import FileSink
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSource, run_main
+    >>> with tempfile.TemporaryDirectory() as td:
+    ...     path = os.path.join(td, "out.txt")
+    ...     flow = Dataflow("file_sink_eg")
+    ...     s = op.input("inp", flow, TestingSource([("k", "hi")]))
+    ...     op.output("out", s, FileSink(path))
+    ...     run_main(flow)
+    ...     print(open(path).read())
+    hi
+    <BLANKLINE>
     """
 
     def __init__(self, path: Path, end: str = "\n"):
@@ -259,6 +324,20 @@ class DirSink(FixedPartitionedSink[str, int]):
 
     Items must be ``(key, value)`` 2-tuples; the key picks the file
     via ``assign_file``.
+
+    >>> import tempfile, os
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.connectors.files import DirSink
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSource, run_main
+    >>> with tempfile.TemporaryDirectory() as td:
+    ...     flow = Dataflow("dir_sink_eg")
+    ...     s = op.input("inp", flow, TestingSource([("k", "v")]))
+    ...     sink = DirSink(td, file_count=2, assign_file=lambda k: 0)
+    ...     op.output("out", s, sink)
+    ...     run_main(flow)
+    ...     print(open(os.path.join(td, "part_0")).read().strip())
+    v
     """
 
     def __init__(

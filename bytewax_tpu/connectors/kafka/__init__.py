@@ -71,7 +71,14 @@ def _require_confluent():
 
 @dataclass(frozen=True)
 class KafkaSourceMessage(Generic[K, V]):
-    """Message read from Kafka."""
+    """Message read from Kafka.
+
+    >>> from bytewax_tpu.connectors.kafka import KafkaSourceMessage
+    >>> msg = KafkaSourceMessage(key=b"k", value=b"v", topic="events")
+    >>> msg.to_sink()
+    KafkaSinkMessage(key=b'k', value=b'v', topic='events', headers=[], \
+partition=None, timestamp=0)
+    """
 
     key: K
     value: V
@@ -124,7 +131,21 @@ class KafkaSourceMessage(Generic[K, V]):
 
 @dataclass(frozen=True)
 class KafkaError(Generic[K, V]):
-    """Error from a :class:`KafkaSource`."""
+    """Error from a :class:`KafkaSource`.
+
+    Appears on the ``errs`` stream of ``kafka.operators.input``; route
+    it to a dead-letter sink or :func:`bytewax_tpu.operators.raises`:
+
+    >>> from bytewax_tpu.connectors.kafka import (
+    ...     KafkaError, KafkaSourceMessage,
+    ... )
+    >>> err = KafkaError(
+    ...     error="broker transport failure",
+    ...     msg=KafkaSourceMessage(key=None, value=None, topic="events"),
+    ... )
+    >>> err.msg.topic
+    'events'
+    """
 
     error: object
     """Underlying `confluent_kafka.KafkaError`."""
@@ -135,7 +156,13 @@ class KafkaError(Generic[K, V]):
 
 @dataclass(frozen=True)
 class KafkaSinkMessage(Generic[K, V]):
-    """Message to be written to Kafka."""
+    """Message to be written to Kafka.
+
+    >>> from bytewax_tpu.connectors.kafka import KafkaSinkMessage
+    >>> msg = KafkaSinkMessage(key=None, value=b"payload", topic="out")
+    >>> msg.value
+    b'payload'
+    """
 
     key: K
     value: V

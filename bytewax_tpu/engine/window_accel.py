@@ -12,10 +12,14 @@ handles everything else (sessions, non-numeric folds, SystemClock).
 Snapshots are emitted in the host tier's ``_WindowSnapshot`` format,
 so recovery is interchangeable between tiers.
 
-Semantics note: lateness is judged against the key's watermark as of
-the *end* of each delivered batch (the host tier judges per item);
-for commutative folds this only affects which side of the late stream
-borderline items land on within a single batch.
+Semantics note: lateness matches the host tier exactly — each row is
+judged post-item against its key's running watermark (a per-key
+prefix max over the delivered batch, floored by the carried base), so
+an in-batch timestamp jump marks subsequent borderline rows late on
+both tiers identically, and the comparison is strict (``ts <
+watermark``; a row exactly at the watermark is on time).
+``tests/test_window_accel.py::test_window_accel_lateness_boundary``
+pins this.
 """
 
 from datetime import datetime, timedelta, timezone

@@ -46,6 +46,26 @@ class AbortExecution(RuntimeError):
     immediately, without a final snapshot (simulates a hard crash for
     recovery testing).
 
+    :class:`bytewax_tpu.testing.TestingSource` raises it at the
+    ``ABORT`` sentinel; the engine stops the execution there (no items
+    past the sentinel, no final snapshot).  Each sentinel triggers
+    only once, so re-running the same flow continues past it:
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("abort_eg")
+    >>> src = TestingSource([1, TestingSource.ABORT(), 2])
+    >>> s = op.input("inp", flow, src)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [1]
+    >>> run_main(flow)  # replays; the abort is spent
+    >>> out
+    [1, 1, 2]
+
     Reference parity: ``src/inputs.rs:99-104``.
     """
 
@@ -102,6 +122,38 @@ class FixedPartitionedSource(Source[X], Generic[X, S]):
 
     Partitions are distributed across workers; state is snapshotted and
     routed back on resume and rescale.
+
+    A source reading two lists as two resumable partitions:
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.inputs import (
+    ...     FixedPartitionedSource, StatefulSourcePartition,
+    ... )
+    >>> from bytewax_tpu.testing import TestingSink, run_main
+    >>> DATA = {"p0": [1, 2], "p1": [10]}
+    >>> class ListPart(StatefulSourcePartition):
+    ...     def __init__(self, items, at):
+    ...         self._items, self._at = items, at
+    ...     def next_batch(self):
+    ...         if self._at >= len(self._items):
+    ...             raise StopIteration()
+    ...         self._at += 1
+    ...         return [self._items[self._at - 1]]
+    ...     def snapshot(self):
+    ...         return self._at
+    >>> class ListSource(FixedPartitionedSource):
+    ...     def list_parts(self):
+    ...         return sorted(DATA)
+    ...     def build_part(self, step_id, for_part, resume_state):
+    ...         return ListPart(DATA[for_part], resume_state or 0)
+    >>> flow = Dataflow("fixed_part_eg")
+    >>> s = op.input("inp", flow, ListSource())
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> sorted(out)
+    [1, 2, 10]
     """
 
     @abstractmethod
@@ -145,6 +197,26 @@ class DynamicSource(Source[X]):
     """An input source where all workers can read distinct items.
 
     Reads are not recoverable; designed for ephemeral sources.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+    >>> from bytewax_tpu.testing import TestingSink, run_main
+    >>> class StridePart(StatelessSourcePartition):
+    ...     def __init__(self, start, step):
+    ...         self._nums = iter(range(start, 4, step))
+    ...     def next_batch(self):
+    ...         return [next(self._nums)]  # StopIteration = EOF
+    >>> class StrideSource(DynamicSource):
+    ...     def build(self, step_id, worker_index, worker_count):
+    ...         return StridePart(worker_index, worker_count)
+    >>> flow = Dataflow("dynamic_eg")
+    >>> s = op.input("inp", flow, StrideSource())
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> sorted(out)
+    [0, 1, 2, 3]
     """
 
     @abstractmethod
@@ -202,6 +274,22 @@ class SimplePollingSource(FixedPartitionedSource[X, None]):
     :class:`SimplePollingSource.Retry` to retry sooner than the
     interval.
 
+    >>> from datetime import timedelta
+    >>> from bytewax_tpu.inputs import SimplePollingSource
+    >>> class CounterSource(SimplePollingSource):
+    ...     def __init__(self):
+    ...         super().__init__(interval=timedelta(seconds=10))
+    ...         self.n = 0
+    ...     def next_item(self):
+    ...         self.n += 1
+    ...         return self.n
+    >>> src = CounterSource()
+    >>> src.list_parts()
+    ['singleton']
+    >>> part = src.build_part("poll", "singleton", None)
+    >>> part.next_batch()
+    [1]
+
     Reference parity: ``inputs.py:333``.
     """
 
@@ -235,7 +323,12 @@ class SimplePollingSource(FixedPartitionedSource[X, None]):
 
 
 def batch(ib: Iterable[X], batch_size: int) -> Iterator[List[X]]:
-    """Batch an iterable into lists of up to ``batch_size``."""
+    """Batch an iterable into lists of up to ``batch_size``.
+
+    >>> from bytewax_tpu.inputs import batch
+    >>> list(batch(range(5), 2))
+    [[0, 1], [2, 3], [4]]
+    """
     it = iter(ib)
     while True:
         chunk = list(itertools.islice(it, batch_size))
@@ -247,7 +340,16 @@ def batch(ib: Iterable[X], batch_size: int) -> Iterator[List[X]]:
 def batch_getter(
     getter: Callable[[], X], batch_size: int, yield_on: Optional[X] = None
 ) -> Iterator[List[X]]:
-    """Batch a getter that returns a sentinel when no more items."""
+    """Batch a getter that returns a sentinel when no more items.
+
+    >>> from bytewax_tpu.inputs import batch_getter
+    >>> items = [1, 2, 3]
+    >>> def getter():
+    ...     return items.pop(0) if items else None
+    >>> it = batch_getter(getter, 2)
+    >>> next(it), next(it)
+    ([1, 2], [3])
+    """
     while True:
         chunk: List[X] = []
         while len(chunk) < batch_size:
@@ -261,7 +363,19 @@ def batch_getter(
 def batch_getter_ex(
     getter: Callable[[], X], batch_size: int, yield_ex=IndexError
 ) -> Iterator[List[X]]:
-    """Batch a getter that raises an exception when no more items."""
+    """Batch a getter that raises an exception when no more items.
+
+    Shaped for stdlib ``queue.Queue.get_nowait`` (raises ``Empty``):
+
+    >>> import queue
+    >>> from bytewax_tpu.inputs import batch_getter_ex
+    >>> q = queue.Queue()
+    >>> for i in range(3):
+    ...     q.put(i)
+    >>> it = batch_getter_ex(q.get_nowait, 2, yield_ex=queue.Empty)
+    >>> next(it), next(it)
+    ([0, 1], [2])
+    """
     while True:
         chunk: List[X] = []
         while len(chunk) < batch_size:
@@ -282,6 +396,14 @@ def batch_async(
 
     Gathers up to ``batch_size`` items, waiting at most ``timeout``;
     yields possibly-empty batches without blocking forever.
+
+    >>> from datetime import timedelta
+    >>> from bytewax_tpu.inputs import batch_async
+    >>> async def gen():
+    ...     for i in range(3):
+    ...         yield i
+    >>> list(batch_async(gen(), timeout=timedelta(seconds=1), batch_size=2))
+    [[0, 1], [2]]
 
     Reference parity: ``inputs.py:546``.
     """

@@ -85,7 +85,17 @@ def _untyped_none() -> Any:
 
 @dataclass(frozen=True)
 class BranchOut(Generic[X, Y]):
-    """Streams returned from :func:`branch`."""
+    """Streams returned from :func:`branch`.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSource
+    >>> flow = Dataflow("branch_out_eg")
+    >>> s = op.input("inp", flow, TestingSource([1, 2]))
+    >>> b = op.branch("split", s, lambda x: x > 1)
+    >>> type(b.trues).__name__, type(b.falses).__name__
+    ('Stream', 'Stream')
+    """
 
     trues: Stream[X]
     falses: Stream[Y]
@@ -139,6 +149,18 @@ def flat_map_batch(
     operators lower to it.  On the XLA tier, batches whose mapper is
     jax-traceable are fused into the compiled step.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("flat_map_batch_eg")
+    >>> s = op.input("inp", flow, TestingSource([1, 2, 3]))
+    >>> s = op.flat_map_batch("double", s, lambda xs: [x * 2 for x in xs])
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [2, 4, 6]
+
     Reference parity: ``operators/__init__.py:179`` /
     ``src/operators.rs:122-228``.
     """
@@ -155,6 +177,17 @@ def input(  # noqa: A001
     source: Source[X],
 ) -> Stream[X]:
     """Introduce items into a dataflow from a source.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("input_eg")
+    >>> s = op.input("inp", flow, TestingSource(["a", "b"]))
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    ['a', 'b']
 
     Reference parity: ``operators/__init__.py:240`` /
     ``src/inputs.rs:449-858``.
@@ -176,6 +209,16 @@ def inspect_debug(
     inspector: Callable[[str, X, int, int], None] = _default_debug_inspector,
 ) -> Stream[X]:
     """Observe items, their epoch, and worker.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("inspect_debug_eg")
+    >>> s = op.input("inp", flow, TestingSource([1]))
+    >>> s = op.inspect_debug("dbg", s)
+    >>> op.output("out", s, TestingSink([]))
+    >>> run_main(flow)
+    inspect_debug_eg.dbg W0 @1: 1
 
     Reference parity: ``operators/__init__.py:296`` /
     ``src/operators.rs:230-317``.
@@ -213,6 +256,17 @@ def merge(step_id: str, *ups: Stream[X]) -> Stream[X]:
 def output(step_id: str, up: Stream[X], sink: Sink[X]) -> None:
     """Write items out of a dataflow into a sink.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("output_eg")
+    >>> s = op.input("inp", flow, TestingSource([1, 2]))
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [1, 2]
+
     Reference parity: ``operators/__init__.py:449`` /
     ``src/outputs.rs:200-589``.
     """
@@ -225,6 +279,21 @@ def output(step_id: str, up: Stream[X], sink: Sink[X]) -> None:
 @operator(_core=True)
 def redistribute(step_id: str, up: Stream[X]) -> Stream[X]:
     """Redistribute items randomly across all workers.
+
+    With a single worker this is a passthrough; in a cluster it
+    round-robins batches across lanes to rebalance skew.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("redistribute_eg")
+    >>> s = op.input("inp", flow, TestingSource([1, 2, 3]))
+    >>> s = op.redistribute("spread", s)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> sorted(out)
+    [1, 2, 3]
 
     Reference parity: ``operators/__init__.py:497`` /
     ``src/operators.rs:345-361``.
@@ -295,6 +364,29 @@ def stateful_batch(
     Keys are hash-routed to a home worker (chip shard on the XLA tier);
     ``builder`` is called with ``None`` for new keys or the resume
     snapshot on recovery.
+
+    A running-total logic, snapshotting its sum for recovery:
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> class RunningTotal(op.StatefulBatchLogic):
+    ...     def __init__(self, resume_state):
+    ...         self.total = resume_state if resume_state is not None else 0
+    ...     def on_batch(self, values):
+    ...         self.total += sum(values)
+    ...         return ([self.total], op.StatefulBatchLogic.RETAIN)
+    ...     def snapshot(self):
+    ...         return self.total
+    >>> flow = Dataflow("stateful_batch_eg")
+    >>> inp = [("a", 1), ("a", 2), ("b", 10)]
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> s = op.stateful_batch("total", s, RunningTotal)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> sorted(out)
+    [('a', 1), ('a', 3), ('b', 10)]
 
     Reference parity: ``operators/__init__.py:795`` /
     ``src/operators.rs:441-1041``.
@@ -384,6 +476,33 @@ def stateful(
     builder: Callable[[Optional[S]], StatefulLogic[V, W, S]],
 ) -> KeyedStream[W]:
     """Advanced per-item stateful operator.
+
+    A logic that passes each value through and discards its per-key
+    state after every item (so each item builds a fresh logic):
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> class FirstOnly(op.StatefulLogic):
+    ...     def __init__(self, resume_state):
+    ...         pass
+    ...     def on_item(self, value):
+    ...         return ([value], op.StatefulLogic.DISCARD)
+    ...     def snapshot(self):
+    ...         return None
+    >>> flow = Dataflow("stateful_eg")
+    >>> inp = [("a", "x"), ("a", "y"), ("b", "z")]
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> s = op.stateful("first", s, FirstOnly)
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> sorted(out)
+    [('a', 'x'), ('a', 'y'), ('b', 'z')]
+
+    (Each ``DISCARD`` drops the key's logic, so the next item for that
+    key builds a fresh one — retaining with ``RETAIN`` and emitting
+    nothing on later items would dedupe instead.)
 
     Reference parity: ``operators/__init__.py:1065``.
     """
@@ -659,6 +778,16 @@ def inspect(
 ) -> Stream[X]:
     """Observe items for debugging; prints by default.
 
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("inspect_eg")
+    >>> s = op.input("inp", flow, TestingSource([1]))
+    >>> s = op.inspect("see", s)
+    >>> op.output("out", s, TestingSink([]))
+    >>> run_main(flow)
+    inspect_eg.see: 1
+
     Reference parity: ``operators/__init__.py:2021``.
     """
     if inspector is None:
@@ -805,6 +934,20 @@ def map_value(
 @operator
 def raises(step_id: str, up: Stream[Any]) -> None:
     """Raise an exception and crash the dataflow on any item.
+
+    Useful to assert a stream stays empty (e.g. an error branch):
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSource, run_main
+    >>> flow = Dataflow("raises_eg")
+    >>> s = op.input("inp", flow, TestingSource([1]))
+    >>> op.raises("boom", s)
+    >>> try:
+    ...     run_main(flow)
+    ... except RuntimeError:
+    ...     print("crashed")
+    crashed
 
     Reference parity: ``operators/__init__.py:2767``.
     """
@@ -1103,6 +1246,23 @@ class TTLCache(Generic[DK, DV]):
     Entries are stamped when fetched and re-fetched on first access
     at or past their deadline (expiry is lazy: an entry that is never
     read again is simply overwritten whenever it is next fetched).
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> from bytewax_tpu.operators import TTLCache
+    >>> clock = [datetime(2024, 1, 1, tzinfo=timezone.utc)]
+    >>> fetches = []
+    >>> def getter(k):
+    ...     fetches.append(k)
+    ...     return k.upper()
+    >>> cache = TTLCache(getter, lambda: clock[0], timedelta(seconds=10))
+    >>> cache.get("a"), cache.get("a")
+    ('A', 'A')
+    >>> fetches
+    ['a']
+    >>> clock[0] += timedelta(seconds=11)
+    >>> _ = cache.get("a")
+    >>> fetches
+    ['a', 'a']
 
     Reference parity: ``operators/__init__.py:1275``.
     """

@@ -190,6 +190,16 @@ class SystemClock(Clock[Any, None]):
 
     The watermark is the current system time; at EOF it jumps to
     :data:`UTC_MAX` so all windows close.
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> fake_now = datetime(2024, 1, 1, tzinfo=timezone.utc)
+    >>> clock = win.SystemClock(now_getter=lambda: fake_now)
+    >>> logic = clock.build(None)
+    >>> logic.before_batch()
+    >>> logic.on_item("anything")
+    (datetime.datetime(2024, 1, 1, 0, 0, tzinfo=datetime.timezone.utc), \
+datetime.datetime(2024, 1, 1, 0, 0, tzinfo=datetime.timezone.utc))
     """
 
     now_getter: Callable[[], datetime] = _get_system_utc
@@ -286,6 +296,24 @@ class EventClock(Clock[V, _EventClockState]):
         time the engine should wake up at; ``None`` return disables
         timer-driven closes (then only new values or EOF close
         windows).
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> fake_now = datetime(2024, 6, 1, tzinfo=timezone.utc)
+    >>> clock = win.EventClock(
+    ...     ts_getter=lambda v: v["at"],
+    ...     wait_for_system_duration=timedelta(seconds=10),
+    ...     now_getter=lambda: fake_now,
+    ... )
+    >>> logic = clock.build(None)
+    >>> logic.before_batch()
+    >>> ts, watermark = logic.on_item(
+    ...     {"at": datetime(2024, 1, 1, tzinfo=timezone.utc)}
+    ... )
+    >>> ts
+    datetime.datetime(2024, 1, 1, 0, 0, tzinfo=datetime.timezone.utc)
+    >>> watermark == ts - timedelta(seconds=10)
+    True
     """
 
     ts_getter: Callable[[V], datetime]
@@ -313,7 +341,20 @@ class EventClock(Clock[V, _EventClockState]):
 @dataclass
 class WindowMetadata:
     """Metadata about a window: open (inclusive) and close (exclusive)
-    times, plus the ids of any windows merged into it."""
+    times, plus the ids of any windows merged into it.
+
+    Emitted on the ``meta`` stream of :class:`WindowOut` when each
+    window closes:
+
+    >>> from datetime import datetime, timezone
+    >>> from bytewax_tpu.operators.windowing import WindowMetadata
+    >>> md = WindowMetadata(
+    ...     open_time=datetime(2024, 1, 1, tzinfo=timezone.utc),
+    ...     close_time=datetime(2024, 1, 1, 0, 1, tzinfo=timezone.utc),
+    ... )
+    >>> md.merged_ids
+    set()
+    """
 
     open_time: datetime
     close_time: datetime
@@ -463,6 +504,22 @@ class SlidingWindower(Windower[_SlidingWindowerState]):
     :arg offset: Time between window starts.
     :arg align_to: Align windows to this instant (may be in the past
         or future; only the phase matters).
+
+    A 10-minute window starting every 5 minutes — each timestamp
+    falls into two overlapping windows:
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> windower = win.SlidingWindower(
+    ...     length=timedelta(minutes=10),
+    ...     offset=timedelta(minutes=5),
+    ...     align_to=datetime(2024, 1, 1, tzinfo=timezone.utc),
+    ... )
+    >>> logic = windower.build(None)
+    >>> sorted(logic.open_for(
+    ...     datetime(2024, 1, 1, 0, 7, tzinfo=timezone.utc)
+    ... ))
+    [0, 1]
     """
 
     length: timedelta
@@ -503,6 +560,16 @@ class TumblingWindower(Windower[_SlidingWindowerState]):
 
     :arg length: Length of each window.
     :arg align_to: Align window boundaries to this instant.
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1),
+    ...     align_to=datetime(2024, 1, 1, tzinfo=timezone.utc),
+    ... )
+    >>> logic = windower.build(None)
+    >>> list(logic.open_for(datetime(2024, 1, 1, 0, 3, 30, tzinfo=timezone.utc)))
+    [3]
     """
 
     length: timedelta
@@ -626,6 +693,33 @@ class SessionWindower(Windower[_SessionWindowerState]):
     other and close when the stream goes quiet for ``gap``.
 
     :arg gap: Maximum inactivity between values in a session.
+
+    Two bursts separated by more than the gap form two sessions:
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> t0 = datetime(2024, 1, 1, tzinfo=timezone.utc)
+    >>> inp = [
+    ...     ("k", (t0, 1)),
+    ...     ("k", (t0 + timedelta(seconds=5), 2)),
+    ...     ("k", (t0 + timedelta(minutes=5), 3)),
+    ... ]
+    >>> clock = win.EventClock(
+    ...     ts_getter=lambda v: v[0], wait_for_system_duration=timedelta(0)
+    ... )
+    >>> flow = Dataflow("session_eg")
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> wo = win.collect_window(
+    ...     "sessions", s, clock, win.SessionWindower(gap=timedelta(minutes=1))
+    ... )
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> [[v for _t, v in vs] for _k, (_wid, vs) in sorted(out)]
+    [[1, 2], [3]]
     """
 
     gap: timedelta
@@ -837,6 +931,43 @@ def window(
     :arg ordered: Apply values in timestamp order (at a latency cost)
         instead of upstream order.  Defaults to ``True``.
     :returns: :class:`WindowOut`.
+
+    A custom logic that counts values per window:
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> class Counter(win.WindowLogic):
+    ...     def __init__(self, resume_state):
+    ...         self.n = resume_state if resume_state is not None else 0
+    ...     def on_value(self, value):
+    ...         self.n += 1
+    ...         return []
+    ...     def on_merge(self, consumed):
+    ...         self.n += consumed.n
+    ...         return []
+    ...     def on_close(self):
+    ...         return [self.n]
+    ...     def snapshot(self):
+    ...         return self.n
+    >>> align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    >>> clock = win.EventClock(
+    ...     ts_getter=lambda v: v[0], wait_for_system_duration=timedelta(0)
+    ... )
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1), align_to=align
+    ... )
+    >>> inp = [("k", (align, "x")), ("k", (align + timedelta(seconds=5), "y"))]
+    >>> flow = Dataflow("window_eg")
+    >>> s = op.input("inp", flow, TestingSource(inp))
+    >>> wo = win.window("count", s, clock, windower, Counter)
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [('k', (0, 2))]
 
     Reference parity: ``windowing.py:1254``.
     """
@@ -1424,6 +1555,30 @@ def join_window(
 ) -> WindowOut[Any, Tuple]:
     """Gather the values for a key on multiple streams within each
     window.
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> import bytewax_tpu.operators as op
+    >>> import bytewax_tpu.operators.windowing as win
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    >>> names = [("1", (align, "alice"))]
+    >>> emails = [("1", (align + timedelta(seconds=2), "a@example.com"))]
+    >>> flow = Dataflow("join_window_eg")
+    >>> ns = op.input("names", flow, TestingSource(names))
+    >>> es = op.input("emails", flow, TestingSource(emails))
+    >>> clock = win.EventClock(
+    ...     ts_getter=lambda v: v[0], wait_for_system_duration=timedelta(0)
+    ... )
+    >>> windower = win.TumblingWindower(
+    ...     length=timedelta(minutes=1), align_to=align
+    ... )
+    >>> wo = win.join_window("join", clock, windower, ns, es)
+    >>> out = []
+    >>> op.output("out", wo.down, TestingSink(out))
+    >>> run_main(flow)
+    >>> [(k, (wid, tuple(v[1] for v in vs))) for k, (wid, vs) in out]
+    [('1', (0, ('alice', 'a@example.com')))]
 
     Reference parity: ``windowing.py:2055``.
     """

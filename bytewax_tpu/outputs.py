@@ -56,6 +56,36 @@ class FixedPartitionedSink(Sink[Tuple[str, X]], Generic[X, S]):
 
     Partitions are distributed across workers; state is snapshotted and
     routed back on resume and rescale.
+
+    A two-partition sink routing ``(key, value)`` pairs by key:
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.outputs import (
+    ...     FixedPartitionedSink, StatefulSinkPartition,
+    ... )
+    >>> from bytewax_tpu.testing import TestingSource, run_main
+    >>> written = {"p0": [], "p1": []}
+    >>> class DictPart(StatefulSinkPartition):
+    ...     def __init__(self, ls):
+    ...         self._ls = ls
+    ...     def write_batch(self, values):
+    ...         self._ls.extend(values)
+    ...     def snapshot(self):
+    ...         return None
+    >>> class DictSink(FixedPartitionedSink):
+    ...     def list_parts(self):
+    ...         return sorted(written)
+    ...     def part_fn(self, item_key):
+    ...         return int(item_key)
+    ...     def build_part(self, step_id, for_part, resume_state):
+    ...         return DictPart(written[for_part])
+    >>> flow = Dataflow("fixed_sink_eg")
+    >>> s = op.input("inp", flow, TestingSource([("0", "a"), ("1", "b")]))
+    >>> op.output("out", s, DictSink())
+    >>> run_main(flow)
+    >>> written
+    {'p0': ['a'], 'p1': ['b']}
     """
 
     @abstractmethod
@@ -99,7 +129,32 @@ class StatelessSinkPartition(ABC, Generic[X]):
 
 
 class DynamicSink(Sink[X]):
-    """An output sink where all workers write items concurrently."""
+    """An output sink where all workers write items concurrently.
+
+    A sink that collects items into a shared list:
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.outputs import DynamicSink, StatelessSinkPartition
+    >>> from bytewax_tpu.testing import TestingSource, run_main
+    >>> class ListPart(StatelessSinkPartition):
+    ...     def __init__(self, ls):
+    ...         self._ls = ls
+    ...     def write_batch(self, items):
+    ...         self._ls.extend(items)
+    >>> class ListSink(DynamicSink):
+    ...     def __init__(self, ls):
+    ...         self._ls = ls
+    ...     def build(self, step_id, worker_index, worker_count):
+    ...         return ListPart(self._ls)
+    >>> flow = Dataflow("dynamic_sink_eg")
+    >>> s = op.input("inp", flow, TestingSource([1, 2]))
+    >>> out = []
+    >>> op.output("out", s, ListSink(out))
+    >>> run_main(flow)
+    >>> out
+    [1, 2]
+    """
 
     @abstractmethod
     def build(

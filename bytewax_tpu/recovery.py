@@ -48,6 +48,21 @@ class RecoveryConfig:
         delete a state snapshot after it is no longer needed.  Set to
         how long it takes you to copy the partition files off-machine.
         Defaults to zero.
+
+    >>> import tempfile
+    >>> from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> with tempfile.TemporaryDirectory() as td:
+    ...     init_db_dir(td, 1)
+    ...     flow = Dataflow("recovery_eg")
+    ...     s = op.input("inp", flow, TestingSource([1, 2]))
+    ...     out = []
+    ...     op.output("out", s, TestingSink(out))
+    ...     run_main(flow, recovery_config=RecoveryConfig(td))
+    >>> out
+    [1, 2]
     """
 
     def __init__(

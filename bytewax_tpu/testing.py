@@ -32,7 +32,15 @@ __all__ = [
 
 @dataclass
 class TimeTestingGetter:
-    """Wrapper providing a modifiable fake clock for unit tests."""
+    """Wrapper providing a modifiable fake clock for unit tests.
+
+    >>> from datetime import datetime, timedelta, timezone
+    >>> from bytewax_tpu.testing import TimeTestingGetter
+    >>> t = TimeTestingGetter(datetime(2024, 1, 1, tzinfo=timezone.utc))
+    >>> t.advance(timedelta(minutes=5))
+    >>> t.get().minute
+    5
+    """
 
     now: datetime
 
@@ -46,7 +54,14 @@ class TimeTestingGetter:
 
 
 def ffwd_iter(it: Iterator[Any], n: int) -> None:
-    """Skip a stateful iterator forward ``n`` items."""
+    """Skip a stateful iterator forward ``n`` items.
+
+    >>> from bytewax_tpu.testing import ffwd_iter
+    >>> it = iter(range(5))
+    >>> ffwd_iter(it, 3)
+    >>> next(it)
+    3
+    """
     next(islice(it, n, n), None)
 
 
@@ -57,6 +72,17 @@ class TestingSource(FixedPartitionedSource[X, int]):
     stops this execution (the next resumes after it), :class:`ABORT`
     simulates a crash (triggers once; the next execution replays from
     the last snapshot), :class:`PAUSE` stops emitting for a duration.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("testing_source_eg")
+    >>> s = op.input("inp", flow, TestingSource(["a", "b"], batch_size=2))
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    ['a', 'b']
     """
 
     __test__ = False
@@ -163,6 +189,17 @@ class TestingSink(DynamicSink[X]):
     """Append each output item to a list; unit testing only.
 
     The list is not cleared between executions.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+    >>> flow = Dataflow("testing_sink_eg")
+    >>> s = op.input("inp", flow, TestingSource([1, 2]))
+    >>> out = []
+    >>> op.output("out", s, TestingSink(out))
+    >>> run_main(flow)
+    >>> out
+    [1, 2]
     """
 
     __test__ = False
@@ -179,7 +216,14 @@ class TestingSink(DynamicSink[X]):
 def poll_next_batch(
     part: StatefulSourcePartition, timeout: timedelta = timedelta(seconds=5)
 ) -> List:
-    """Repeatedly poll a partition until it returns a batch."""
+    """Repeatedly poll a partition until it returns a batch.
+
+    >>> from bytewax_tpu.testing import TestingSource, poll_next_batch
+    >>> src = TestingSource([1, 2], batch_size=2)
+    >>> part = src.build_part("eg", "iterable", None)
+    >>> poll_next_batch(part)
+    [1, 2]
+    """
     batch: List = []
     start = datetime.now(timezone.utc)
     while len(batch) <= 0:

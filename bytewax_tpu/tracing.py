@@ -144,7 +144,14 @@ def spans_active() -> bool:
 @contextlib.contextmanager
 def span(name: str, **attrs) -> Iterator[None]:
     """Trace a span: exported via the configured backend, or logged at
-    DEBUG locally."""
+    DEBUG locally.
+
+    >>> from bytewax_tpu.tracing import span
+    >>> with span("compute", step_id="flow.map"):
+    ...     total = sum(range(10))
+    >>> total
+    45
+    """
     if _tracer is not None and _tracer._provider is not None:
         from opentelemetry import trace as ot_trace
 

@@ -88,7 +88,19 @@ def _render_op(op: Operator) -> RenderedOperator:
 
 
 def render_dataflow(flow: Dataflow) -> RenderedDataflow:
-    """Convert a dataflow into the renderable tree."""
+    """Convert a dataflow into the renderable tree.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource
+    >>> from bytewax_tpu.visualize import render_dataflow
+    >>> flow = Dataflow("viz")
+    >>> s = op.input("inp", flow, TestingSource([1]))
+    >>> op.output("out", s, TestingSink([]))
+    >>> rendered = render_dataflow(flow)
+    >>> [sub.op_type for sub in rendered.substeps]
+    ['input', 'output']
+    """
     return RenderedDataflow(
         flow_id=flow.flow_id,
         substeps=[_render_op(op) for op in flow.substeps],
@@ -96,12 +108,40 @@ def render_dataflow(flow: Dataflow) -> RenderedDataflow:
 
 
 def to_json(flow: Dataflow) -> str:
-    """Render a dataflow as JSON (served by ``GET /dataflow``)."""
+    """Render a dataflow as JSON (served by ``GET /dataflow``).
+
+    >>> import json
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource
+    >>> from bytewax_tpu.visualize import to_json
+    >>> flow = Dataflow("viz")
+    >>> s = op.input("inp", flow, TestingSource([1]))
+    >>> op.output("out", s, TestingSink([]))
+    >>> json.loads(to_json(flow))["flow_id"]
+    'viz'
+    """
     return json.dumps(asdict(render_dataflow(flow)), indent=2)
 
 
 def to_mermaid(flow: Dataflow) -> str:
-    """Render the top level of a dataflow as a Mermaid graph."""
+    """Render the top level of a dataflow as a Mermaid graph.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource
+    >>> from bytewax_tpu.visualize import to_mermaid
+    >>> flow = Dataflow("viz")
+    >>> s = op.input("inp", flow, TestingSource([1]))
+    >>> op.output("out", s, TestingSink([]))
+    >>> print(to_mermaid(flow))
+    flowchart TD
+    subgraph "viz (Dataflow)"
+    viz.inp["input (viz.inp)"]
+    viz.out["output (viz.out)"]
+    viz.inp --> viz.out
+    end
+    """
     rendered = render_dataflow(flow)
     top_ids = [op.step_id for op in rendered.substeps]
 
@@ -125,7 +165,18 @@ def to_mermaid(flow: Dataflow) -> str:
 
 def to_plan(flow: Dataflow) -> Dict[str, Any]:
     """Render the flattened core-operator plan (engine's view),
-    including XLA-tier lowering annotations."""
+    including XLA-tier lowering annotations.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource
+    >>> from bytewax_tpu.visualize import to_plan
+    >>> flow = Dataflow("viz")
+    >>> s = op.input("inp", flow, TestingSource([1]))
+    >>> op.output("out", s, TestingSink([]))
+    >>> [step["op_type"] for step in to_plan(flow)["core_ops"]]
+    ['input', 'output']
+    """
     from bytewax_tpu.engine.flatten import flatten
 
     plan = flatten(flow)

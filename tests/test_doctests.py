@@ -14,6 +14,14 @@ MODULES = [
     "bytewax_tpu.inputs",
     "bytewax_tpu.outputs",
     "bytewax_tpu.xla",
+    "bytewax_tpu.connectors.demo",
+    "bytewax_tpu.connectors.files",
+    "bytewax_tpu.connectors.kafka",
+    "bytewax_tpu.connectors.stdio",
+    "bytewax_tpu.recovery",
+    "bytewax_tpu.testing",
+    "bytewax_tpu.tracing",
+    "bytewax_tpu.visualize",
 ]
 
 
@@ -36,3 +44,59 @@ def test_doctest_examples_exist():
     finder = doctest.DocTestFinder()
     tests = [t for t in finder.find(mod) if t.examples]
     assert len(tests) >= 20, f"only {len(tests)} operators carry examples"
+
+
+def test_every_public_operator_has_example():
+    """Every public operator function (the `@operator`-decorated API in
+    `operators/` modules) carries a runnable docstring example, matching
+    the reference's every-docstring `{testcode}` policy (SURVEY §4 item
+    8)."""
+    import importlib
+    import inspect
+
+    finder = doctest.DocTestFinder()
+    missing = []
+    for modname in [
+        "bytewax_tpu.operators",
+        "bytewax_tpu.operators.helpers",
+        "bytewax_tpu.operators.windowing",
+    ]:
+        mod = importlib.import_module(modname)
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            if not inspect.isfunction(obj):
+                continue
+            if getattr(obj, "__module__", None) != modname:
+                continue
+            if not [t for t in finder.find(obj, name=name) if t.examples]:
+                missing.append(f"{modname}.{name}")
+    assert not missing, f"public operators without examples: {missing}"
+
+
+def test_every_connector_has_example():
+    """Every public connector class carries a runnable docstring
+    example (broker-backed Kafka source/sink classes document their
+    message types instead; their IO needs a live broker)."""
+    import importlib
+
+    finder = doctest.DocTestFinder()
+    targets = {
+        "bytewax_tpu.connectors.files": [
+            "CSVSource", "DirSink", "DirSource", "FileSink", "FileSource",
+        ],
+        "bytewax_tpu.connectors.stdio": ["StdOutSink"],
+        "bytewax_tpu.connectors.demo": ["RandomMetricSource"],
+        "bytewax_tpu.connectors.kafka": [
+            "KafkaError", "KafkaSinkMessage", "KafkaSourceMessage",
+        ],
+    }
+    missing = []
+    for modname, names in targets.items():
+        mod = importlib.import_module(modname)
+        for name in names:
+            obj = getattr(mod, name)
+            if not [t for t in finder.find(obj, name=name) if t.examples]:
+                missing.append(f"{modname}.{name}")
+    assert not missing, f"connectors without examples: {missing}"

@@ -136,6 +136,61 @@ def test_window_accel_late_items(monkeypatch):
     assert sum(c for _k, (_wid, c) in down) == 1
 
 
+@pytest.mark.parametrize(
+    "offsets_s, late_expected",
+    [
+        # Watermark jump first (wait=10s → watermark 110s), then a
+        # borderline-old row IN THE SAME BATCH: late, post-item.
+        ([120, 100], [100]),
+        # Same rows, old one first: nothing has advanced the
+        # watermark past it yet, so it is on time.
+        ([100, 120], []),
+        # Exactly AT the watermark (110 == 120 - 10): strict `<`
+        # means on time.
+        ([120, 110], []),
+        # Just below: late.
+        ([120, 109], [109]),
+    ],
+)
+def test_window_accel_lateness_boundary(monkeypatch, offsets_s, late_expected):
+    """Pin the in-batch lateness boundary: the device tier judges each
+    row post-item against its key's running watermark, strict `<`,
+    bit-identical to the host tier (`window_accel.py` semantics
+    note)."""
+
+    def run(accel):
+        monkeypatch.setenv("BYTEWAX_TPU_ACCEL", accel)
+        clock = EventClock(
+            ts_getter=lambda item: item[0],
+            wait_for_system_duration=timedelta(seconds=10),
+        )
+        windower = TumblingWindower(
+            length=timedelta(minutes=1), align_to=ALIGN
+        )
+        inp = [(ALIGN + timedelta(seconds=s), "a") for s in offsets_s]
+        down, late = [], []
+        flow = Dataflow("test_df")
+        # One delivered batch so the in-batch prefix-max path is
+        # what judges the borderline row.
+        s = op.input("inp", flow, TestingSource(inp, batch_size=len(inp)))
+        wo = w.count_window(
+            "count", s, clock, windower, key=lambda item: item[1]
+        )
+        op.output("down", wo.down, TestingSink(down))
+        op.output("late", wo.late, TestingSink(late))
+        run_main(flow)
+        late_secs = sorted(
+            int((v[0] - ALIGN).total_seconds()) for _k, (_wid, v) in late
+        )
+        counted = sum(c for _k, (_wid, c) in down)
+        return late_secs, counted
+
+    dev_late, dev_count = run("1")
+    host_late, host_count = run("0")
+    assert dev_late == host_late == late_expected
+    assert dev_count == host_count == len(offsets_s) - len(late_expected)
+
+
 def test_window_accel_cross_tier_recovery(tmp_path, monkeypatch):
     from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
 
