@@ -202,23 +202,55 @@ class Plan:
         return [s.stream_id for s in op.up_streams()]
 
 
-def _walk(op: Operator, plan: Plan) -> None:
+def _walk(op: Operator, plan: Plan, prunable: bool = False) -> None:
     if op.core:
         if op.name not in CORE_OPS:
             msg = f"unknown core operator {op.name!r} at {op.step_id!r}"
             raise DataflowError(msg)
-        idx = len(plan.ops)
+        if prunable:
+            op.conf["_prunable"] = True
         plan.ops.append(op)
+    else:
+        _annotate_accel(op)
+        prunable = prunable or bool(op.conf.get("_prunable"))
+        for sub in op.substeps:
+            _walk(sub, plan, prunable)
+
+
+def _index(plan: Plan) -> None:
+    plan.producer = {}
+    plan.consumers = {}
+    for idx, op in enumerate(plan.ops):
         for port, val in op.ups.items():
             streams = [val] if not isinstance(val, list) else val
             for s in streams:
                 plan.consumers.setdefault(s.stream_id, []).append((idx, port))
         for s in op.down_streams():
             plan.producer[s.stream_id] = idx
-    else:
-        _annotate_accel(op)
-        for sub in op.substeps:
-            _walk(sub, plan)
+
+
+def _prune_dead_taps(plan: Plan) -> None:
+    """Drop core steps marked ``_prunable`` (pure internal shims —
+    the window operator's unwrap taps) whose output streams have no
+    consumer: they can never affect anything observable, and a live
+    tap costs a per-event Python pass.  Iterates because dropping a
+    tap can orphan another prunable step upstream.  Deterministic
+    (tree order), so every cluster process prunes identically."""
+    while True:
+        dead = [
+            op
+            for op in plan.ops
+            if op.conf.get("_prunable")
+            and all(
+                not plan.consumers.get(s.stream_id)
+                for s in op.down_streams()
+            )
+        ]
+        if not dead:
+            return
+        drop = set(map(id, dead))
+        plan.ops = [op for op in plan.ops if id(op) not in drop]
+        _index(plan)
 
 
 def flatten(flow: Dataflow) -> Plan:
@@ -227,6 +259,8 @@ def flatten(flow: Dataflow) -> Plan:
     plan = Plan(flow)
     for op in flow.substeps:
         _walk(op, plan)
+    _index(plan)
+    _prune_dead_taps(plan)
     names = {op.name for op in plan.ops}
     if "input" not in names:
         msg = (

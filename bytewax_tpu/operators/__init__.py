@@ -142,12 +142,18 @@ def flat_map_batch(
     step_id: str,
     up: Stream[X],
     mapper: Callable[[List[X]], Iterable[Y]],
+    *,
+    _prunable: bool = False,
 ) -> Stream[Y]:
     """Transform an entire batch of items 1-to-many.
 
     This is the lowest-level stateless transform; all ``map``-family
     operators lower to it.  On the XLA tier, batches whose mapper is
     jax-traceable are fused into the compiled step.
+
+    ``_prunable`` (internal) marks the step as a pure shim the
+    flatten pass may drop when its output is never consumed; only
+    set it for mappers with no side effects.
 
     >>> import bytewax_tpu.operators as op
     >>> from bytewax_tpu.dataflow import Dataflow

@@ -124,6 +124,15 @@ class ClockLogic(ABC, Generic[V, S]):
         """Return ``(value_timestamp, current_watermark)``."""
         ...
 
+    def on_items(
+        self, values: List[V]
+    ) -> List[Tuple[datetime, datetime]]:
+        """Batch form of :meth:`on_item`; must be equivalent to
+        calling it once per value.  Override for speed — the default
+        just loops."""
+        on_item = self.on_item
+        return [on_item(v) for v in values]
+
     @abstractmethod
     def on_notify(self) -> datetime:
         """Return the current watermark on a timer wakeup."""
@@ -262,6 +271,39 @@ class _EventClockLogic(ClockLogic[V, _EventClockState]):
             self.state.system_time_of_max_event = self._system_now
             return ts, new_base
         return ts, watermark
+
+    def on_items(
+        self, values: List[V]
+    ) -> List[Tuple[datetime, datetime]]:
+        # The per-item hot path flattened: the watermark is a local
+        # (no datetime re-construction per item) and the state writes
+        # happen once at the end.  `_system_now` is constant within a
+        # batch, so deferring the base/system-time write preserves
+        # `on_item`'s exact per-item watermarks and final state.
+        st = self.state
+        assert st is not None
+        now = self._system_now
+        watermark = st.watermark_base + (now - st.system_time_of_max_event)
+        wait = self.wait_for_system_duration
+        get = self.ts_getter
+        out: List[Tuple[datetime, datetime]] = []
+        append = out.append
+        base_advanced = False
+        for v in values:
+            ts = get(v)
+            try:
+                new_base = ts - wait
+            except OverflowError:
+                append((ts, watermark))
+                continue
+            if new_base > watermark:
+                watermark = new_base
+                base_advanced = True
+            append((ts, watermark))
+        if base_advanced:
+            st.watermark_base = watermark
+            st.system_time_of_max_event = now
+        return out
 
     def on_notify(self) -> datetime:
         self.before_batch()
@@ -437,6 +479,11 @@ class _SlidingWindowerLogic(WindowerLogic[_SlidingWindowerState]):
         # length); pure integer arithmetic — the XLA tier computes the
         # same ids vectorized on device.
         since = timestamp - self.align_to
+        if self.offset == self.length:
+            # Tumbling: exactly one window.  floor((since-len)/off)+1
+            # == floor(since/off) when off == len, so one floordiv
+            # (timedelta // timedelta is the per-item hot spot).
+            return [since // self.offset]
         first = (since - self.length) // self.offset + 1
         last = since // self.offset
         return list(range(first, last + 1))
@@ -800,14 +847,28 @@ class _WindowLogic(
     logics: Dict[int, WindowLogic] = field(default_factory=dict)
     queue: List[_WindowQueueEntry] = field(default_factory=list)
     _last_watermark: datetime = UTC_MIN
+    #: Whether `queue` is currently non-decreasing in timestamp (the
+    #: steady state for in-order streams) — lets `_flush` slice the
+    #: due prefix instead of partitioning + sorting.  Not snapshotted;
+    #: recomputed on resume.
+    _queue_sorted: bool = field(default=True, compare=False)
+
+    def __post_init__(self) -> None:
+        q = self.queue
+        self._queue_sorted = all(
+            q[i][1] <= q[i + 1][1] for i in range(len(q) - 1)
+        )
 
     def _insert(self, entries: List[_WindowQueueEntry]) -> Iterable[_WindowEvent]:
+        logics = self.logics
+        open_for = self.windower.open_for
+        builder = self.builder
         for value, timestamp in entries:
-            for window_id in self.windower.open_for(timestamp):
-                logic = self.logics.get(window_id)
+            for window_id in open_for(timestamp):
+                logic = logics.get(window_id)
                 if logic is None:
-                    logic = self.builder(None)
-                    self.logics[window_id] = logic
+                    logic = builder(None)
+                    logics[window_id] = logic
                 for w in logic.on_value(value):
                     yield (window_id, "E", w)
 
@@ -827,13 +888,30 @@ class _WindowLogic(
             yield (window_id, "M", meta)
 
     def _flush(self, watermark: datetime) -> Iterable[_WindowEvent]:
-        if self.ordered:
+        queue = self.queue
+        if not self.ordered or not queue:
+            due, self.queue = queue, []
+        elif self._queue_sorted:
+            if queue[-1][1] <= watermark:
+                due, self.queue = queue, []
+            else:
+                # Slice the due prefix (first index with ts >
+                # watermark); equal timestamps keep upstream order.
+                lo, hi = 0, len(queue)
+                while lo < hi:
+                    mid = (lo + hi) // 2
+                    if queue[mid][1] <= watermark:
+                        lo = mid + 1
+                    else:
+                        hi = mid
+                due, self.queue = queue[:lo], queue[lo:]
+        else:
             due, self.queue = partition(
-                self.queue, lambda entry: entry[1] <= watermark
+                queue, lambda entry: entry[1] <= watermark
             )
             due.sort(key=lambda entry: entry[1])
-        else:
-            due, self.queue = self.queue, []
+            if not self.queue:
+                self._queue_sorted = True
         yield from self._insert(due)
         yield from self._apply_merges()
         yield from self._apply_closes(watermark)
@@ -845,20 +923,133 @@ class _WindowLogic(
 
     def on_batch(self, values: List[V]) -> Tuple[Iterable[_WindowEvent], bool]:
         self.clock.before_batch()
+        if (
+            self.ordered
+            and not self.queue
+            and type(self.clock) is _EventClockLogic
+            # With a nonzero wait the watermark lags every timestamp,
+            # so the fast path's `ts == watermark` test can never
+            # hold — don't pay a doomed attempt per batch.
+            and self.clock.wait_for_system_duration <= ZERO_TD
+            and type(self.windower) is _SlidingWindowerLogic
+            and self.windower.offset == self.windower.length
+        ):
+            return self._on_batch_tumbling_inorder(values)
+        return self._on_batch_general(values)
+
+    def _on_batch_general(
+        self, values: List[V]
+    ) -> Tuple[Iterable[_WindowEvent], bool]:
         events: List[_WindowEvent] = []
-        watermark = self._last_watermark
-        for value in values:
-            ts, watermark = self.clock.on_item(value)
+        pairs = self.clock.on_items(values)
+        if pairs:
+            watermark = pairs[-1][1]
             assert watermark >= self._last_watermark
             self._last_watermark = watermark
-            if ts < watermark:
+        else:
+            watermark = self._last_watermark
+        queue = self.queue
+        append = queue.append
+        tail_ts = queue[-1][1] if queue else None
+        q_sorted = self._queue_sorted
+        late_for = self.windower.late_for
+        for value, (ts, wm) in zip(values, pairs):
+            if ts < wm:
                 events.extend(
-                    (window_id, "L", value)
-                    for window_id in self.windower.late_for(ts)
+                    (window_id, "L", value) for window_id in late_for(ts)
                 )
             else:
-                self.queue.append((value, ts))
+                if q_sorted and tail_ts is not None and ts < tail_ts:
+                    q_sorted = False
+                tail_ts = ts
+                append((value, ts))
+        self._queue_sorted = q_sorted
         events.extend(self._flush(watermark))
+        return (events, self._is_empty())
+
+    def _on_batch_tumbling_inorder(
+        self, values: List[V]
+    ) -> Tuple[Iterable[_WindowEvent], bool]:
+        """Fused fast path for the streaming steady state: event clock,
+        tumbling windows, ordered mode, empty queue, and every item
+        on time and in order (``ts == watermark`` after its own clock
+        update, which `_EventClockLogic` guarantees exactly for an
+        in-order stream).  One loop folds each item straight into its
+        window — no per-item tuples, queue traffic, or window-id
+        arithmetic (the current window's bounds are two datetime
+        compares).  The first item that breaks the profile (late,
+        out of order, or still ahead of the watermark under a nonzero
+        wait) falls back to the general path for the batch remainder,
+        which reproduces the exact general semantics."""
+        clock = cast(_EventClockLogic, self.clock)
+        st = clock.state
+        assert st is not None
+        now = clock._system_now
+        watermark = st.watermark_base + (now - st.system_time_of_max_event)
+        wait = clock.wait_for_system_duration
+        get = clock.ts_getter
+        windower = cast(_SlidingWindowerLogic, self.windower)
+        offset = windower.offset
+        align = windower.align_to
+        opened = windower.state.opened
+        logics = self.logics
+        builder = self.builder
+        events: List[_WindowEvent] = []
+        append_event = events.append
+        base_advanced = False
+        win_start: Optional[datetime] = None
+        win_end: Optional[datetime] = None
+        cur_wid = -1
+        cur_logic: Optional[WindowLogic] = None
+        n = len(values)
+        i = 0
+        while i < n:
+            value = values[i]
+            ts = get(value)
+            ok = True
+            try:
+                new_base = ts - wait
+            except OverflowError:
+                ok = False
+            else:
+                if new_base > watermark:
+                    watermark = new_base
+                    base_advanced = True
+                if ts != watermark:
+                    ok = False
+            if not ok:
+                break
+            if win_start is not None and win_start <= ts < win_end:
+                wid = cur_wid
+                logic = cur_logic
+            else:
+                wid = (ts - align) // offset
+                win_start = align + offset * wid
+                win_end = win_start + offset
+                if wid not in opened:
+                    opened[wid] = windower._meta_for(wid)
+                logic = logics.get(wid)
+                if logic is None:
+                    logic = builder(None)
+                    logics[wid] = logic
+                cur_wid = wid
+                cur_logic = logic
+            for w in logic.on_value(value):
+                append_event((wid, "E", w))
+            i += 1
+        # Persist clock progress before either exit so the fallback
+        # (and the next batch) sees the advanced watermark.
+        if base_advanced:
+            st.watermark_base = watermark
+            st.system_time_of_max_event = now
+        if i < n:
+            rest = values if i == 0 else values[i:]
+            rest_events, done = self._on_batch_general(rest)
+            events.extend(rest_events)
+            return (events, done)
+        if watermark > self._last_watermark:
+            self._last_watermark = watermark
+        events.extend(self._apply_closes(watermark))
         return (events, self._is_empty())
 
     def on_notify(self) -> Tuple[Iterable[_WindowEvent], bool]:
@@ -993,21 +1184,54 @@ def window(
 
     events = op.stateful_batch("stateful_batch", up, shim_builder)
 
-    def unwrap_emit(ev: _WindowEvent) -> Optional[Tuple[int, W]]:
-        window_id, typ, obj = ev
-        return (window_id, cast(W, obj)) if typ == "E" else None
+    # Batch-level taps (one comprehension per delivery, not a Python
+    # call per event): the events stream is engine-internal, so the
+    # (key, (window_id, type, obj)) shape is guaranteed.
+    def unwrap_emit(k_evs: List) -> List[Tuple[str, Tuple[int, W]]]:
+        return [
+            (k, (window_id, obj))
+            for k, (window_id, typ, obj) in k_evs
+            if typ == "E"
+        ]
 
-    def unwrap_late(ev: _WindowEvent) -> Optional[Tuple[int, V]]:
-        window_id, typ, obj = ev
-        return (window_id, cast(V, obj)) if typ == "L" else None
+    def unwrap_late(k_evs: List) -> List[Tuple[str, Tuple[int, V]]]:
+        return [
+            (k, (window_id, obj))
+            for k, (window_id, typ, obj) in k_evs
+            if typ == "L"
+        ]
 
-    def unwrap_meta(ev: _WindowEvent) -> Optional[Tuple[int, WindowMetadata]]:
-        window_id, typ, obj = ev
-        return (window_id, cast(WindowMetadata, obj)) if typ == "M" else None
+    def unwrap_meta(
+        k_evs: List,
+    ) -> List[Tuple[str, Tuple[int, WindowMetadata]]]:
+        return [
+            (k, (window_id, obj))
+            for k, (window_id, typ, obj) in k_evs
+            if typ == "M"
+        ]
 
-    downs = op.filter_map_value("unwrap_down", events, unwrap_emit)
-    lates = op.filter_map_value("unwrap_late", events, unwrap_late)
-    metas = op.filter_map_value("unwrap_meta", events, unwrap_meta)
+    # The unwrap taps are pure fan-out shims; `_prunable` lets the
+    # flatten pass drop any whose output stream is never consumed
+    # (most flows ignore `late`/`meta`, and each live tap costs a
+    # per-event Python pass).
+    downs = cast(
+        KeyedStream,
+        op.flat_map_batch(
+            "unwrap_down", events, unwrap_emit, _prunable=True
+        ),
+    )
+    lates = cast(
+        KeyedStream,
+        op.flat_map_batch(
+            "unwrap_late", events, unwrap_late, _prunable=True
+        ),
+    )
+    metas = cast(
+        KeyedStream,
+        op.flat_map_batch(
+            "unwrap_meta", events, unwrap_meta, _prunable=True
+        ),
+    )
     return WindowOut(downs, lates, metas)
 
 

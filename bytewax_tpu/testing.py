@@ -139,14 +139,23 @@ class _IterSourcePartition(StatefulSourcePartition[X, int]):
         ffwd_iter(self._it, self._start_idx)
         self._raise: Optional[Exception] = None
 
+    _SENTINELS = (TestingSource.EOF, TestingSource.ABORT, TestingSource.PAUSE)
+
     def next_batch(self) -> List[X]:
         if self._raise is not None:
             raise self._raise
         self._next_awake = None
 
         batch: List[X] = []
+        append = batch.append
+        size = self._batch_size
+        sentinels = self._SENTINELS
         for item in self._it:
-            if isinstance(item, TestingSource.EOF):
+            if not isinstance(item, sentinels):
+                append(item)
+                if len(batch) >= size:
+                    break
+            elif isinstance(item, TestingSource.EOF):
                 self._raise = StopIteration()
                 # Skip over the sentinel on continuation.
                 self._start_idx += 1
@@ -156,14 +165,10 @@ class _IterSourcePartition(StatefulSourcePartition[X, int]):
                     self._raise = AbortExecution()
                     item._triggered = True
                     break
-            elif isinstance(item, TestingSource.PAUSE):
+            else:  # PAUSE
                 now = datetime.now(tz=timezone.utc)
                 self._next_awake = now + item.for_duration
                 break
-            else:
-                batch.append(item)
-                if len(batch) >= self._batch_size:
-                    break
 
         if batch or self._raise is not None or self._next_awake is not None:
             self._start_idx += len(batch)

@@ -27,6 +27,14 @@ def force_platform(platform: str, n_devices=None) -> None:
     verified.
     """
     os.environ["JAX_PLATFORMS"] = platform
+    if platform == "cpu":
+        # The accelerator site hook (PALLAS_AXON_POOL_IPS →
+        # sitecustomize register()) dials its tunnel at *interpreter
+        # startup*, which can block every child python for minutes
+        # when the tunnel is down.  This process already paid that
+        # cost; scrub the trigger so CPU-only subprocesses (cluster
+        # spawners, probes) start instantly and deterministically.
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     if n_devices is not None:
         flags = os.environ.get("XLA_FLAGS", "")
         opt = "--xla_force_host_platform_device_count="
