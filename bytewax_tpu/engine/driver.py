@@ -27,6 +27,7 @@ import numpy as np
 
 from bytewax_tpu.dataflow import Dataflow, Operator
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
+from bytewax_tpu.errors import note_context
 from bytewax_tpu.engine.flatten import Plan, flatten
 from bytewax_tpu.engine.recovery_store import RecoveryStore, ResumeFrom
 from bytewax_tpu.engine.xla import AccelSpec, DeviceAggState, NonNumericValues
@@ -98,14 +99,19 @@ class _StepError(RuntimeError):
     reference's error chaining (``src/errors.rs``)."""
 
 
-def _reraise(step_id: str, what: str, ex: BaseException) -> None:
-    msg = f"error calling {what} in step {step_id!r}"
-    note = getattr(ex, "add_note", None)
-    if note is not None:
-        try:
-            note(msg)
-        except TypeError:
-            pass
+def _reraise(
+    step_id: str,
+    what: str,
+    ex: BaseException,
+    fn: Optional[Callable] = None,
+) -> None:
+    """Re-raise a user exception with location-tracked engine context
+    (the reference's ``src/errors.rs`` chaining): the failing step,
+    the engine call site that caught it, and — when the caller passes
+    the user callable — the def site of the code that raised."""
+    note_context(
+        ex, f"error calling {what} in step {step_id!r}", fn=fn, _depth=2
+    )
     raise ex
 
 
@@ -347,7 +353,7 @@ class _FlatMapBatchRt(_OpRt):
                 if not isinstance(out, (list, ArrayBatch)):
                     out = list(out)
             except BaseException as ex:  # noqa: BLE001
-                _reraise(self.op.step_id, "the mapper", ex)
+                _reraise(self.op.step_id, "the mapper", ex, self.mapper)
             self.emit("down", (w, out))
 
 
@@ -365,7 +371,7 @@ class _BranchRt(_OpRt):
                 try:
                     keep = self.predicate(item)
                 except BaseException as ex:  # noqa: BLE001
-                    _reraise(self.op.step_id, "the predicate", ex)
+                    _reraise(self.op.step_id, "the predicate", ex, self.predicate)
                 (trues if keep else falses).append(item)
             self.emit("trues", (w, trues))
             self.emit("falses", (w, falses))
@@ -443,7 +449,7 @@ class _InspectDebugRt(_OpRt):
                 try:
                     self.inspector(self.op.step_id, item, epoch, w)
                 except BaseException as ex:  # noqa: BLE001
-                    _reraise(self.op.step_id, "the inspector", ex)
+                    _reraise(self.op.step_id, "the inspector", ex, self.inspector)
             self.emit("down", (w, items))
 
 
@@ -512,7 +518,7 @@ class _StatefulBatchRt(_OpRt):
         try:
             return self.builder(state)
         except BaseException as ex:  # noqa: BLE001
-            _reraise(self.op.step_id, "the logic builder", ex)
+            _reraise(self.op.step_id, "the logic builder", ex, self.builder)
 
     def _resched(self, key: str, logic: Any) -> None:
         try:

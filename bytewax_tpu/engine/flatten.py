@@ -202,19 +202,16 @@ class Plan:
         return [s.stream_id for s in op.up_streams()]
 
 
-def _walk(op: Operator, plan: Plan, prunable: bool = False) -> None:
+def _walk(op: Operator, plan: Plan) -> None:
     if op.core:
         if op.name not in CORE_OPS:
             msg = f"unknown core operator {op.name!r} at {op.step_id!r}"
             raise DataflowError(msg)
-        if prunable:
-            op.conf["_prunable"] = True
         plan.ops.append(op)
     else:
         _annotate_accel(op)
-        prunable = prunable or bool(op.conf.get("_prunable"))
         for sub in op.substeps:
-            _walk(sub, plan, prunable)
+            _walk(sub, plan)
 
 
 def _index(plan: Plan) -> None:

@@ -516,6 +516,7 @@ def stateful(
     def shim_builder(resume_state: Optional[S]) -> _StatefulShim[V, W, S]:
         return _StatefulShim(builder, builder(resume_state))
 
+    shim_builder.__wrapped__ = builder
     return stateful_batch("stateful_batch", up, shim_builder)
 
 
@@ -550,6 +551,7 @@ def flat_map(
     def shim_mapper(xs: List[X]) -> Iterable[Y]:
         return itertools.chain.from_iterable(mapper(x) for x in xs)
 
+    shim_mapper.__wrapped__ = mapper
     return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
@@ -584,6 +586,7 @@ def flat_map_value(
                 out.append((k, w))
         return out
 
+    shim_mapper.__wrapped__ = mapper
     return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
@@ -662,6 +665,7 @@ def filter(  # noqa: A001
                 out.append(x)
         return out
 
+    shim_mapper.__wrapped__ = predicate
     return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
@@ -704,6 +708,7 @@ def filter_value(
                 out.append(k_v)
         return out
 
+    shim_mapper.__wrapped__ = predicate
     return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
@@ -738,6 +743,7 @@ def filter_map(
                 out.append(y)
         return out
 
+    shim_mapper.__wrapped__ = mapper
     return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
@@ -773,6 +779,7 @@ def filter_map_value(
                 out.append((k, w))
         return out
 
+    shim_mapper.__wrapped__ = mapper
     return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
@@ -805,6 +812,7 @@ def inspect(
     ) -> None:
         inspector(step_id, item)
 
+    shim_inspector.__wrapped__ = inspector
     return inspect_debug("inspect_debug", up, shim_inspector)
 
 
@@ -840,6 +848,7 @@ def key_on(step_id: str, up: Stream[X], key: Callable[[X], str]) -> KeyedStream[
             out.append((k, x))
         return out
 
+    shim_mapper.__wrapped__ = key
     return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
@@ -894,6 +903,7 @@ def map(  # noqa: A001
     def shim_mapper(xs: List[X]) -> Iterable[Y]:
         return [mapper(x) for x in xs]
 
+    shim_mapper.__wrapped__ = mapper
     return flat_map_batch("flat_map_batch", up, shim_mapper)
 
 
@@ -934,6 +944,7 @@ def map_value(
     def shim_batch(k_vs: List[Tuple[str, V]]) -> List[Tuple[str, W]]:
         return [shim_mapper(k_v) for k_v in k_vs]
 
+    shim_batch.__wrapped__ = mapper
     return flat_map_batch("flat_map_batch", up, shim_batch)
 
 

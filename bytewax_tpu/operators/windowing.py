@@ -283,7 +283,7 @@ class _EventClockLogic(ClockLogic[V, _EventClockState]):
         st = self.state
         assert st is not None
         now = self._system_now
-        watermark = st.watermark_base + (now - st.system_time_of_max_event)
+        watermark = self._watermark()
         wait = self.wait_for_system_duration
         get = self.ts_getter
         out: List[Tuple[datetime, datetime]] = []
@@ -927,10 +927,11 @@ class _WindowLogic(
             self.ordered
             and not self.queue
             and type(self.clock) is _EventClockLogic
-            # With a nonzero wait the watermark lags every timestamp,
-            # so the fast path's `ts == watermark` test can never
-            # hold — don't pay a doomed attempt per batch.
-            and self.clock.wait_for_system_duration <= ZERO_TD
+            # With any nonzero wait (either sign) the watermark is
+            # offset from every timestamp, so the fast path's
+            # `ts == watermark` test can never hold — don't pay a
+            # doomed attempt per batch.
+            and self.clock.wait_for_system_duration == ZERO_TD
             and type(self.windower) is _SlidingWindowerLogic
             and self.windower.offset == self.windower.length
         ):
@@ -985,7 +986,7 @@ class _WindowLogic(
         st = clock.state
         assert st is not None
         now = clock._system_now
-        watermark = st.watermark_base + (now - st.system_time_of_max_event)
+        watermark = clock._watermark()
         wait = clock.wait_for_system_duration
         get = clock.ts_getter
         windower = cast(_SlidingWindowerLogic, self.windower)

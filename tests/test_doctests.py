@@ -14,6 +14,7 @@ MODULES = [
     "bytewax_tpu.inputs",
     "bytewax_tpu.outputs",
     "bytewax_tpu.xla",
+    "bytewax_tpu.errors",
     "bytewax_tpu.connectors.demo",
     "bytewax_tpu.connectors.files",
     "bytewax_tpu.connectors.kafka",

@@ -1,0 +1,71 @@
+"""Location-tracked error chaining (the analog of the reference's
+``src/errors.rs`` ``PythonException`` trait: every engine layer that
+catches a user exception tags it with its own location and context)."""
+
+import pytest
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.errors import callable_location, note_context
+from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+
+def _boom(x):
+    raise ValueError("boom")
+
+
+def test_mapper_error_carries_step_and_callable_location():
+    flow = Dataflow("errflow")
+    s = op.input("inp", flow, TestingSource([1]))
+    s = op.map("bad", s, _boom)
+    op.output("out", s, TestingSink([]))
+    with pytest.raises(ValueError, match="boom") as exc_info:
+        run_main(flow)
+    notes = "\n".join(getattr(exc_info.value, "__notes__", []))
+    # The failing step, fully qualified.
+    assert "'errflow.bad" in notes
+    # The engine call site that caught it (track_caller analog).
+    assert "engine at" in notes and "driver.py:" in notes
+    # The def site of the user callable that raised.
+    assert "user callable defined at" in notes
+    assert "test_errors.py" in notes
+
+
+def test_logic_builder_error_carries_context():
+    def bad_builder(_resume):
+        raise RuntimeError("cannot build")
+
+    flow = Dataflow("errflow2")
+    s = op.input("inp", flow, TestingSource([("k", 1)]))
+    s = op.stateful_batch("st", s, bad_builder)
+    op.output("out", s, TestingSink([]))
+    with pytest.raises(RuntimeError, match="cannot build") as exc_info:
+        run_main(flow)
+    notes = "\n".join(getattr(exc_info.value, "__notes__", []))
+    assert "the logic builder" in notes and "'errflow2.st" in notes
+    assert "user callable defined at" in notes
+
+
+def test_callable_location_shapes():
+    import functools
+
+    assert callable_location(_boom).endswith(
+        f":{_boom.__code__.co_firstlineno}"
+    )
+    part = functools.partial(_boom, 1)
+    assert callable_location(part) == callable_location(_boom)
+
+    class _CallableObj:
+        def __call__(self):
+            pass
+
+    assert callable_location(_CallableObj()) is not None
+    assert callable_location(len) is None  # builtins have no code
+
+
+def test_note_context_is_safe_on_any_exception():
+    ex = ValueError("x")
+    note_context(ex, "ctx", fn=_boom)
+    notes = getattr(ex, "__notes__", [])
+    assert any("ctx (engine at" in n for n in notes)
+    assert any("user callable defined at" in n for n in notes)

@@ -123,9 +123,10 @@ def main() -> int:
         except BaseException as ex:  # noqa: BLE001
             entry[name] = None
             entry[f"{name}_error"] = f"{type(ex).__name__}: {ex}"[:200]
-        # Persist incrementally: a tunnel death mid-suite must not
-        # lose the sub-benchmarks that already ran.
-        _append(dict(entry))
+        # Persist incrementally (tagged partial) so a tunnel death
+        # mid-suite can't lose the sub-benchmarks that already ran;
+        # the one untagged line per attempt is the final summary.
+        _append(dict(entry, partial=True))
 
     batch = 1 << 20
     bench._run_columnar(batch, batch)  # warm compile
@@ -174,6 +175,7 @@ def main() -> int:
         lambda: round(bench._device_step_ms()[0], 3),
     )
     capture("pallas_vs_scatter", _pallas_vs_scatter)
+    _append(dict(entry))  # final summary line (no `partial` tag)
     return 0
 
 
