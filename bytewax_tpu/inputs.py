@@ -12,6 +12,7 @@ import itertools
 from abc import ABC, abstractmethod
 from datetime import datetime, timedelta, timezone
 from typing import (
+    Any,
     AsyncIterator,
     Callable,
     Generic,
@@ -230,15 +231,17 @@ class DynamicSource(Source[X]):
         ...
 
 
-class _SimplePollingPartition(StatefulSourcePartition[X, None]):
+class _SimplePollingPartition(StatefulSourcePartition[X, Any]):
     def __init__(
         self,
         interval: timedelta,
         align_to: Optional[datetime],
         getter: Callable[[], Optional[X]],
+        snapshotter: Callable[[], Any],
     ):
         self._interval = interval
         self._getter = getter
+        self._snapshotter = snapshotter
         now = datetime.now(timezone.utc)
         if align_to is not None and align_to > now:
             self._next_awake = align_to
@@ -263,11 +266,11 @@ class _SimplePollingPartition(StatefulSourcePartition[X, None]):
     def next_awake(self) -> Optional[datetime]:
         return self._next_awake
 
-    def snapshot(self) -> None:
-        return None
+    def snapshot(self) -> Any:
+        return self._snapshotter()
 
 
-class SimplePollingSource(FixedPartitionedSource[X, None]):
+class SimplePollingSource(FixedPartitionedSource[X, Any]):
     """Calls a user-defined function at a regular interval.
 
     Subclass and implement :meth:`next_item`.  Raise
@@ -310,16 +313,33 @@ class SimplePollingSource(FixedPartitionedSource[X, None]):
         return ["singleton"]
 
     def build_part(
-        self, step_id: str, for_part: str, resume_state: Optional[None]
+        self, step_id: str, for_part: str, resume_state: Optional[Any]
     ) -> _SimplePollingPartition[X]:
+        if resume_state is not None:
+            self.resume(resume_state)
         return _SimplePollingPartition(
-            self._interval, self._align_to, self.next_item
+            self._interval, self._align_to, self.next_item, self.snapshot
         )
 
     @abstractmethod
     def next_item(self) -> Optional[X]:
         """Fetch the next item; return ``None`` if nothing new."""
         ...
+
+    def snapshot(self) -> Any:
+        """Snapshot the position of the next read (returned to
+        :meth:`resume` on the next execution).  Return a state that
+        resumes reading *after* the last emitted item.  Defaults to
+        ``None`` (stateless polling)."""
+        return None
+
+    def resume(self, resume_state: Any) -> None:
+        """Reset the position of the next read; called once before
+        :meth:`next_item` when this execution is a resume.
+
+        Reference parity: ``inputs.py:443``.
+        """
+        return None
 
 
 def batch(ib: Iterable[X], batch_size: int) -> Iterator[List[X]]:

@@ -23,6 +23,8 @@ __all__ = [
     "to_json",
     "to_mermaid",
     "to_plan",
+    "to_plantuml",
+    "to_rendered",
 ]
 
 
@@ -124,6 +126,19 @@ def to_json(flow: Dataflow) -> str:
     return json.dumps(asdict(render_dataflow(flow)), indent=2)
 
 
+def _owner_of(component_ids: List[str], stream_id: str) -> str:
+    """Resolve the step that owns ``stream_id``: the longest component
+    id that is a dotted prefix of it (a stream produced by a nested
+    substep belongs to the innermost rendered component)."""
+    best = ""
+    for step_id in component_ids:
+        if (
+            stream_id == step_id or stream_id.startswith(step_id + ".")
+        ) and len(step_id) > len(best):
+            best = step_id
+    return best or stream_id.rsplit(".", 1)[0]
+
+
 def to_mermaid(flow: Dataflow) -> str:
     """Render the top level of a dataflow as a Mermaid graph.
 
@@ -145,20 +160,12 @@ def to_mermaid(flow: Dataflow) -> str:
     rendered = render_dataflow(flow)
     top_ids = [op.step_id for op in rendered.substeps]
 
-    def owner_of(stream_id: str) -> str:
-        # A stream produced by a nested substep belongs to the
-        # top-level operator whose id is a dotted prefix of it.
-        for step_id in top_ids:
-            if stream_id == step_id or stream_id.startswith(step_id + "."):
-                return step_id
-        return stream_id.rsplit(".", 1)[0]
-
     lines = ["flowchart TD", f'subgraph "{rendered.flow_id} (Dataflow)"']
     for op in rendered.substeps:
         lines.append(f'{op.step_id}["{op.op_type} ({op.step_id})"]')
         for port in op.inp_ports:
             for sid in port.from_stream_ids:
-                lines.append(f"{owner_of(sid)} --> {op.step_id}")
+                lines.append(f"{_owner_of(top_ids, sid)} --> {op.step_id}")
     lines.append("end")
     return "\n".join(lines)
 
@@ -203,6 +210,63 @@ def to_plan(flow: Dataflow) -> Dict[str, Any]:
     }
 
 
+def to_rendered(flow: Dataflow) -> RenderedDataflow:
+    """Alias of :func:`render_dataflow` (reference API name,
+    ``visualize.py:119``)."""
+    return render_dataflow(flow)
+
+
+def to_plantuml(flow: Dataflow, recursive: bool = False) -> str:
+    """Render a dataflow as a PlantUML component diagram
+    (reference parity: ``visualize.py:252``).
+
+    :arg recursive: Also show nested substeps as nested components.
+
+    >>> import bytewax_tpu.operators as op
+    >>> from bytewax_tpu.dataflow import Dataflow
+    >>> from bytewax_tpu.testing import TestingSink, TestingSource
+    >>> from bytewax_tpu.visualize import to_plantuml
+    >>> flow = Dataflow("viz")
+    >>> s = op.input("inp", flow, TestingSource([1]))
+    >>> op.output("out", s, TestingSink([]))
+    >>> print(to_plantuml(flow))
+    @startuml
+    component "input (viz.inp)" as viz.inp
+    component "output (viz.out)" as viz.out
+    viz.inp --> viz.out
+    @enduml
+    """
+    rendered = render_dataflow(flow)
+    shown: List[RenderedOperator] = []
+
+    def emit(op: RenderedOperator, depth: int) -> List[str]:
+        shown.append(op)
+        pad = "  " * depth
+        lines = [f'{pad}component "{op.op_type} ({op.step_id})" as {op.step_id}']
+        if recursive and op.substeps:
+            lines[-1] += " {"
+            for sub in op.substeps:
+                lines.extend(emit(sub, depth + 1))
+            lines.append(f"{pad}}}")
+        return lines
+
+    lines = ["@startuml"]
+    for op in rendered.substeps:
+        lines.extend(emit(op, 0))
+    # Wire every shown component (nested included when recursive);
+    # each edge source resolves to the innermost shown component that
+    # produced the stream.
+    shown_ids = [op.step_id for op in shown]
+    for op in shown:
+        for port in op.inp_ports:
+            for sid in port.from_stream_ids:
+                src = _owner_of(shown_ids, sid)
+                if src != op.step_id:
+                    lines.append(f"{src} --> {op.step_id}")
+    lines.append("@enduml")
+    return "\n".join(lines)
+
+
 def _main() -> None:
     from bytewax_tpu.run import _locate_dataflow, _prepare_import
 
@@ -213,8 +277,13 @@ def _main() -> None:
     parser.add_argument("import_str", type=str)
     parser.add_argument(
         "--format",
-        choices=["json", "mermaid", "plan"],
+        choices=["json", "mermaid", "plantuml", "plan"],
         default="mermaid",
+    )
+    parser.add_argument(
+        "--recursive",
+        action="store_true",
+        help="show nested substeps (plantuml only)",
     )
     args = parser.parse_args()
     module_str, dataflow_name = _prepare_import(args.import_str)
@@ -223,6 +292,8 @@ def _main() -> None:
         print(to_json(flow))
     elif args.format == "plan":
         print(json.dumps(to_plan(flow), indent=2))
+    elif args.format == "plantuml":
+        print(to_plantuml(flow, recursive=args.recursive))
     else:
         print(to_mermaid(flow))
 
