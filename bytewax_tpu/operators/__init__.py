@@ -444,17 +444,24 @@ class _StatefulShim(StatefulBatchLogic[V, W, S]):
 
     def on_batch(self, values: List[V]) -> Tuple[Iterable[W], bool]:
         emits: List[W] = []
+        extend = emits.extend
+        builder = self.builder
+        logic = self.logic
         for v in values:
             # A mid-batch discard must not drop the remaining values
             # for the key: rebuild fresh logic and keep going (the
             # reference does the same: operators/__init__.py:1030-1042).
-            if self.logic is None:
-                self.logic = self.builder(None)
-            vs, is_complete = self.logic.on_item(v)
-            emits.extend(vs)
+            if logic is None:
+                logic = builder(None)
+            vs, is_complete = logic.on_item(v)
+            # Identity check, not truthiness: `vs` may be any
+            # iterable (a numpy array is ambiguous under bool()).
+            if vs is not _EMPTY:
+                extend(vs)
             if is_complete:
-                self.logic = None
-        if self.logic is None:
+                logic = None
+        self.logic = logic
+        if logic is None:
             return (emits, StatefulBatchLogic.DISCARD)
         return (emits, StatefulBatchLogic.RETAIN)
 

@@ -208,9 +208,9 @@ def stats_final(
     device state.
     """
     import bytewax_tpu.operators as op
-    from bytewax_tpu.operators import StatefulLogic
+    from bytewax_tpu.operators import StatefulBatchLogic
 
-    class _StatsLogic(StatefulLogic):
+    class _StatsBatchLogic(StatefulBatchLogic):
         def __init__(self, state: Optional[tuple]):
             if state is None:
                 self.s = _StatsState(float("inf"), float("-inf"), 0.0, 0)
@@ -218,27 +218,56 @@ def stats_final(
                 mn, mx, total, count = state
                 self.s = _StatsState(mn, mx, total, count)
 
-        def on_item(self, v):
+        def on_batch(self, values):
+            # Fold the whole key-batch with C-speed builtins; the
+            # up-front float() comprehension keeps the per-item
+            # coercion semantics (numeric strings fold, junk raises).
+            fv = [float(v) for v in values]
             s = self.s
-            v = float(v)
-            if v < s.mn:
-                s.mn = v
-            if v > s.mx:
-                s.mx = v
-            s.total += v
-            s.count += 1
-            return ((), StatefulLogic.RETAIN)
+            mn = min(fv)
+            mx = max(fv)
+            if mn == mn and mx == mx:
+                if mn < s.mn:
+                    s.mn = mn
+                if mx > s.mx:
+                    s.mx = mx
+            else:
+                # A NaN poisoned the builtins (min/max return NaN
+                # when it leads).  Per-item comparisons reproduce the
+                # per-item fold exactly: NaN never wins a comparison,
+                # real values still update the extrema.
+                for v in fv:
+                    if v < s.mn:
+                        s.mn = v
+                    if v > s.mx:
+                        s.mx = v
+            s.total += sum(fv)
+            s.count += len(fv)
+            return ((), StatefulBatchLogic.RETAIN)
 
         def on_eof(self):
             s = self.s
             mean = s.total / s.count if s.count else 0.0
-            return (((s.mn, mean, s.mx, s.count),), StatefulLogic.DISCARD)
+            return (
+                ((s.mn, mean, s.mx, s.count),),
+                StatefulBatchLogic.DISCARD,
+            )
 
         def snapshot(self):
             s = self.s
             return (s.mn, s.mx, s.total, s.count)
 
     def shim_builder(resume_state):
-        return _StatsLogic(resume_state)
+        return _StatsBatchLogic(resume_state)
 
-    return op.stateful("stateful", up, shim_builder)
+    # Nest the core step under a "stateful" scope so the flattened
+    # step id (...<step>.stateful.stateful_batch) is unchanged from
+    # the per-item implementation this replaced — snapshots in
+    # existing recovery stores keep resolving.
+    from bytewax_tpu.dataflow import operator as _operator
+
+    @_operator
+    def stateful(step_id: str, up: KeyedStream) -> KeyedStream:
+        return op.stateful_batch("stateful_batch", up, shim_builder)
+
+    return stateful("stateful", up)
