@@ -187,6 +187,13 @@ class KeyEncoder:
 
     __slots__ = ("_sorted", "_ids")
 
+    #: With at most this many seen keys, an over-wide incoming column
+    #: is searched as-is (numpy string comparison is width-aware, so
+    #: mixed-width searchsorted is exact) instead of paying the
+    #: O(rows × width) narrowing scan+copy per batch — the search is
+    #: so shallow that wide compares are cheaper than narrowing.
+    _WIDE_SEARCH_MAX_KEYS = 16
+
     def __init__(self):
         self._sorted: Optional[np.ndarray] = None  # seen keys, sorted
         self._ids: Optional[np.ndarray] = None  # internal id per entry
@@ -200,8 +207,13 @@ class KeyEncoder:
             if keys.dtype.kind in "SU":
                 # pandas hands uniques back as objects; keep the seen
                 # set in the column's fixed-width dtype so the steady
-                # state compares raw buffers, not PyObjects.
-                uniq = np.asarray(uniq).astype(keys.dtype.kind)
+                # state compares raw buffers, not PyObjects.  Narrow
+                # it (cheap on the small unique set) so steady-state
+                # searches stay at true key width even when the
+                # producer's column was over-wide.
+                uniq = self._narrowed(
+                    np.asarray(uniq).astype(keys.dtype.kind)
+                )
             self._merge(np.asarray(uniq), ids)
         return ids[codes]
 
@@ -262,20 +274,35 @@ class KeyEncoder:
             # Never install from an empty batch: its dtype kind is
             # arbitrary and would poison the steady-state fast path.
             return np.empty(0, dtype=np.int64)
-        keys = self._narrowed(keys)
-        if self._sorted is None:
-            return self._cold(keys, alloc_many, install=True)
-        if self._sorted.dtype.kind != keys.dtype.kind:
-            # A producer switching between str/bytes/object columns:
-            # stay correct without cross-kind comparisons (slow path
-            # every batch, but mixed-kind feeds are already odd).
-            return self._cold(keys, alloc_many, install=False)
+        if (
+            self._sorted is not None
+            and keys.dtype.kind in "SU"
+            and keys.dtype.kind == self._sorted.dtype.kind
+            and keys.dtype.itemsize > self._sorted.dtype.itemsize
+            and len(self._sorted) <= self._WIDE_SEARCH_MAX_KEYS
+        ):
+            # Few keys, over-wide column: skip the narrowing pass and
+            # search the (narrow) seen set with the wide keys
+            # directly — numpy's width-aware comparison keeps this
+            # exact.
+            probe = self._sorted
+        else:
+            keys = self._narrowed(keys)
+            probe = self._sorted
+            if probe is None:
+                return self._cold(keys, alloc_many, install=True)
+            if probe.dtype.kind != keys.dtype.kind:
+                # A producer switching between str/bytes/object
+                # columns: stay correct without cross-kind
+                # comparisons (slow path every batch, but mixed-kind
+                # feeds are already odd).
+                return self._cold(keys, alloc_many, install=False)
         # Membership via left/right insertion points: present keys
         # have right > left (and left is then the exact index).  Two
         # binary searches beat one search plus a per-row gather+
         # compare — the gather materializes a wide string array.
-        lo = np.searchsorted(self._sorted, keys, side="left")
-        hit = np.searchsorted(self._sorted, keys, side="right") > lo
+        lo = np.searchsorted(probe, keys, side="left")
+        hit = np.searchsorted(probe, keys, side="right") > lo
         if hit.all():
             return self._ids[lo]
         out = np.empty(len(keys), dtype=np.int64)

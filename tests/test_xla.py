@@ -322,3 +322,42 @@ def test_key_encoder_empty_first_batch():
     # Steady state: no allocs for seen keys.
     ids2 = enc.encode(np.array(["b", "a"]), lambda ks: 1 / 0)
     assert ids2.tolist() == [11, 10]
+
+
+def test_key_encoder_wide_column_fast_path():
+    """With few seen keys, an over-wide string column is searched
+    as-is (no per-batch narrowing); prefix collisions and misses stay
+    exact across widths."""
+    from bytewax_tpu.engine.arrays import KeyEncoder
+
+    enc = KeyEncoder()
+    next_id = iter(range(100))
+    alloc = lambda ks: [next(next_id) for _ in ks]  # noqa: E731
+
+    ids = enc.encode(np.array(["a", "b"], dtype="U1"), alloc)
+    assert ids.tolist() == [0, 1]
+    assert enc._sorted.dtype.itemsize // 4 == 1  # stored narrow
+
+    # Over-wide batch (U8): hits map to the same ids; "ab" must MISS
+    # (no truncation against the narrow "a") and get a fresh id.
+    wide = np.array(["b", "ab", "a"], dtype="U8")
+    ids2 = enc.encode(wide, alloc)
+    assert ids2.tolist() == [1, 2, 0]
+    # The miss installed narrowed: the seen set stays at true width.
+    assert enc._sorted.dtype.itemsize // 4 == 2
+    # Steady state over wide columns: no allocs.
+    ids3 = enc.encode(np.array(["ab", "a", "b"], dtype="U21"), lambda ks: 1 / 0)
+    assert ids3.tolist() == [2, 0, 1]
+
+
+def test_key_encoder_many_keys_still_narrow():
+    """Above the wide-search threshold the narrowing path still runs
+    (deep searches at full width would be slower) and stays exact."""
+    from bytewax_tpu.engine.arrays import KeyEncoder
+
+    enc = KeyEncoder()
+    keys = np.array([f"k{i}" for i in range(40)])
+    ids = enc.encode(keys, lambda ks: list(range(len(ks))))
+    wide = keys.astype("U30")
+    ids2 = enc.encode(wide, lambda ks: 1 / 0)
+    assert ids2.tolist() == ids.tolist()
