@@ -423,6 +423,35 @@ def _run_wordcount(n_lines: int, words_per_line: int = 10) -> float:
     return n_lines * words_per_line / dt
 
 
+# -- anomaly detector --------------------------------------------------------
+
+
+def _run_anomaly(n_rows: int, n_keys: int = 50) -> float:
+    """Per-key rolling z-score via stateful_map (reference:
+    examples/anomaly_detector.py) — the per-item stateful hot path;
+    returns events/sec."""
+    import numpy as np
+
+    from bytewax_tpu.models.anomaly import anomaly_flow
+    from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+    rng = np.random.RandomState(3)
+    keys = [f"sensor_{i:02d}" for i in range(n_keys)]
+    inp = list(
+        zip(
+            (keys[i] for i in rng.randint(0, n_keys, size=n_rows)),
+            rng.randn(n_rows).tolist(),
+        )
+    )
+    out = []
+    flow = anomaly_flow(TestingSource(inp, batch_size=10_000), TestingSink(out))
+    t0 = time.perf_counter()
+    run_main(flow)
+    dt = time.perf_counter() - t0
+    assert len(out) == n_rows
+    return n_rows / dt
+
+
 # -- isolated device step ----------------------------------------------------
 
 
@@ -525,6 +554,7 @@ def main() -> None:
     )
     p99_s, n_closes = _run_window_close_p99()
     wc_rate = _run_wordcount(50_000)
+    anomaly_rate = _run_anomaly(500_000)
     step_ms, sharded_ms = _device_step_ms()
 
     extra = {
@@ -539,6 +569,7 @@ def main() -> None:
         ),
         "window_closes_measured": n_closes,
         "wordcount_events_per_sec": round(wc_rate),
+        "anomaly_events_per_sec": round(anomaly_rate),
         "device_step_1m_rows_ms": round(step_ms, 3),
         "host_events_per_sec": round(host_rate),
     }

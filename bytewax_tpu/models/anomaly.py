@@ -40,10 +40,14 @@ def _update(
 def anomaly_flow(source, sink: Sink, threshold: float = 3.0) -> Dataflow:
     """Items are ``(key, value)``; emits ``(key, (value, zscore,
     is_anomaly))`` per item with per-key online mean/variance state."""
+    import functools
+
     flow = Dataflow("anomaly_detector")
     s = op.input("inp", flow, source)
+    # functools.partial dispatches at C speed — this mapper runs once
+    # per item.
     scored = op.stateful_map(
-        "zscore", s, lambda st, v: _update(st, v, threshold)
+        "zscore", s, functools.partial(_update, threshold=threshold)
     )
     op.output("out", scored, sink)
     return flow
