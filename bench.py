@@ -23,6 +23,22 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def _enable_compile_cache() -> None:
+    """Persist XLA compilations across processes: tunnel-attached TPU
+    compiles run 20-40s each, and without this every bench run repays
+    every shape."""
+    try:
+        import jax
+
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 - cache is best-effort
+        pass
+
+
 def _log_probe(ok: bool, platform: str, reason: str) -> None:
     """Append the probe attempt to TPU_PROBELOG.jsonl so a CPU
     fallback always comes with evidence of how hard the chip was
@@ -511,6 +527,11 @@ def main() -> None:
         # never masquerade as a chip figure.
         os.environ["BYTEWAX_TPU_PLATFORM"] = "cpu"
         backend = "cpu"
+    # Only after the probe decided (and the fallback forced a
+    # backend) is importing jax in this process safe — a dead tunnel
+    # hangs jax init, which is the whole reason the probe runs in a
+    # subprocess with a timeout.
+    _enable_compile_cache()
 
     batch_rows = 1 << 20  # 1M-row micro-batches
 

@@ -508,7 +508,16 @@ class _SlidingWindowerLogic(WindowerLogic[_SlidingWindowerState]):
         return ids
 
     def late_for(self, timestamp: datetime) -> List[int]:
-        return self.intersecting_ids(timestamp)
+        # Shares open_for's one-element memo: the ids are pure
+        # arithmetic on the timestamp, so the same entry serves both
+        # (late replays carry runs of equal second-granularity
+        # timestamps just like on-time streams do).
+        if timestamp == self._memo_ts:
+            return list(self._memo_ids)
+        ids = self.intersecting_ids(timestamp)
+        self._memo_ts = timestamp
+        self._memo_ids = list(ids)
+        return ids
 
     def merged(self) -> Iterable[Tuple[int, int]]:
         return _EMPTY
@@ -951,14 +960,25 @@ class _WindowLogic(
             watermark = self._last_watermark
         queue = self.queue
         append = queue.append
+        append_event = events.append
         tail_ts = queue[-1][1] if queue else None
         q_sorted = self._queue_sorted
         late_for = self.windower.late_for
         for value, (ts, wm) in zip(values, pairs):
             if ts < wm:
-                events.extend(
-                    (window_id, "L", value) for window_id in late_for(ts)
-                )
+                # Direct append for the common single-window case: a
+                # late replay is per-item territory, so the genexpr
+                # frame per item dominates it.  `late_for` is only
+                # promised to be Iterable — materialize generators.
+                wids = late_for(ts)
+                if not isinstance(wids, (list, tuple)):
+                    wids = list(wids)
+                if len(wids) == 1:
+                    append_event((wids[0], "L", value))
+                else:
+                    events.extend(
+                        (window_id, "L", value) for window_id in wids
+                    )
             else:
                 if q_sorted and tail_ts is not None and ts < tail_ts:
                     q_sorted = False

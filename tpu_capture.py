@@ -112,6 +112,8 @@ def main() -> int:
     if not backend:
         _append({"ok": False, "reason": "accelerator unreachable"})
         return 1
+    # Safe to touch jax only after the probe saw a live accelerator.
+    bench._enable_compile_cache()
 
     entry = {"ok": True, "backend": backend}
 
