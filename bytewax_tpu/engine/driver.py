@@ -39,6 +39,8 @@ from bytewax_tpu.inputs import (
 from bytewax_tpu.native import (
     bucket_adler as _native_bucket_adler,
     group_kv as _native_group_kv,
+    scan_emit as _native_scan_emit,
+    scan_fill_values as _native_scan_fill,
 )
 from bytewax_tpu.tracing import span as _span, spans_active as _spans_active
 from bytewax_tpu.outputs import DynamicSink, FixedPartitionedSink
@@ -474,8 +476,10 @@ class _StatefulBatchRt(_OpRt):
         # lowering pass; same snapshots, same EOF emission order).
         self.agg: Optional[DeviceAggState] = None
         self.wagg = None
+        self.sagg = None
         spec = op.conf.get("_accel")
         if driver.accel:
+            from bytewax_tpu.engine.scan_accel import ScanAccelSpec
             from bytewax_tpu.engine.window_accel import WindowAccelSpec
 
             if isinstance(spec, AccelSpec):
@@ -488,6 +492,10 @@ class _StatefulBatchRt(_OpRt):
                 # Sliding/tumbling or session device windower, per
                 # the spec subtype.
                 self.wagg = spec.make_state()
+            elif isinstance(spec, ScanAccelSpec):
+                # Per-row-emitting stateful_map lowering (segmented
+                # device scan over per-key numeric state).
+                self.sagg = spec.make_state()
         # Stream resumed states in store pages (never materialize the
         # whole keyed state as one dict — reference pages its resume
         # reads too, src/recovery.rs:817-882).  Device agg state
@@ -497,13 +505,14 @@ class _StatefulBatchRt(_OpRt):
         # (fold_final etc.) firing even with no new input (reference:
         # src/operators.rs:976-1006).
         page: List[Tuple[str, Any]] = []
+        pager = self.agg if self.agg is not None else self.sagg
         for key, state in driver.iter_resume_states(op.step_id):
             if not driver.is_local(_route_hash(key) % driver.worker_count):
                 continue
-            if self.agg is not None:
+            if pager is not None:
                 page.append((key, state))
                 if len(page) >= 4096:
-                    self.agg.load_many(page)
+                    pager.load_many(page)
                     page = []
             elif self.wagg is not None:
                 self.wagg.load(key, state)
@@ -512,7 +521,7 @@ class _StatefulBatchRt(_OpRt):
                 self.logics[key] = logic
                 self._resched(key, logic)
         if page:
-            self.agg.load_many(page)
+            pager.load_many(page)
 
     def _build(self, state: Optional[Any]) -> Any:
         try:
@@ -721,6 +730,9 @@ class _StatefulBatchRt(_OpRt):
         if self.agg is not None:
             self._process_accel(entries)
             return
+        if self.sagg is not None:
+            self._process_scan_accel(entries)
+            return
         out: Dict[int, List[Any]] = {}
         for _w, items in entries:
             if isinstance(items, ArrayBatch):
@@ -787,6 +799,81 @@ class _StatefulBatchRt(_OpRt):
                 _reraise(self.op.step_id, "the device aggregation", ex)
             self.awoken.update(touched)
 
+    def _process_scan_accel(self, entries: List[Entry]) -> None:
+        assert self.sagg is not None
+        for i, (_w, items) in enumerate(entries):
+            try:
+                with self._timer("stateful_batch_on_batch").time():
+                    res = self._scan_batch(items)
+            except NonNumericValues as ex:
+                if not self.sagg.keys() and not self.logics:
+                    # Rows the device scan can't take (non-numeric
+                    # values, malformed tuples): permanently fall
+                    # back to the host tier before any device state
+                    # exists — it re-runs the mapper per item and
+                    # raises the step-qualified errors.
+                    self.sagg = None
+                    self.process("up", entries[i:])
+                    return
+                _reraise(self.op.step_id, "the device scan", ex)
+            except TypeError as ex:
+                _reraise(self.op.step_id, "the device scan", ex)
+            if res is None:
+                continue
+            touched, out_items, uniq, codes = res
+            self.awoken.update(touched)
+            self._emit_scan(out_items, uniq, codes)
+
+    def _scan_batch(self, items: Any):
+        """One delivery through the device scan; returns ``(touched,
+        out_items, uniq_keys, per-row group codes)`` or None for an
+        empty delivery.  Raises NonNumericValues when the rows can't
+        ride the device tier."""
+        sagg = self.sagg
+        if isinstance(items, ArrayBatch):
+            touched, emit = sagg.update_batch(items)
+            return touched, emit.items(), emit.uniq, emit.codes
+        if not items:
+            return None
+        if type(items) is list:
+            try:
+                groups = _native_group_kv(items)
+            except TypeError as ex:
+                raise NonNumericValues(str(ex)) from ex
+            if groups is not None:
+                vals = np.empty(len(items), dtype=np.float64)
+                try:
+                    lens = _native_scan_fill(groups, vals)
+                except TypeError as ex:
+                    raise NonNumericValues(str(ex)) from ex
+                uniq = list(groups)
+                z, anomaly = sagg.update_grouped(uniq, lens, vals)
+                out_items = _native_scan_emit(groups, z, anomaly)
+                codes = np.repeat(np.arange(len(lens)), lens)
+                return uniq, out_items, uniq, codes
+        # No native toolchain: per-item promotion, Python emission.
+        keys: List[str] = []
+        values: List[Any] = []
+        for item in items:
+            k, v = _extract_kv(item, self.op.step_id)
+            keys.append(k)
+            values.append(v)
+        touched, emit = sagg.update(np.asarray(keys), np.asarray(values))
+        return touched, emit.items(), emit.uniq, emit.codes
+
+    def _emit_scan(
+        self, out_items: List[Any], uniq: List[str], codes: np.ndarray
+    ) -> None:
+        w_count = self.driver.worker_count
+        if w_count == 1:
+            self.emit("down", (0, out_items))
+            return
+        dest_u = _route_hashes_of(uniq) % w_count
+        dests = dest_u[codes]
+        for d in np.unique(dests).tolist():
+            idx = np.nonzero(dests == d)[0].tolist()
+            self.emit("down", (d, [out_items[j] for j in idx]))
+
     def advance(self, now: datetime) -> None:
         if self.wagg is not None:
             at = self.wagg.notify_at()
@@ -828,6 +915,10 @@ class _StatefulBatchRt(_OpRt):
                 _reraise(self.op.step_id, "the device window fold", ex)
             self._emit_window_events(events)
             return
+        if self.sagg is not None:
+            # stateful_map emits per item only; EOF emits nothing and
+            # retains state (host-tier StatefulLogic.on_eof default).
+            return
         if self.agg is not None:
             out: Dict[int, List[Any]] = {}
             w_count = self.driver.worker_count
@@ -866,9 +957,10 @@ class _StatefulBatchRt(_OpRt):
             self.awoken.clear()
             self.wagg.touched.clear()
             return snaps
-        if self.agg is not None:
+        if self.agg is not None or self.sagg is not None:
+            state = self.agg if self.agg is not None else self.sagg
             with self._timer("snapshot").time():
-                snaps = self.agg.snapshots_for(sorted(self.awoken))
+                snaps = state.snapshots_for(sorted(self.awoken))
             self.awoken.clear()
             return snaps
         snaps: List[Tuple[str, Optional[Any]]] = []
@@ -1229,6 +1321,23 @@ class _Driver:
 
         with span("epoch_close", epoch=self.epoch):
             self._close_epoch_inner(workers)
+        if self._gc_managed:
+            # Deterministic collection points: the cycle collector is
+            # off during the hot loop (its periodic full scans over a
+            # growing item heap dominate per-item cost at device-tier
+            # rates and spike latency mid-epoch — the reference's
+            # native engine has no GC on the hot path at all,
+            # src/worker.rs run loop); collect at epoch close, rate-
+            # limited so epoch_interval=0 flows don't collect per
+            # batch.  Plain refcounting still frees the (acyclic)
+            # item churn immediately.
+            import gc
+            import time as _time
+
+            now_m = _time.monotonic()
+            if now_m - self._last_gc >= 1.0:
+                gc.collect()
+                self._last_gc = _time.monotonic()
 
     def _close_epoch_inner(self, workers: Optional[range] = None) -> None:
         if self.store is not None:
@@ -1403,6 +1512,19 @@ class _Driver:
 
         api_server = maybe_start_server(self.plan.flow)
 
+        # Epoch-aligned garbage collection (see _close_epoch); opt
+        # out with BYTEWAX_TPU_GC=auto to keep Python's automatic
+        # collector running mid-epoch.
+        import gc
+
+        self._gc_managed = (
+            os.environ.get("BYTEWAX_TPU_GC", "epoch") == "epoch"
+            and gc.isenabled()
+        )
+        self._last_gc = time.monotonic()
+        if self._gc_managed:
+            gc.disable()
+
         try:
             while True:
                 self._progressed = False
@@ -1511,6 +1633,8 @@ class _Driver:
                     pass
             raise
         finally:
+            if self._gc_managed:
+                gc.enable()
             if api_server is not None:
                 api_server.shutdown()
             if clustered:

@@ -28,13 +28,26 @@ def _annotate_accel(op: Operator) -> None:
     core ``stateful_batch`` with a device spec so the driver folds
     them on device instead of per-key Python logics."""
     from bytewax_tpu.engine.xla import AccelSpec
-    from bytewax_tpu.xla import Reducer
+    from bytewax_tpu.xla import Reducer, ScanMap
 
     spec = None
     if op.name == "reduce_final" and isinstance(op.conf.get("reducer"), Reducer):
         spec = AccelSpec(op.conf["reducer"].kind)
     elif op.name == "stats_final":
         spec = AccelSpec("stats")
+    elif op.name == "stateful_map" and isinstance(
+        op.conf.get("mapper"), ScanMap
+    ):
+        mapper = op.conf["mapper"]
+        # Only kinds the device tier implements lower; user-defined
+        # ScanMap subclasses with other kinds stay host-tier (they
+        # are still valid plain mappers).
+        if getattr(mapper, "kind", None) == "zscore" and hasattr(
+            mapper, "threshold"
+        ):
+            from bytewax_tpu.engine.scan_accel import ScanAccelSpec
+
+            spec = ScanAccelSpec("zscore", mapper.threshold)
     elif op.name in ("count_window", "fold_window", "reduce_window"):
         spec = _window_accel_spec(op)
     if spec is not None:

@@ -1,0 +1,301 @@
+"""Device-resident per-key scan state (``stateful_map`` lowering).
+
+:class:`bytewax_tpu.engine.xla.DeviceAggState` accelerates keyed
+*aggregations* (emit at EOF/window close); this module accelerates the
+per-item-emitting ``stateful_map`` shape for recognized numeric state
+kinds: per-key state lives in slot-table device arrays, each
+micro-batch is grouped by key on the host and folded through one
+segmented-scan program (:mod:`bytewax_tpu.ops.scan`), and every row's
+output is computed against its pre-update state — semantics identical
+to the host tier's one-mapper-call-per-item, at device batch speed.
+
+Snapshots are host-format tuples ``(count, mean, m2)`` interchangeable
+with the host tier (CLAUDE.md contract: cross-tier recovery).
+"""
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
+from bytewax_tpu.engine.xla import NonNumericValues
+
+__all__ = ["ScanAccelSpec", "DeviceScanState", "ScanEmit"]
+
+_MIN_CAPACITY = 1024
+
+
+class ScanAccelSpec:
+    """Annotation on a core ``stateful_batch``: lower the enclosing
+    ``stateful_map`` to a device segmented scan of this kind."""
+
+    def __init__(self, kind: str, threshold: float):
+        if kind != "zscore":
+            msg = f"unknown scan kind {kind!r}"
+            raise ValueError(msg)
+        self.kind = kind
+        self.threshold = float(threshold)
+
+    def make_state(self) -> "DeviceScanState":
+        return DeviceScanState(self.threshold)
+
+    def __repr__(self) -> str:
+        return f"ScanAccelSpec({self.kind!r}, {self.threshold})"
+
+
+class ScanEmit:
+    """One micro-batch's per-row outputs, in emission order (rows
+    grouped by key, groups in first-appearance order, original order
+    within each group — the host tier's per-batch emission order)."""
+
+    __slots__ = ("keys", "values", "z", "anomaly", "codes", "uniq")
+
+    def __init__(self, keys, values, z, anomaly, codes, uniq):
+        self.keys = keys  # np[str], emission order
+        self.values = values  # np, original dtype
+        self.z = z  # np.float32
+        self.anomaly = anomaly  # np.bool_
+        self.codes = codes  # np.int64 group code per row (emission order)
+        self.uniq = uniq  # list[str], one per group code
+
+    def items(self) -> List[Tuple[str, Tuple[float, float, bool]]]:
+        return list(
+            zip(
+                self.keys.tolist(),
+                zip(
+                    self.values.tolist(),
+                    self.z.tolist(),
+                    self.anomaly.tolist(),
+                ),
+            )
+        )
+
+
+class DeviceScanState:
+    """Slot-table Welford state for one lowered ``stateful_map`` step.
+
+    Keys occupy slots ``0..capacity-2``; the last slot is scratch for
+    padding rows.  Tables double when full so XLA recompiles only
+    O(log n) shapes.
+    """
+
+    def __init__(self, threshold: float):
+        import jax.numpy as jnp
+
+        self.threshold = float(threshold)
+        self.capacity = _MIN_CAPACITY
+        self.key_to_slot: Dict[str, int] = {}
+        self.slot_keys: List[Optional[str]] = []
+        self._free: List[int] = []
+        self._fields = None  # lazy until first update/load
+        self._jnp = jnp
+
+    # -- slot management ---------------------------------------------------
+
+    def _ensure_fields(self) -> None:
+        if self._fields is None:
+            from bytewax_tpu.ops.scan import WELFORD_FIELDS
+
+            jnp = self._jnp
+            self._fields = {
+                name: jnp.full((self.capacity,), init, dtype=dtype)
+                for name, (init, dtype) in WELFORD_FIELDS.items()
+            }
+
+    def _grow_to(self, needed: int) -> None:
+        new_cap = self.capacity
+        while new_cap - 1 < needed:
+            new_cap *= 2
+        if new_cap == self.capacity:
+            return
+        if self._fields is not None:
+            jnp = self._jnp
+            grown = {}
+            for name, arr in self._fields.items():
+                pad = jnp.zeros((new_cap - self.capacity,), dtype=arr.dtype)
+                # The old scratch slot becomes a real slot: clear it.
+                grown[name] = jnp.concatenate(
+                    [arr.at[self.capacity - 1].set(0), pad]
+                )
+            self._fields = grown
+        self.capacity = new_cap
+
+    def alloc(self, key: str) -> int:
+        slot = self.key_to_slot.get(key)
+        if slot is not None:
+            return slot
+        if self._free:
+            slot = self._free.pop()
+            self.slot_keys[slot] = key
+            if self._fields is not None:
+                # Freed slots keep stale state; reset on reuse.
+                for name in self._fields:
+                    self._fields[name] = self._fields[name].at[slot].set(0)
+        else:
+            self._grow_to(len(self.slot_keys) + 2)
+            slot = len(self.slot_keys)
+            self.slot_keys.append(key)
+        self.key_to_slot[key] = slot
+        return slot
+
+    def keys(self) -> List[str]:
+        return [k for k in self.slot_keys if k is not None]
+
+    # -- updates -----------------------------------------------------------
+
+    def scan_rows(
+        self, row_slots: np.ndarray, values: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Run the segmented-scan program over pre-grouped rows (all
+        rows of a slot contiguous); returns per-row ``(z, anomaly)``."""
+        import jax
+
+        from bytewax_tpu.ops.scan import zscore_scan
+
+        n = len(values)
+        # Pad to the next power of two so XLA sees few distinct
+        # shapes; padding rows target the scratch slot (the max slot
+        # id, so the trailing pad is its own segment).
+        padded = 1 << max(5, math.ceil(math.log2(max(n, 1))))
+        slots_p = np.full(padded, self.capacity - 1, dtype=np.int32)
+        slots_p[:n] = row_slots
+        vals_p = np.zeros(padded, dtype=np.float32)
+        vals_p[:n] = values
+        self._ensure_fields()
+        z, self._fields = zscore_scan(
+            self._fields,
+            jax.device_put(slots_p),
+            jax.device_put(vals_p),
+        )
+        z_np = np.asarray(z)[:n]
+        return z_np, np.abs(z_np) > self.threshold
+
+    def update_grouped(
+        self, uniq: List[str], lens: List[int], values: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fold pre-grouped rows in: ``values`` holds each key's rows
+        contiguously (group g = ``uniq[g]``, ``lens[g]`` rows);
+        returns per-row ``(z, anomaly)`` in the same order."""
+        if values.dtype == object or values.dtype.kind in "USb":
+            msg = (
+                "device-accelerated stateful_map requires numeric "
+                "values; arbitrary-state mappers run on the host tier"
+            )
+            raise NonNumericValues(msg)
+        slot_of = np.fromiter(
+            (self.alloc(k) for k in uniq), dtype=np.int32, count=len(uniq)
+        )
+        row_slots = np.repeat(slot_of, lens)
+        return self.scan_rows(row_slots, values)
+
+    def update(self, keys: np.ndarray, values: np.ndarray) -> Tuple[List[str], ScanEmit]:
+        """Fold ``(key, value)`` rows in; returns the unique keys
+        touched plus the per-row outputs in emission order."""
+        keys = np.asarray(keys)
+        values = np.asarray(values)
+        if values.dtype == object or values.dtype.kind in "USb":
+            msg = (
+                "device-accelerated stateful_map requires numeric "
+                "values; arbitrary-state mappers run on the host tier"
+            )
+            raise NonNumericValues(msg)
+        codes, uniq = factorize_keys(keys)
+        uniq_list = [str(k) for k in uniq.tolist()]
+        slot_of = np.fromiter(
+            (self.alloc(k) for k in uniq_list),
+            dtype=np.int32,
+            count=len(uniq_list),
+        )
+        order = np.argsort(codes, kind="stable")
+        codes_s = codes[order]
+        vals_s = values[order]
+        z_np, an_np = self.scan_rows(slot_of[codes_s], vals_s)
+        emit = ScanEmit(
+            keys[order], vals_s, z_np, an_np, codes_s, uniq_list
+        )
+        return uniq_list, emit
+
+    def update_batch(self, batch: ArrayBatch) -> Tuple[List[str], ScanEmit]:
+        if "value" not in batch.cols:
+            msg = (
+                "columnar batch feeding an accelerated stateful_map "
+                "needs a 'value' column"
+            )
+            raise TypeError(msg)
+        if "key_id" in batch.cols and batch.key_vocab is not None:
+            vocab = np.asarray(batch.key_vocab)
+            keys = vocab[batch.numpy("key_id")]
+        elif "key" in batch.cols:
+            keys = batch.numpy("key")
+        else:
+            msg = (
+                "columnar batch feeding an accelerated stateful_map "
+                "needs a 'key' or dictionary-encoded 'key_id' column"
+            )
+            raise TypeError(msg)
+        return self.update(keys, batch._scaled_values())
+
+    # -- recovery ----------------------------------------------------------
+
+    def _fetch(self) -> Dict[str, np.ndarray]:
+        return {
+            name: np.asarray(arr) for name, arr in self._fields.items()
+        }
+
+    def load(self, key: str, state: Any) -> None:
+        self.load_many([(key, state)])
+
+    def load_many(self, items: List[Tuple[str, Any]]) -> None:
+        """Batched resume: one scatter per field per page of
+        host-format ``(count, mean, m2)`` snapshots."""
+        if not items:
+            return
+        import jax
+
+        self._grow_to(len(self.key_to_slot) + len(items) + 1)
+        self._ensure_fields()
+        counts = np.empty(len(items), dtype=np.int32)
+        means = np.empty(len(items), dtype=np.float32)
+        m2s = np.empty(len(items), dtype=np.float32)
+        slots = np.empty(len(items), dtype=np.int32)
+        for i, (key, state) in enumerate(items):
+            count, mean, m2 = state
+            slots[i] = self.alloc(key)
+            counts[i] = count
+            means[i] = mean
+            m2s[i] = m2
+        dev_slots = jax.device_put(slots)
+        for name, col in (("count", counts), ("mean", means), ("m2", m2s)):
+            self._fields[name] = (
+                self._fields[name].at[dev_slots].set(jax.device_put(col))
+            )
+
+    def snapshots_for(self, keys: List[str]) -> List[Tuple[str, Any]]:
+        """Host-format snapshots (one device_get for the batch)."""
+        if self._fields is None or not keys:
+            return [(k, None) for k in keys]
+        host = self._fetch()
+        out = []
+        for key in keys:
+            slot = self.key_to_slot.get(key)
+            if slot is None:
+                out.append((key, None))
+            else:
+                out.append(
+                    (
+                        key,
+                        (
+                            int(host["count"][slot]),
+                            float(host["mean"][slot]),
+                            float(host["m2"][slot]),
+                        ),
+                    )
+                )
+        return out
+
+    def discard(self, key: str) -> None:
+        slot = self.key_to_slot.pop(key, None)
+        if slot is not None:
+            self.slot_keys[slot] = None
+            self._free.append(slot)

@@ -1,5 +1,13 @@
 """Anomaly detector: per-key rolling z-score via ``stateful_map``
-(reference: ``examples/anomaly_detector.py``)."""
+(reference: ``examples/anomaly_detector.py``).
+
+The mapper is :func:`bytewax_tpu.xla.zscore` — a marked
+``stateful_map`` kernel the engine lowers to one segmented-scan device
+program per micro-batch (per-key Welford state in slot-table HBM
+arrays); on the host tier it runs as a plain per-item mapper with
+identical semantics.  State is a ``(count, mean, m2)`` tuple,
+interchangeable between tiers through recovery snapshots.
+"""
 
 from dataclasses import dataclass
 from typing import Optional, Tuple
@@ -13,41 +21,32 @@ __all__ = ["ZScoreState", "anomaly_flow"]
 
 @dataclass
 class ZScoreState:
+    """Welford running-variance state (kept for callers that drive
+    :func:`_update` directly; the flow itself uses tuple state)."""
+
     count: int = 0
     mean: float = 0.0
-    m2: float = 0.0  # Welford running variance numerator
+    m2: float = 0.0
 
 
 def _update(
     state: Optional[ZScoreState], value: float, threshold: float
 ) -> Tuple[ZScoreState, Tuple[float, float, bool]]:
-    if state is None:
-        state = ZScoreState()
-    if state.count >= 2 and state.m2 > 0:
-        std = (state.m2 / (state.count - 1)) ** 0.5
-        z = (value - state.mean) / std if std > 0 else 0.0
-    else:
-        z = 0.0
-    is_anomaly = abs(z) > threshold
-    # Welford online update.
-    state.count += 1
-    delta = value - state.mean
-    state.mean += delta / state.count
-    state.m2 += delta * (value - state.mean)
-    return state, (value, z, is_anomaly)
+    """Host-tier oracle for one z-score step (dataclass-state form)."""
+    from bytewax_tpu.xla import zscore
+
+    st = None if state is None else (state.count, state.mean, state.m2)
+    (count, mean, m2), out = zscore(threshold)(st, value)
+    return ZScoreState(count, mean, m2), out
 
 
 def anomaly_flow(source, sink: Sink, threshold: float = 3.0) -> Dataflow:
     """Items are ``(key, value)``; emits ``(key, (value, zscore,
     is_anomaly))`` per item with per-key online mean/variance state."""
-    import functools
+    from bytewax_tpu.xla import zscore
 
     flow = Dataflow("anomaly_detector")
     s = op.input("inp", flow, source)
-    # functools.partial dispatches at C speed — this mapper runs once
-    # per item.
-    scored = op.stateful_map(
-        "zscore", s, functools.partial(_update, threshold=threshold)
-    )
+    scored = op.stateful_map("zscore", s, zscore(threshold))
     op.output("out", scored, sink)
     return flow

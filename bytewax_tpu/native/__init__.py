@@ -18,7 +18,15 @@ from typing import Any, Optional
 
 import numpy as np
 
-__all__ = ["BrcParser", "bucket_adler", "group_kv", "is_available", "lib"]
+__all__ = [
+    "BrcParser",
+    "bucket_adler",
+    "group_kv",
+    "is_available",
+    "lib",
+    "scan_emit",
+    "scan_fill_values",
+]
 
 _HERE = Path(__file__).parent
 _SRC = _HERE / "io_native.cpp"
@@ -94,12 +102,9 @@ def _build_ext(src: Path, modname: str):
     return mod
 
 
-def group_kv(items):
-    """Group ``(str key, value)`` tuples into ``{key: [values]}`` with
-    the native fast path when it is available (and buildable), else
-    ``None`` so the caller runs its general Python loop.  The fast
-    path itself raises TypeError on rows that are not exact str-keyed
-    2-tuples — callers must fall back on that too."""
+def _ext() -> Any:
+    """The host_ops CPython extension, building it on first use; None
+    when no toolchain is available (callers stay pure Python)."""
     global _host_ops, _host_ops_tried
     if _host_ops is None:
         if _host_ops_tried:
@@ -110,7 +115,17 @@ def group_kv(items):
                 _host_ops = _build_ext(_HERE / "host_ops.c", "host_ops")
             except Exception:  # noqa: BLE001 — no toolchain: stay Python
                 return None
-    return _host_ops.group_kv(items)
+    return _host_ops
+
+
+def group_kv(items):
+    """Group ``(str key, value)`` tuples into ``{key: [values]}`` with
+    the native fast path when it is available (and buildable), else
+    ``None`` so the caller runs its general Python loop.  The fast
+    path itself raises TypeError on rows that are not exact str-keyed
+    2-tuples — callers must fall back on that too."""
+    ext = _ext()
+    return None if ext is None else ext.group_kv(items)
 
 
 def bucket_adler(items, n_buckets):
@@ -120,17 +135,27 @@ def bucket_adler(items, n_buckets):
     original items, or ``None`` when the native module is not
     available.  Raises TypeError on rows that are not exact str-keyed
     2-tuples — callers must fall back on that too."""
-    global _host_ops, _host_ops_tried
-    if _host_ops is None:
-        if _host_ops_tried:
-            return None
-        with _lock:
-            _host_ops_tried = True
-            try:
-                _host_ops = _build_ext(_HERE / "host_ops.c", "host_ops")
-            except Exception:  # noqa: BLE001 — no toolchain: stay Python
-                return None
-    return _host_ops.bucket_adler(items, n_buckets)
+    ext = _ext()
+    return None if ext is None else ext.bucket_adler(items, n_buckets)
+
+
+def scan_fill_values(groups, out) -> Any:
+    """Flatten an insertion-ordered ``{key: [values]}`` dict into the
+    writable float64 buffer ``out`` (one group after another);
+    returns the list of group sizes, or None without the native
+    module.  Raises TypeError on non-float-coercible values —
+    callers fall back to the host tier on that."""
+    ext = _ext()
+    return None if ext is None else ext.scan_fill_values(groups, out)
+
+
+def scan_emit(groups, z, flags) -> Any:
+    """Build the scan emission list ``[(key, (value, z, flag)), ...]``
+    from the group dict plus device results (``z`` float32 buffer,
+    ``flags`` uint8 buffer) in one C pass, reusing the original key
+    and value objects; None without the native module."""
+    ext = _ext()
+    return None if ext is None else ext.scan_emit(groups, z, flags)
 
 
 def _build() -> Optional[ctypes.CDLL]:

@@ -148,11 +148,162 @@ bucket_adler(PyObject *self, PyObject *args)
     return buckets;
 }
 
+/* Flatten a {key: [values]} group dict (insertion-ordered, as built
+ * by group_kv) into a caller-provided contiguous float64 buffer, one
+ * group after another.  Returns the list of group sizes.  Raises
+ * TypeError when a value is not float-coercible — the caller falls
+ * back to the host tier. */
+static PyObject *
+scan_fill_values(PyObject *self, PyObject *args)
+{
+    PyObject *groups, *out;
+    if (!PyArg_ParseTuple(args, "O!O", &PyDict_Type, &groups, &out)) {
+        return NULL;
+    }
+    Py_buffer view;
+    if (PyObject_GetBuffer(out, &view, PyBUF_CONTIG | PyBUF_WRITABLE) < 0) {
+        return NULL;
+    }
+    double *buf = (double *)view.buf;
+    Py_ssize_t cap = view.len / (Py_ssize_t)sizeof(double);
+    PyObject *lens = PyList_New(0);
+    if (lens == NULL) {
+        PyBuffer_Release(&view);
+        return NULL;
+    }
+    Py_ssize_t pos = 0, di = 0;
+    PyObject *k, *v;
+    while (PyDict_Next(groups, &di, &k, &v)) {
+        if (!PyList_Check(v)) {
+            PyErr_SetString(PyExc_TypeError, "group values must be lists");
+            goto fail;
+        }
+        Py_ssize_t m = PyList_GET_SIZE(v);
+        if (pos + m > cap) {
+            PyErr_SetString(PyExc_ValueError, "output buffer too small");
+            goto fail;
+        }
+        for (Py_ssize_t i = 0; i < m; i++) {
+            double d = PyFloat_AsDouble(PyList_GET_ITEM(v, i));
+            if (d == -1.0 && PyErr_Occurred()) {
+                goto fail;
+            }
+            buf[pos++] = d;
+        }
+        PyObject *len_obj = PyLong_FromSsize_t(m);
+        if (len_obj == NULL || PyList_Append(lens, len_obj) < 0) {
+            Py_XDECREF(len_obj);
+            goto fail;
+        }
+        Py_DECREF(len_obj);
+    }
+    PyBuffer_Release(&view);
+    return lens;
+fail:
+    Py_DECREF(lens);
+    PyBuffer_Release(&view);
+    return NULL;
+}
+
+/* Build the scan step's emission list [(key, (value, z, flag)), ...]
+ * in one C pass over the insertion-ordered group dict plus the
+ * device results (z float32 buffer, flags uint8 buffer) — reusing the
+ * original key and value objects so only the per-row z float, bool,
+ * and two tuples are allocated. */
+static PyObject *
+scan_emit(PyObject *self, PyObject *args)
+{
+    PyObject *groups, *z_obj, *fl_obj;
+    if (!PyArg_ParseTuple(args, "O!OO", &PyDict_Type, &groups, &z_obj,
+                          &fl_obj)) {
+        return NULL;
+    }
+    Py_buffer zv, fv;
+    if (PyObject_GetBuffer(z_obj, &zv, PyBUF_CONTIG_RO) < 0) {
+        return NULL;
+    }
+    if (PyObject_GetBuffer(fl_obj, &fv, PyBUF_CONTIG_RO) < 0) {
+        PyBuffer_Release(&zv);
+        return NULL;
+    }
+    const float *z = (const float *)zv.buf;
+    const unsigned char *flags = (const unsigned char *)fv.buf;
+    Py_ssize_t n = zv.len / (Py_ssize_t)sizeof(float);
+    PyObject *out = NULL;
+    if (fv.len != n) {
+        PyErr_SetString(PyExc_ValueError, "z/flags length mismatch");
+        goto done;
+    }
+    out = PyList_New(n);
+    if (out == NULL) {
+        goto done;
+    }
+    Py_ssize_t pos = 0, di = 0;
+    PyObject *k, *v;
+    while (PyDict_Next(groups, &di, &k, &v)) {
+        if (!PyList_Check(v)) {
+            PyErr_SetString(PyExc_TypeError, "group values must be lists");
+            Py_CLEAR(out);
+            goto done;
+        }
+        Py_ssize_t m = PyList_GET_SIZE(v);
+        if (pos + m > n) {
+            PyErr_SetString(PyExc_ValueError, "row count mismatch");
+            Py_CLEAR(out);
+            goto done;
+        }
+        for (Py_ssize_t i = 0; i < m; i++) {
+            PyObject *zf = PyFloat_FromDouble((double)z[pos]);
+            if (zf == NULL) {
+                Py_CLEAR(out);
+                goto done;
+            }
+            PyObject *fl = flags[pos] ? Py_True : Py_False;
+            Py_INCREF(fl);
+            PyObject *inner = PyTuple_New(3);
+            if (inner == NULL) {
+                Py_DECREF(zf);
+                Py_DECREF(fl);
+                Py_CLEAR(out);
+                goto done;
+            }
+            PyObject *val = PyList_GET_ITEM(v, i);
+            Py_INCREF(val);
+            PyTuple_SET_ITEM(inner, 0, val);
+            PyTuple_SET_ITEM(inner, 1, zf);
+            PyTuple_SET_ITEM(inner, 2, fl);
+            PyObject *pair = PyTuple_New(2);
+            if (pair == NULL) {
+                Py_DECREF(inner);
+                Py_CLEAR(out);
+                goto done;
+            }
+            Py_INCREF(k);
+            PyTuple_SET_ITEM(pair, 0, k);
+            PyTuple_SET_ITEM(pair, 1, inner);
+            PyList_SET_ITEM(out, pos, pair);
+            pos++;
+        }
+    }
+    if (pos != n) {
+        PyErr_SetString(PyExc_ValueError, "row count mismatch");
+        Py_CLEAR(out);
+    }
+done:
+    PyBuffer_Release(&zv);
+    PyBuffer_Release(&fv);
+    return out;
+}
+
 static PyMethodDef HostOpsMethods[] = {
     {"group_kv", group_kv, METH_VARARGS,
      "Group a list of (str key, value) tuples into {key: [values]}."},
     {"bucket_adler", bucket_adler, METH_VARARGS,
      "Bucket (str key, value) tuples by adler32(key) %% n_buckets."},
+    {"scan_fill_values", scan_fill_values, METH_VARARGS,
+     "Flatten {key: [values]} into a float64 buffer; return group sizes."},
+    {"scan_emit", scan_emit, METH_VARARGS,
+     "Build [(key, (value, z, flag)), ...] from groups + device results."},
     {NULL, NULL, 0, NULL},
 };
 

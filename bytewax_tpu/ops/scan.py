@@ -1,0 +1,141 @@
+"""Segmented per-key running scans with per-row emission.
+
+The reference's ``stateful_map`` calls the user mapper once per item
+under the GIL (``/root/reference/pysrc/bytewax/operators/__init__.py``
+``stateful_map``; engine loop ``src/operators.rs:441-520``).  For
+recognized numeric state shapes the same computation is one device
+program per micro-batch: the host groups rows by key into contiguous
+segments, and a segmented ``jax.lax.associative_scan`` over the state
+monoid yields every row's *pre-update* state — exactly what the
+host-tier mapper observes before it folds the row in — in O(log n)
+depth instead of n sequential Python calls.
+
+The first kind is the anomaly-detector shape (reference
+``examples/anomaly_detector.py``): per-key online mean/variance via
+Welford triples ``(count, mean, m2)``.  Welford states form a monoid
+under Chan's parallel merge, so the per-key running fold is exactly a
+segmented scan.
+"""
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["welford_merge", "zscore_scan", "WELFORD_FIELDS"]
+
+#: name -> (init, dtype) of the per-key Welford state row.
+WELFORD_FIELDS = {
+    "count": (0, jnp.int32),
+    "mean": (0.0, jnp.float32),
+    "m2": (0.0, jnp.float32),
+}
+
+
+def welford_merge(a, b):
+    """Chan's parallel Welford merge: combine two ``(count, mean, m2)``
+    summaries of disjoint samples.  Associative, identity (0, 0, 0)."""
+    na, ma, m2a = a
+    nb, mb, m2b = b
+    n = na + nb
+    nf = n.astype(jnp.float32)
+    naf = na.astype(jnp.float32)
+    nbf = nb.astype(jnp.float32)
+    safe = jnp.where(n > 0, nf, 1.0)
+    delta = mb - ma
+    mean = ma + delta * nbf / safe
+    m2 = m2a + m2b + delta * delta * naf * nbf / safe
+    return n, mean, m2
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def zscore_scan(
+    state: Dict[str, jax.Array],
+    slots: jax.Array,
+    values: jax.Array,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One micro-batch of the per-key rolling z-score.
+
+    ``slots`` must be grouped (all rows of a key contiguous); padding
+    rows carry the scratch slot ``capacity - 1`` and must form the
+    trailing segment.  Returns per-row ``z`` — computed against each
+    row's pre-update state, matching the host mapper — and the
+    updated slot tables (donated in place in HBM).  The threshold
+    compare happens host-side on the returned column (one fewer
+    device transfer).
+
+    The per-row running Welford state is computed from three segmented
+    prefix sums of *pivot-shifted* values (the segment head's value is
+    the pivot, so the ``sumsq - sum²/n`` form stays well-conditioned),
+    then merged with each key's persistent table state via Chan's
+    parallel Welford combine — native cumsum lowering, no custom
+    associative-scan combine on the hot path.
+    """
+    count_t, mean_t, m2_t = state["count"], state["mean"], state["m2"]
+    capacity = count_t.shape[0]
+    n = slots.shape[0]
+    f = mean_t.dtype
+    vals = values.astype(f)
+
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), slots[1:] != slots[:-1]]
+    )
+    idx = jnp.arange(n, dtype=jnp.int32)
+    # Broadcast each segment head's index to its rows: arange is
+    # monotone, so a running max of head indices does it.
+    head_idx = jax.lax.cummax(jnp.where(seg_start, idx, 0))
+    pivot = vals[head_idx]
+    d = vals - pivot
+    ones = jnp.ones((n,), dtype=f)
+
+    def seg_excl(col):
+        """Exclusive in-segment prefix sum."""
+        c = jnp.cumsum(col)
+        excl = c - col
+        return excl - excl[head_idx]
+
+    pn = seg_excl(ones)  # prior rows of this key in the batch
+    ps = seg_excl(d)
+    pq = seg_excl(d * d)
+
+    def around_pivot(cnt, s, q):
+        """(count, mean, m2) of a shifted prefix sum triple."""
+        safe = jnp.maximum(cnt, 1.0)
+        return pivot + s / safe, q - s * s / safe
+
+    def chan_merge(n0, mean0, m20, nb, mean_b, m2_b):
+        nbt = n0 + nb
+        safe = jnp.maximum(nbt, 1.0)
+        delta = mean_b - mean0
+        mean = mean0 + delta * nb / safe
+        m2 = m20 + m2_b + delta * delta * n0 * nb / safe
+        return nbt, mean, m2
+
+    n0 = count_t[slots].astype(f)
+    mean0 = mean_t[slots]
+    m20 = m2_t[slots]
+
+    # Pre-update state per row = table carry ⊕ in-batch prefix.
+    mean_b, m2_b = around_pivot(pn, ps, pq)
+    p_n, p_mean, p_m2 = chan_merge(n0, mean0, m20, pn, mean_b, m2_b)
+
+    have_var = (p_n >= 2) & (p_m2 > 0)
+    denom = jnp.sqrt(p_m2 / jnp.maximum(p_n - 1, 1.0))
+    z = jnp.where(have_var, (vals - p_mean) / denom, 0.0)
+
+    # Segment tails write table carry ⊕ inclusive in-batch state back;
+    # every other row is redirected to the scratch slot (arbitrary
+    # values there are fine — padding already targets it).
+    mean_i, m2_i = around_pivot(pn + 1, ps + d, pq + d * d)
+    s_n, s_mean, s_m2 = chan_merge(n0, mean0, m20, pn + 1, mean_i, m2_i)
+    seg_end = jnp.concatenate(
+        [slots[1:] != slots[:-1], jnp.ones((1,), dtype=bool)]
+    )
+    dest = jnp.where(seg_end, slots, capacity - 1)
+    new_state = {
+        "count": count_t.at[dest].set(s_n.astype(count_t.dtype)),
+        "mean": mean_t.at[dest].set(s_mean),
+        "m2": m2_t.at[dest].set(s_m2),
+    }
+    return z, new_state

@@ -135,16 +135,76 @@ class _IterSourcePartition(StatefulSourcePartition[X, int]):
         self._start_idx = 0 if resume_state is None else resume_state
         self._batch_size = batch_size
         self._next_awake: Optional[datetime] = None
-        self._it = iter(ib)
-        ffwd_iter(self._it, self._start_idx)
+        if type(ib) is list:
+            # List inputs take a sliced fast path in next_batch: the
+            # common benchmark/test shape must not pay a per-item
+            # Python loop in the source.  One isinstance scan up
+            # front decides (exact iterator-path semantics, incl.
+            # sentinel subclasses); sentinels appended to the list
+            # after construction are not supported on this path.
+            self._lst: Optional[List] = ib
+            self._idx = self._start_idx
+            self._it = iter(())
+            self._lst_clean = not any(
+                isinstance(x, self._SENTINELS) for x in ib
+            )
+        else:
+            self._lst = None
+            self._it = iter(ib)
+            ffwd_iter(self._it, self._start_idx)
         self._raise: Optional[Exception] = None
 
     _SENTINELS = (TestingSource.EOF, TestingSource.ABORT, TestingSource.PAUSE)
+
+    def _next_batch_list(self) -> List[X]:
+        lst = self._lst
+        i = self._idx
+        if self._lst_clean:
+            # Sentinel-free list: the slice is the batch.
+            chunk = lst[i : i + self._batch_size]
+            if not chunk:
+                raise StopIteration()
+            self._idx = i + len(chunk)
+            self._start_idx += len(chunk)
+            return chunk
+        # Sentinels present: per-item semantics identical to the
+        # iterator path, including its snapshot-index accounting.
+        batch: List[X] = []
+        append = batch.append
+        size = self._batch_size
+        sentinels = self._SENTINELS
+        while self._idx < len(lst):
+            item = lst[self._idx]
+            self._idx += 1
+            if not isinstance(item, sentinels):
+                append(item)
+                if len(batch) >= size:
+                    break
+            elif isinstance(item, TestingSource.EOF):
+                self._raise = StopIteration()
+                # Skip over the sentinel on continuation.
+                self._start_idx += 1
+                break
+            elif isinstance(item, TestingSource.ABORT):
+                if not item._triggered:
+                    self._raise = AbortExecution()
+                    item._triggered = True
+                    break
+            else:  # PAUSE
+                now = datetime.now(tz=timezone.utc)
+                self._next_awake = now + item.for_duration
+                break
+        if batch or self._raise is not None or self._next_awake is not None:
+            self._start_idx += len(batch)
+            return batch
+        raise StopIteration()
 
     def next_batch(self) -> List[X]:
         if self._raise is not None:
             raise self._raise
         self._next_awake = None
+        if self._lst is not None:
+            return self._next_batch_list()
 
         batch: List[X] = []
         append = batch.append

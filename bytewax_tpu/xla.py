@@ -35,11 +35,13 @@ __all__ = [
     "MIN",
     "Reducer",
     "STATS",
+    "ScanMap",
     "SUM",
     "WindowFold",
     "jit_batch",
     "map_batch",
     "stats_final",
+    "zscore",
 ]
 
 
@@ -118,6 +120,64 @@ STATS = WindowFold(
     ),
     lambda a: (a[0], a[2] / a[3] if a[3] else 0.0, a[1], a[3]),
 )
+
+
+class ScanMap:
+    """A ``stateful_map`` mapper with a device lowering.
+
+    Callable like a plain ``(state, value) -> (state, emit)`` mapper
+    (the host tier uses it directly); ``kind`` names the segmented
+    per-key device scan the engine lowers to
+    (:mod:`bytewax_tpu.ops.scan`) when values are numeric.  State is a
+    plain tuple, interchangeable between tiers through recovery
+    snapshots.
+    """
+
+    kind: str
+
+
+class _ZScoreMap(ScanMap):
+    """Per-key rolling z-score (the anomaly-detector shape): state is
+    a Welford triple ``(count, mean, m2)``; each value emits
+    ``(value, z, is_anomaly)`` scored against the state *before* the
+    value folds in."""
+
+    kind = "zscore"
+
+    def __init__(self, threshold: float):
+        self.threshold = float(threshold)
+
+    def __call__(self, state, value):
+        if state is None:
+            count, mean, m2 = 0, 0.0, 0.0
+        else:
+            count, mean, m2 = state
+        if count >= 2 and m2 > 0:
+            std = (m2 / (count - 1)) ** 0.5
+            z = (value - mean) / std if std > 0 else 0.0
+        else:
+            z = 0.0
+        is_anomaly = abs(z) > self.threshold
+        # Welford online update.
+        count += 1
+        delta = value - mean
+        mean += delta / count
+        m2 += delta * (value - mean)
+        return (count, mean, m2), (value, z, is_anomaly)
+
+    def __repr__(self) -> str:
+        return f"bytewax_tpu.xla.zscore({self.threshold})"
+
+
+def zscore(threshold: float = 3.0) -> ScanMap:
+    """A ``stateful_map`` mapper computing each key's rolling z-score
+    with per-key online mean/variance (Welford) state.
+
+    Emits ``(value, z, abs(z) > threshold)`` per item.  The engine
+    lowers it to one segmented-scan device program per micro-batch;
+    the host tier runs it as a plain mapper with identical semantics.
+    """
+    return _ZScoreMap(threshold)
 
 
 class JaxUDF:

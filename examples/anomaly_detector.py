@@ -16,7 +16,7 @@ def _fmt(kv):
 
 
 def get_flow():
-    from bytewax_tpu.models.anomaly import _update
+    from bytewax_tpu.xla import zscore
 
     flow = Dataflow("anomaly_detector")
     s = op.input(
@@ -26,7 +26,9 @@ def get_flow():
             "system_metric", interval=timedelta(0), count=200, seed=42
         ),
     )
-    scored = op.stateful_map("zscore", s, lambda st, v: _update(st, v, 2.5))
+    # A marked mapper: the engine lowers this stateful_map to a
+    # segmented-scan device program; unmarked lambdas run host-tier.
+    scored = op.stateful_map("zscore", s, zscore(2.5))
     pretty = op.map("fmt", scored, _fmt)
     op.output("out", pretty, StdOutSink())
     return flow

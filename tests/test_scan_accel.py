@@ -1,0 +1,279 @@
+"""Device lowering of ``stateful_map`` (segmented per-key scan):
+host tier is the oracle; snapshots interchange between tiers."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import bytewax_tpu.operators as op
+from bytewax_tpu import xla
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.engine.flatten import flatten
+from bytewax_tpu.engine.scan_accel import DeviceScanState, ScanAccelSpec
+from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+
+def _host_oracle(items, threshold):
+    """Run the marker mapper per item in Python (the host tier)."""
+    states = {}
+    out = []
+    mapper = xla.zscore(threshold)
+    for k, v in items:
+        st, emit = mapper(states.get(k), v)
+        states[k] = st
+        out.append((k, emit))
+    return states, out
+
+
+def _flow(items, out, threshold, batch_size=7):
+    flow = Dataflow("scan_accel")
+    s = op.input("inp", flow, TestingSource(items, batch_size=batch_size))
+    scored = op.stateful_map("zscore", s, xla.zscore(threshold))
+    op.output("out", scored, TestingSink(out))
+    return flow
+
+
+def _assert_scored_equal(got, want, atol=1e-4):
+    assert len(got) == len(want)
+    # Per-key value sequences must match exactly and in order; z
+    # within f32-vs-f64 tolerance; flags equal (test data keeps z
+    # away from the threshold boundary).
+    def per_key(rows):
+        by = {}
+        for k, (v, z, a) in rows:
+            by.setdefault(k, []).append((v, z, a))
+        return by
+
+    g, w = per_key(got), per_key(want)
+    assert g.keys() == w.keys()
+    for k in w:
+        assert len(g[k]) == len(w[k])
+        for (gv, gz, ga), (wv, wz, wa) in zip(g[k], w[k]):
+            assert gv == wv
+            assert gz == pytest.approx(wz, abs=atol)
+            assert ga == wa
+
+
+def test_annotation_marks_scan_spec():
+    flow = _flow([("a", 1.0)], [], 2.5)
+    plan = flatten(flow)
+    specs = [
+        o.conf.get("_accel")
+        for o in plan.ops
+        if o.name == "stateful_batch"
+    ]
+    assert len(specs) == 1
+    assert isinstance(specs[0], ScanAccelSpec)
+    assert specs[0].threshold == 2.5
+
+
+def test_unknown_scanmap_kind_stays_host_tier():
+    # A user-defined ScanMap subclass with a kind the device tier
+    # doesn't implement must lower to nothing and run as a plain
+    # host mapper.
+    class Running(xla.ScanMap):
+        kind = "running_sum"
+
+        def __call__(self, st, v):
+            total = (st or 0.0) + v
+            return total, total
+
+    out = []
+    flow = Dataflow("scan_custom")
+    s = op.input("inp", flow, TestingSource([("a", 1.0), ("a", 2.0)]))
+    s = op.stateful_map("m", s, Running())
+    op.output("out", s, TestingSink(out))
+    plan = flatten(flow)
+    specs = [
+        o.conf.get("_accel")
+        for o in plan.ops
+        if o.name == "stateful_batch"
+    ]
+    assert specs == [None]
+    run_main(flow)
+    assert out == [("a", 1.0), ("a", 3.0)]
+
+
+def test_unmarked_mapper_not_annotated():
+    flow = Dataflow("scan_plain")
+    s = op.input("inp", flow, TestingSource([("a", 1.0)]))
+    s = op.stateful_map("m", s, lambda st, v: ((st or 0) + v, v))
+    op.output("out", s, TestingSink([]))
+    plan = flatten(flow)
+    specs = [
+        o.conf.get("_accel")
+        for o in plan.ops
+        if o.name == "stateful_batch"
+    ]
+    assert specs == [None]
+
+
+def test_device_matches_host_oracle(entry_point):
+    rng = np.random.RandomState(7)
+    items = [
+        (f"k{rng.randint(0, 5)}", float(np.round(rng.randn(), 3)))
+        for _ in range(400)
+    ]
+    # A couple of blatant outliers so both anomaly branches fire.
+    items[200] = ("k0", 50.0)
+    items[300] = ("k3", -40.0)
+    _, want = _host_oracle(items, threshold=3.0)
+    out = []
+    entry_point(_flow(items, out, 3.0))
+    _assert_scored_equal(out, want)
+
+
+def test_single_item_batches_match_oracle():
+    items = [("a", 1.0), ("b", 2.0), ("a", 3.0), ("a", 2.0), ("b", 9.0)]
+    _, want = _host_oracle(items, threshold=2.0)
+    out = []
+    run_main(_flow(items, out, 2.0, batch_size=1))
+    _assert_scored_equal(out, want)
+
+
+def test_non_numeric_values_fall_back_to_host():
+    # String values can't ride the device scan: the step must fall
+    # back to the host tier, whose mapper then raises its own
+    # arithmetic TypeError (same outcome as running unaccelerated).
+    items = [("a", "x"), ("a", "x"), ("b", "y")]
+    out = []
+    flow = Dataflow("scan_fallback")
+    s = op.input("inp", flow, TestingSource(items, batch_size=2))
+    scored = op.stateful_map("zscore", s, xla.zscore(2.0))
+    op.output("out", scored, TestingSink(out))
+    with pytest.raises(TypeError):
+        run_main(flow)
+
+
+def test_mixed_malformed_rows_error_like_host():
+    # Non-str key: host tier raises the step-qualified TypeError; the
+    # device path must fall back and surface the same class of error.
+    items = [(1, 2.0)]
+    out = []
+    with pytest.raises(TypeError, match="str"):
+        run_main(_flow(items, out, 2.0))
+
+
+def test_scan_state_snapshot_roundtrip():
+    st = DeviceScanState(2.0)
+    touched, emit = st.update(
+        np.array(["a", "a", "b"]), np.array([1.0, 2.0, 10.0])
+    )
+    assert sorted(touched) == ["a", "b"]
+    snaps = dict(st.snapshots_for(["a", "b", "missing"]))
+    assert snaps["missing"] is None
+    count, mean, m2 = snaps["a"]
+    assert count == 2
+    assert mean == pytest.approx(1.5)
+    assert m2 == pytest.approx(0.5)
+    # Resume into a fresh state: continues identically.
+    st2 = DeviceScanState(2.0)
+    st2.load_many([(k, s) for k, s in snaps.items() if s is not None])
+    _, emit2 = st2.update(np.array(["a"]), np.array([3.0]))
+    mapper = xla.zscore(2.0)
+    host_state = (2, 1.5, 0.5)
+    _, (v, z, a) = mapper(host_state, 3.0)
+    assert emit2.z[0] == pytest.approx(z, abs=1e-5)
+    assert bool(emit2.anomaly[0]) == a
+
+
+def test_device_snapshot_resumes_on_host_tier(tmp_path, recovery_config):
+    """Cross-tier recovery: snapshots written by the device scan must
+    resume under the host tier (accel disabled) and vice versa."""
+    from bytewax_tpu.testing import TestingSource as TS
+
+    items = [("a", 1.0), ("a", 2.0), ("b", 5.0)]
+    tail = [("a", 3.0), ("b", 6.0)]
+    _, want = _host_oracle(items + tail, threshold=2.0)
+    inp = items + [TS.ABORT()] + tail
+
+    from datetime import timedelta
+
+    out1 = []
+    run_main(
+        _flow(inp, out1, 2.0, batch_size=2),
+        epoch_interval=timedelta(0),
+        recovery_config=recovery_config,
+    )
+    assert len(out1) == len(items)
+
+    out2 = []
+    env_prev = os.environ.get("BYTEWAX_TPU_ACCEL")
+    os.environ["BYTEWAX_TPU_ACCEL"] = "0"
+    try:
+        run_main(
+            _flow(inp, out2, 2.0, batch_size=2),
+            epoch_interval=timedelta(0),
+            recovery_config=recovery_config,
+        )
+    finally:
+        if env_prev is None:
+            os.environ.pop("BYTEWAX_TPU_ACCEL", None)
+        else:
+            os.environ["BYTEWAX_TPU_ACCEL"] = env_prev
+    _assert_scored_equal(out1 + out2, want, atol=1e-4)
+
+
+def test_welford_merge_matches_sequential():
+    import jax.numpy as jnp
+
+    from bytewax_tpu.ops.scan import welford_merge
+
+    rng = np.random.RandomState(3)
+    xs = rng.randn(100)
+    # Sequential host fold.
+    count, mean, m2 = 0, 0.0, 0.0
+    for v in xs:
+        count += 1
+        d = v - mean
+        mean += d / count
+        m2 += d * (v - mean)
+    # Pairwise device merge of the two halves.
+    def summarize(arr):
+        c, m, s = 0, 0.0, 0.0
+        for v in arr:
+            c += 1
+            d = v - m
+            m += d / c
+            s += d * (v - m)
+        return (
+            jnp.asarray(c, jnp.int32),
+            jnp.asarray(m, jnp.float32),
+            jnp.asarray(s, jnp.float32),
+        )
+
+    n, me, s2 = welford_merge(summarize(xs[:50]), summarize(xs[50:]))
+    assert int(n) == count
+    assert float(me) == pytest.approx(mean, abs=1e-5)
+    assert float(s2) == pytest.approx(m2, rel=1e-4)
+
+
+def test_example_anomaly_detector_runs_device_tier(tmp_path):
+    """The BASELINE config flow must actually engage the scan accel:
+    run it in-process and assert the plan annotation plus output."""
+    from bytewax_tpu.connectors.demo import RandomMetricSource
+    from datetime import timedelta
+
+    flow = Dataflow("anomaly_device")
+    s = op.input(
+        "inp",
+        flow,
+        RandomMetricSource(
+            "metric", interval=timedelta(0), count=50, seed=1
+        ),
+    )
+    scored = op.stateful_map("zscore", s, xla.zscore(2.5))
+    out = []
+    op.output("out", scored, TestingSink(out))
+    plan = flatten(flow)
+    assert any(
+        isinstance(o.conf.get("_accel"), ScanAccelSpec)
+        for o in plan.ops
+        if o.name == "stateful_batch"
+    )
+    run_main(flow)
+    assert len(out) == 50
+    assert all(k == "metric" for k, _ in out)
