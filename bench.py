@@ -123,6 +123,31 @@ def _run_columnar(n_rows: int, batch_rows: int) -> float:
     return n_rows / dt
 
 
+def _run_itemized(n_rows: int, batch_rows: int) -> float:
+    """The 1BRC aggregation over itemized ``(key, value)`` tuples with
+    acceleration ON: measures the itemized→columnar promotion at the
+    accel boundary (native grouper + value flatten) — ported-from-
+    bytewax flows feed this shape, so it should track
+    ``_run_columnar`` within a small factor."""
+    from bytewax_tpu.models.brc import (
+        ArrayBatchSource,
+        brc_flow,
+        generate_batches,
+    )
+    from bytewax_tpu.testing import TestingSink, run_main
+
+    batches = [
+        b.to_pylist() for b in generate_batches(n_rows, batch_rows)
+    ]
+    out = []
+    flow = brc_flow(ArrayBatchSource(batches), TestingSink(out))
+    t0 = time.perf_counter()
+    run_main(flow)
+    dt = time.perf_counter() - t0
+    assert len(out) == 413
+    return n_rows / dt
+
+
 def _run_host(n_rows: int, batch_rows: int) -> float:
     from bytewax_tpu.models.brc import (
         ArrayBatchSource,
@@ -548,6 +573,11 @@ def main() -> None:
     # The chip link is shared and bursty; take the best of a few reps
     # as the steady-state rate.
     xla_rate = max(_run_columnar(xla_rows, batch_rows) for _ in range(reps))
+    item_rows = int(os.environ.get("BENCH_ITEM_ROWS", 4_000_000))
+    _run_itemized(1 << 20, 1 << 20)  # warm the promoted shapes
+    item_rate = max(
+        _run_itemized(item_rows, batch_rows) for _ in range(2)
+    )
     host_rate = _run_host(host_rows, batch_rows)
 
     win_ref = _run_windowing_host(100_000, 10)  # the reference shape
@@ -594,6 +624,8 @@ def main() -> None:
         "wordcount_events_per_sec": round(wc_rate),
         "anomaly_events_per_sec": round(anomaly_rate),
         "device_step_1m_rows_ms": round(step_ms, 3),
+        "brc_itemized_events_per_sec": round(item_rate),
+        "brc_itemized_vs_columnar": round(item_rate / xla_rate, 2),
         "host_events_per_sec": round(host_rate),
     }
     if sharded_ms is not None:

@@ -776,17 +776,27 @@ class _StatefulBatchRt(_OpRt):
                     if isinstance(items, ArrayBatch):
                         touched = self.agg.update_batch(items)
                     else:
-                        keys = []
-                        values = []
-                        for item in items:
-                            k, v = _extract_kv(item, self.op.step_id)
-                            keys.append(k)
-                            values.append(v)
-                        if not keys:
+                        if not items:
                             continue
-                        touched = self.agg.update(
-                            np.asarray(keys), np.asarray(values)
-                        )
+                        touched = None
+                        if type(items) is list:
+                            # One-pass itemized→columnar promotion
+                            # (native kv_encode) — no per-item Python
+                            # at the accel boundary.  NonNumericValues
+                            # (malformed rows / non-numeric values)
+                            # propagates to the fallback handling
+                            # below; None means no native toolchain.
+                            touched = self.agg.update_items(items)
+                        if touched is None:
+                            keys = []
+                            values = []
+                            for item in items:
+                                k, v = _extract_kv(item, self.op.step_id)
+                                keys.append(k)
+                                values.append(v)
+                            touched = self.agg.update(
+                                np.asarray(keys), np.asarray(values)
+                            )
             except NonNumericValues as ex:
                 if not self.agg.keys() and not self.logics:
                     # Non-numeric values: permanently fall back to the

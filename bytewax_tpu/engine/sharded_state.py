@@ -134,6 +134,11 @@ class ShardedAggState:
         # kid -> key reverse map it needs for touched-key reporting.
         self._enc = KeyEncoder()
         self._kid_key: Dict[int, str] = {}
+        # One-pass itemized promotion (native kv_encode): dense ids
+        # in first-sight order, mapped to wire kids via one gather.
+        self._iddict: Dict[str, int] = {}
+        self._id_keys: List[str] = []
+        self._id_to_kid = np.empty(0, dtype=np.int32)
 
     # -- key placement -----------------------------------------------------
 
@@ -166,6 +171,13 @@ class ShardedAggState:
             self._free[shard].append(slot)
             self._kid_key.pop(kid, None)
             self._enc.drop(key)
+            if self._iddict:
+                # Dense ids must stay collision-free (kv_encode
+                # assigns len(dict)): a discard resets the itemized
+                # cache (see DeviceAggState.discard).
+                self._iddict = {}
+                self._id_keys = []
+                self._id_to_kid = np.empty(0, dtype=np.int32)
 
     def _global_idx(self, kid: int) -> int:
         shard, slot = kid % self.n_shards, kid // self.n_shards
@@ -329,6 +341,50 @@ class ShardedAggState:
         :meth:`alloc` returned)."""
         values = self._pick_dtype(np.asarray(values))
         self._dispatch(np.asarray(kids, dtype=np.int32), values)
+
+    def update_items(self, items) -> "List[str]":
+        """One-pass itemized fast path over native ``kv_encode``; see
+        ``DeviceAggState.update_items`` (same contract: returns
+        touched keys, None without the native module, raises
+        NonNumericValues with no state mutated)."""
+        from bytewax_tpu.engine.xla import NonNumericValues as _NNV
+        from bytewax_tpu.native import kv_encode as _kv_encode
+
+        n = len(items)
+        ids = np.empty(n, dtype=np.int32)
+        vals = np.empty(n, dtype=np.float64)
+        try:
+            res = _kv_encode(items, self._iddict, ids, vals)
+        except TypeError as ex:
+            raise _NNV(str(ex)) from ex
+        if res is None:
+            return None
+        new_keys, all_int = res
+        if all_int:
+            vals = vals.astype(np.int64)
+        try:
+            vals = self._pick_dtype(vals)
+        except (_NNV, TypeError):
+            for k in new_keys:
+                self._iddict.pop(k, None)
+            raise
+        if new_keys:
+            self._id_keys.extend(new_keys)
+            self._id_to_kid = np.concatenate(
+                [
+                    self._id_to_kid,
+                    np.fromiter(
+                        (self.alloc(k) for k in new_keys),
+                        dtype=np.int32,
+                        count=len(new_keys),
+                    ),
+                ]
+            )
+        self._dispatch(self._id_to_kid[ids], vals)
+        counts = np.bincount(ids, minlength=len(self._id_keys))
+        return [
+            self._id_keys[i] for i in np.nonzero(counts)[0].tolist()
+        ]
 
     def update(self, keys: np.ndarray, values: np.ndarray) -> List[str]:
         """Fold ``(key, value)`` rows in; returns the unique keys
@@ -511,6 +567,9 @@ class ShardedAggState:
         self._vocab = VocabMap(dtype=np.int32)
         self._enc.clear()
         self._kid_key.clear()
+        self._iddict = {}
+        self._id_keys = []
+        self._id_to_kid = np.empty(0, dtype=np.int32)
         return out
 
     def keys(self) -> List[str]:

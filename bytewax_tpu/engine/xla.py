@@ -119,6 +119,12 @@ class DeviceAggState:
         # Automatic encoder for plain string key columns: steady
         # state is one searchsorted per batch, no per-row hashing.
         self._enc = KeyEncoder()
+        # One-pass itemized promotion (native kv_encode): dense ids
+        # assigned in first-sight order, mapped to slots via one
+        # gather per batch.
+        self._iddict: Dict[str, int] = {}
+        self._id_keys: List[str] = []
+        self._id_to_slot = np.empty(0, dtype=np.int32)
 
     # -- slot management ---------------------------------------------------
 
@@ -181,6 +187,16 @@ class DeviceAggState:
             self.slot_keys[slot] = None  # type: ignore[call-overload]
             self._free.append(slot)
             self._enc.drop(key)
+            if self._iddict:
+                # Dense ids must stay collision-free (kv_encode
+                # assigns len(dict)), so a discard invalidates the
+                # itemized cache wholesale; keys re-intern to their
+                # existing slots on the next batch.  Callers that
+                # discard per-close (window accel) never use this
+                # cache, so the reset is effectively free.
+                self._iddict = {}
+                self._id_keys = []
+                self._id_to_slot = np.empty(0, dtype=np.int32)
 
     def _apply_resets(self) -> None:
         if self._fields is None:
@@ -252,6 +268,58 @@ class DeviceAggState:
                 )
                 raise TypeError(msg)
         return values
+
+    def update_items(self, items: List[Any]):
+        """One-pass itemized fast path: native ``kv_encode`` walks
+        each ``(key, value)`` tuple exactly once (dict-encode + value
+        fill), then one gather maps dense ids to slots and one
+        scatter folds the batch.  Returns the touched keys, or None
+        when the native module is unavailable (caller falls back).
+        Raises :class:`NonNumericValues` for rows the device tier
+        can't take, with no state mutated."""
+        from bytewax_tpu.native import kv_encode as _kv_encode
+
+        n = len(items)
+        ids = np.empty(n, dtype=np.int32)
+        vals = np.empty(n, dtype=np.float64)
+        try:
+            res = _kv_encode(items, self._iddict, ids, vals)
+        except TypeError as ex:
+            raise NonNumericValues(str(ex)) from ex
+        if res is None:
+            return None
+        new_keys, all_int = res
+        if all_int:
+            # Preserve the exact-integer accumulator the per-item
+            # path would have picked.
+            vals = vals.astype(np.int64)
+        try:
+            vals = self._pick_dtype(vals)
+        except (NonNumericValues, TypeError):
+            # Undo the C pass's id assignments so a host fallback
+            # (or any caller that survives the error) sees a
+            # genuinely untouched state.
+            for k in new_keys:
+                self._iddict.pop(k, None)
+            raise
+        if new_keys:
+            self._id_keys.extend(new_keys)
+            self._id_to_slot = np.concatenate(
+                [
+                    self._id_to_slot,
+                    np.fromiter(
+                        (self.alloc(k) for k in new_keys),
+                        dtype=np.int32,
+                        count=len(new_keys),
+                    ),
+                ]
+            )
+        self._ensure_fields()
+        self._scatter(self._id_to_slot[ids], vals)
+        counts = np.bincount(ids, minlength=len(self._id_keys))
+        return [
+            self._id_keys[i] for i in np.nonzero(counts)[0].tolist()
+        ]
 
     def update(self, keys: np.ndarray, values: np.ndarray) -> List[str]:
         """Fold ``(key, value)`` rows in; returns the unique keys
@@ -497,6 +565,9 @@ class DeviceAggState:
         self._vocab = VocabMap(dtype=np.int32)
         self._dev_map = None
         self._enc.clear()
+        self._iddict = {}
+        self._id_keys = []
+        self._id_to_slot = np.empty(0, dtype=np.int32)
         return out
 
     def keys(self) -> List[str]:

@@ -23,6 +23,7 @@ __all__ = [
     "bucket_adler",
     "group_kv",
     "is_available",
+    "kv_encode",
     "lib",
     "scan_emit",
     "scan_fill_values",
@@ -149,6 +150,18 @@ def scan_fill_values(groups, out) -> Any:
     return None if ext is None else ext.scan_fill_values(groups, out)
 
 
+def kv_encode(items, iddict, ids, vals) -> Any:
+    """One-pass itemized→columnar promotion: dictionary-encode the
+    keys of ``(str key, value)`` tuples through ``iddict`` (first-
+    sight dense ids) and fill values into the float64 buffer
+    ``vals`` / ids into the int32 buffer ``ids``.  Returns
+    ``(new_keys, all_int)``, or None without the native module.
+    Raises TypeError on malformed rows or non-numeric values (with
+    ``iddict`` rolled back) — callers fall back on that."""
+    ext = _ext()
+    return None if ext is None else ext.kv_encode(items, iddict, ids, vals)
+
+
 def scan_emit(groups, z, flags) -> Any:
     """Build the scan emission list ``[(key, (value, z, flag)), ...]``
     from the group dict plus device results (``z`` float32 buffer,
@@ -236,6 +249,25 @@ def _configure(cdll: ctypes.CDLL) -> None:
         ctypes.c_int64,
     ]
     cdll.line_offsets.restype = ctypes.c_int64
+    cdll.wc_new.restype = ctypes.c_void_p
+    cdll.wc_free.argtypes = [ctypes.c_void_p]
+    cdll.wc_vocab_size.argtypes = [ctypes.c_void_p]
+    cdll.wc_vocab_size.restype = ctypes.c_int32
+    cdll.wc_vocab_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_char_p,
+        ctypes.c_int32,
+    ]
+    cdll.wc_vocab_get.restype = ctypes.c_int32
+    cdll.wc_tokenize.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+    ]
+    cdll.wc_tokenize.restype = ctypes.c_int64
 
 
 class BrcParser:

@@ -295,6 +295,115 @@ done:
     return out;
 }
 
+/* One-pass itemized->columnar promotion for keyed aggregation:
+ * dictionary-encode the keys of (str key, value) 2-tuples through the
+ * caller's {key: dense_id} dict (assigning len(dict) to first-seen
+ * keys) and fill the values into a float64 buffer, walking each
+ * cache-cold item tuple exactly once.  Returns (new_keys, all_int):
+ * the keys added this call in id order, and whether every value was
+ * an exact int.  On error the added keys are rolled back out of the
+ * dict so the caller's id space stays consistent. */
+static PyObject *
+kv_encode(PyObject *self, PyObject *args)
+{
+    PyObject *items, *iddict, *ids_obj, *vals_obj;
+    if (!PyArg_ParseTuple(args, "O!O!OO", &PyList_Type, &items,
+                          &PyDict_Type, &iddict, &ids_obj, &vals_obj)) {
+        return NULL;
+    }
+    Py_buffer iv, vv;
+    if (PyObject_GetBuffer(ids_obj, &iv, PyBUF_CONTIG | PyBUF_WRITABLE) < 0) {
+        return NULL;
+    }
+    if (PyObject_GetBuffer(vals_obj, &vv, PyBUF_CONTIG | PyBUF_WRITABLE) < 0) {
+        PyBuffer_Release(&iv);
+        return NULL;
+    }
+    int32_t *ids = (int32_t *)iv.buf;
+    double *vals = (double *)vv.buf;
+    Py_ssize_t n = PyList_GET_SIZE(items);
+    PyObject *new_keys = NULL;
+    if (iv.len / (Py_ssize_t)sizeof(int32_t) < n
+        || vv.len / (Py_ssize_t)sizeof(double) < n) {
+        PyErr_SetString(PyExc_ValueError, "output buffers too small");
+        goto fail;
+    }
+    new_keys = PyList_New(0);
+    if (new_keys == NULL) {
+        goto fail;
+    }
+    int all_int = 1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GET_ITEM(items, i); /* borrowed */
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2) {
+            PyErr_SetString(PyExc_TypeError,
+                            "row is not a (key, value) 2-tuple");
+            goto fail;
+        }
+        PyObject *k = PyTuple_GET_ITEM(item, 0);
+        PyObject *v = PyTuple_GET_ITEM(item, 1);
+        if (!PyUnicode_Check(k)) {
+            PyErr_SetString(PyExc_TypeError, "key is not a str");
+            goto fail;
+        }
+        /* PyIndex_Check covers exact integers beyond PyLong (numpy
+         * int scalars implement __index__; floats do not), so int
+         * streams keep the exact integer accumulator. */
+        if (all_int && !PyIndex_Check(v)) {
+            all_int = 0;
+        }
+        double d = PyFloat_AsDouble(v);
+        if (d == -1.0 && PyErr_Occurred()) {
+            goto fail;
+        }
+        PyObject *id_obj = PyDict_GetItemWithError(iddict, k); /* borrowed */
+        long id;
+        if (id_obj != NULL) {
+            id = PyLong_AsLong(id_obj);
+        } else {
+            if (PyErr_Occurred()) {
+                goto fail;
+            }
+            id = (long)PyDict_GET_SIZE(iddict);
+            id_obj = PyLong_FromLong(id);
+            if (id_obj == NULL || PyDict_SetItem(iddict, k, id_obj) < 0) {
+                Py_XDECREF(id_obj);
+                goto fail;
+            }
+            Py_DECREF(id_obj);
+            if (PyList_Append(new_keys, k) < 0) {
+                goto fail;
+            }
+        }
+        ids[i] = (int32_t)id;
+        vals[i] = d;
+    }
+    PyBuffer_Release(&iv);
+    PyBuffer_Release(&vv);
+    PyObject *res = Py_BuildValue("(Oi)", new_keys, all_int);
+    Py_DECREF(new_keys);
+    return res;
+fail:
+    if (new_keys != NULL) {
+        /* Roll the added keys back out so a retry or fallback sees
+         * the dict exactly as before this call (the live exception
+         * is parked across the dict calls). */
+        PyObject *et, *ev, *tb;
+        PyErr_Fetch(&et, &ev, &tb);
+        Py_ssize_t added = PyList_GET_SIZE(new_keys);
+        for (Py_ssize_t j = 0; j < added; j++) {
+            if (PyDict_DelItem(iddict, PyList_GET_ITEM(new_keys, j)) < 0) {
+                PyErr_Clear();
+            }
+        }
+        PyErr_Restore(et, ev, tb);
+        Py_DECREF(new_keys);
+    }
+    PyBuffer_Release(&iv);
+    PyBuffer_Release(&vv);
+    return NULL;
+}
+
 static PyMethodDef HostOpsMethods[] = {
     {"group_kv", group_kv, METH_VARARGS,
      "Group a list of (str key, value) tuples into {key: [values]}."},
@@ -304,6 +413,8 @@ static PyMethodDef HostOpsMethods[] = {
      "Flatten {key: [values]} into a float64 buffer; return group sizes."},
     {"scan_emit", scan_emit, METH_VARARGS,
      "Build [(key, (value, z, flag)), ...] from groups + device results."},
+    {"kv_encode", kv_encode, METH_VARARGS,
+     "Dict-encode (str key, value) tuples + fill values in one pass."},
     {NULL, NULL, 0, NULL},
 };
 

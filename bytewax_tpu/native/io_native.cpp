@@ -19,10 +19,60 @@
 
 namespace {
 
-struct BrcParser {
-  std::unordered_map<std::string, int32_t> vocab_index;
-  std::vector<std::string> vocab;
+// Incrementally-grown string dictionary: ids are assigned in first-
+// sight order and never change (downstream device state keys on id
+// identity across batches).
+struct VocabSet {
+  std::unordered_map<std::string, int32_t> index;
+  std::vector<std::string> entries;
+
+  int32_t intern(const char* s, size_t n) {
+    std::string key(s, n);
+    auto it = index.find(key);
+    if (it != index.end()) return it->second;
+    int32_t id = static_cast<int32_t>(entries.size());
+    entries.push_back(key);
+    index.emplace(std::move(key), id);
+    return id;
+  }
 };
+
+struct BrcParser {
+  VocabSet vocab;
+};
+
+// Word tokenizer for the wordcount fast path: splits lowered text on
+// the same separator set as the Python-tier regex
+// [^\s!,.?":;0-9]+ (models/wordcount.py), restricted to ASCII
+// semantics — callers route non-ASCII lines through the Python
+// regex (bytes >= 0x80 are treated as word chars here, identical to
+// the regex for ASCII-whitespace-separated text).
+struct WordTokenizer {
+  VocabSet vocab;
+  bool stop[256] = {};
+
+  WordTokenizer() {
+    // Mirrors TOKEN_RE in bytewax_tpu/ops/text.py: ASCII \s per
+    // Python (space, \t-\r, and the \x1c-\x1f separators) plus the
+    // listed punctuation and digits.  Keep the three in sync (the
+    // parity test covers the edges).
+    for (int c : {(int)' ', (int)'\t', (int)'\n', (int)'\r', (int)'\v',
+                  (int)'\f', 0x1c, 0x1d, 0x1e, 0x1f, (int)'!', (int)',',
+                  (int)'.', (int)'?', (int)'"', (int)':', (int)';'}) {
+      stop[c] = true;
+    }
+    for (int c = '0'; c <= '9'; ++c) stop[c] = true;
+  }
+};
+
+int32_t vocab_get(const VocabSet& v, int32_t i, char* out, int32_t cap) {
+  if (i < 0 || i >= static_cast<int32_t>(v.entries.size())) return -1;
+  const std::string& s = v.entries[i];
+  int32_t n = static_cast<int32_t>(s.size());
+  if (n > cap) return -n;
+  std::memcpy(out, s.data(), n);
+  return n;
+}
 
 }  // namespace
 
@@ -33,15 +83,42 @@ BrcParser* brc_parser_new() { return new BrcParser(); }
 void brc_parser_free(BrcParser* p) { delete p; }
 
 int32_t brc_vocab_size(const BrcParser* p) {
-  return static_cast<int32_t>(p->vocab.size());
+  return static_cast<int32_t>(p->vocab.entries.size());
 }
 
 int32_t brc_vocab_get(const BrcParser* p, int32_t i, char* out, int32_t cap) {
-  if (i < 0 || i >= static_cast<int32_t>(p->vocab.size())) return -1;
-  const std::string& s = p->vocab[i];
-  int32_t n = static_cast<int32_t>(s.size());
-  if (n > cap) return -n;
-  std::memcpy(out, s.data(), n);
+  return vocab_get(p->vocab, i, out, cap);
+}
+
+WordTokenizer* wc_new() { return new WordTokenizer(); }
+
+void wc_free(WordTokenizer* p) { delete p; }
+
+int32_t wc_vocab_size(const WordTokenizer* p) {
+  return static_cast<int32_t>(p->vocab.entries.size());
+}
+
+int32_t wc_vocab_get(const WordTokenizer* p, int32_t i, char* out,
+                     int32_t cap) {
+  return vocab_get(p->vocab, i, out, cap);
+}
+
+// Tokenize a text buffer into dictionary-encoded word ids: one pass,
+// one hash lookup per word.  Returns tokens written, or -1 when
+// `cap` is too small.
+int64_t wc_tokenize(WordTokenizer* p, const char* buf, int64_t len,
+                    int32_t* ids, int64_t cap) {
+  int64_t n = 0;
+  const char* cur = buf;
+  const char* end = buf + len;
+  while (cur < end) {
+    while (cur < end && p->stop[static_cast<unsigned char>(*cur)]) ++cur;
+    if (cur >= end) break;
+    const char* start = cur;
+    while (cur < end && !p->stop[static_cast<unsigned char>(*cur)]) ++cur;
+    if (n >= cap) return -1;
+    ids[n++] = p->vocab.intern(start, cur - start);
+  }
   return n;
 }
 
@@ -72,16 +149,7 @@ int64_t brc_parse_chunk(BrcParser* p, const char* buf, int64_t len,
     if (nl == nullptr) nl = end;
 
     // Station id: one hash lookup per row; insert on first sight.
-    std::string station(cur, semi - cur);
-    auto it = p->vocab_index.find(station);
-    int32_t id;
-    if (it == p->vocab_index.end()) {
-      id = static_cast<int32_t>(p->vocab.size());
-      p->vocab_index.emplace(std::move(station), id);
-      p->vocab.push_back(std::string(cur, semi - cur));
-    } else {
-      id = it->second;
-    }
+    int32_t id = p->vocab.intern(cur, semi - cur);
 
     // Temperature: [-]d{1,2}.d → deci-degrees, branch-light parse.
     const char* t = semi + 1;

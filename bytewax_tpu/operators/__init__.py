@@ -1067,7 +1067,24 @@ def count_final(
     """
     from bytewax_tpu.xla import SUM
 
-    down = map("key", up, lambda x: (key(x), 1))
+    def _key_ones(batch):
+        """Batch-level keying: one listcomp per itemized batch; a
+        columnar batch that already carries a key column counts one
+        per row (``key`` applies to itemized rows only — columnar
+        rows are keyed by their own key/key_id column)."""
+        import numpy as _np
+
+        from bytewax_tpu.engine.arrays import ArrayBatch as _AB
+
+        if isinstance(batch, _AB):
+            if "key" in batch.cols or "key_id" in batch.cols:
+                cols = dict(batch.cols)
+                cols["value"] = _np.ones(len(batch), dtype=_np.int32)
+                return _AB(cols, key_vocab=batch.key_vocab)
+            batch = batch.to_pylist()
+        return [(key(x), 1) for x in batch]
+
+    down = flat_map_batch("key", up, _key_ones)
     return reduce_final("sum", down, SUM)
 
 

@@ -1,0 +1,132 @@
+"""Host data-plane text ops feeding the device tier.
+
+The reference tokenizes per line in Python UDFs
+(``/root/reference/examples/wordcount.py``); here tokenization is one
+native pass producing dictionary-encoded columnar batches, so the
+downstream keyed count rides the device scatter path without ever
+materializing per-word Python strings.
+"""
+
+import re
+from typing import Any, List, Optional
+
+import numpy as np
+
+from bytewax_tpu.engine.arrays import ArrayBatch
+
+__all__ = ["TOKEN_RE", "WordTokenizer", "native_tokenizer_available"]
+
+#: The canonical word-separator set (reference:
+#: ``examples/wordcount.py``).  The native tokenizer's stop table in
+#: ``native/io_native.cpp`` mirrors its ASCII subset — keep both in
+#: sync (tests/test_text.py covers the edges).
+TOKEN_RE = re.compile(r"[^\s!,.?\":;0-9]+")
+_TOKEN_RE = TOKEN_RE
+
+
+def native_tokenizer_available() -> bool:
+    """Whether the native tokenizer library can be built/loaded."""
+    from bytewax_tpu.native import is_available
+
+    return is_available()
+
+
+class WordTokenizer:
+    """A ``flat_map_batch`` mapper: batches of (already-lowercased)
+    text lines in, one dictionary-encoded ``ArrayBatch`` of
+    ``(key_id, 1)`` word rows out.
+
+    The word vocabulary grows in first-sight order and is append-only
+    across batches (id meanings never change), so downstream device
+    state keys on id identity.  ASCII lines tokenize in one native
+    pass; lines with non-ASCII characters fall back to the Python
+    regex per line (the extracted words re-enter the native vocab, so
+    both paths share one id space) — their word rows are appended
+    after the batch's ASCII rows.
+    """
+
+    def __init__(self):
+        import ctypes
+
+        from bytewax_tpu.native import lib
+
+        self._ctypes = ctypes
+        self._cdll = lib()
+        self._tok = self._cdll.wc_new()
+        self._vocab_cache: List[str] = []
+        self._vocab_np: Optional[np.ndarray] = None
+
+    def __del__(self):
+        tok = getattr(self, "_tok", None)
+        if tok:
+            self._cdll.wc_free(tok)
+            self._tok = None
+
+    def _tokenize_bytes(self, data: bytes) -> np.ndarray:
+        ctypes = self._ctypes
+        cap = len(data) // 2 + 1
+        ids = np.empty(cap, dtype=np.int32)
+        n = self._cdll.wc_tokenize(
+            self._tok,
+            data,
+            len(data),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cap,
+        )
+        if n < 0:  # pragma: no cover - cap is a strict upper bound
+            msg = "native tokenizer capacity overflow"
+            raise RuntimeError(msg)
+        return ids[:n]
+
+    def _vocab(self) -> np.ndarray:
+        """Current vocabulary as a numpy string array (a new, longer
+        array per growth — the engine's append-only contract)."""
+        ctypes = self._ctypes
+        size = self._cdll.wc_vocab_size(self._tok)
+        if self._vocab_np is not None and len(self._vocab_np) == size:
+            return self._vocab_np
+        while len(self._vocab_cache) < size:
+            i = len(self._vocab_cache)
+            buf = ctypes.create_string_buffer(1024)
+            n = self._cdll.wc_vocab_get(self._tok, i, buf, 1024)
+            if n < 0:  # word longer than the probe buffer
+                buf = ctypes.create_string_buffer(-n)
+                n = self._cdll.wc_vocab_get(self._tok, i, buf, -n)
+            self._vocab_cache.append(buf.raw[:n].decode("utf-8"))
+        self._vocab_np = np.array(self._vocab_cache)
+        return self._vocab_np
+
+    def __call__(self, lines: Any) -> Any:
+        if isinstance(lines, ArrayBatch):
+            lines = lines.to_pylist()
+        slow: List[str] = []
+        try:
+            # One join + one native pass for the ASCII batch body.
+            data = "\n".join(lines).encode("ascii")
+        except UnicodeEncodeError:
+            fast_lines = []
+            for line in lines:
+                (fast_lines if line.isascii() else slow).append(line)
+            data = "\n".join(fast_lines).encode("ascii")
+        ids = self._tokenize_bytes(data)
+        if slow:
+            # Python-regex words contain no native separator chars,
+            # so a space-joined re-pass interns them unsplit into the
+            # same id space.
+            words = []
+            for line in slow:
+                words.extend(_TOKEN_RE.findall(line))
+            if words:
+                slow_ids = self._tokenize_bytes(
+                    " ".join(words).encode("utf-8")
+                )
+                ids = np.concatenate([ids, slow_ids])
+        if not len(ids):
+            return []
+        return ArrayBatch(
+            {
+                "key_id": ids,
+                "value": np.ones(len(ids), dtype=np.int32),
+            },
+            key_vocab=self._vocab(),
+        )

@@ -126,3 +126,55 @@ def test_group_kv_matches_python_loop_in_dataflow(monkeypatch):
     slow = []
     run_main(build(slow))
     assert fast == slow
+
+
+def test_kv_encode_basic():
+    import numpy as np
+
+    from bytewax_tpu.native import kv_encode
+
+    items = [("a", 1), ("b", 2.5), ("a", 3)]
+    iddict = {}
+    ids = np.empty(3, dtype=np.int32)
+    vals = np.empty(3, dtype=np.float64)
+    res = kv_encode(items, iddict, ids, vals)
+    if res is None:
+        import pytest
+
+        pytest.skip("no native toolchain")
+    new_keys, all_int = res
+    assert new_keys == ["a", "b"]
+    assert all_int == 0  # 2.5 is a float
+    assert iddict == {"a": 0, "b": 1}
+    assert ids.tolist() == [0, 1, 0]
+    assert vals.tolist() == [1.0, 2.5, 3.0]
+    # Second batch: existing ids reused, only new keys reported.
+    items2 = [("b", 4), ("c", 5)]
+    ids2 = np.empty(2, dtype=np.int32)
+    vals2 = np.empty(2, dtype=np.float64)
+    new2, all_int2 = kv_encode(items2, iddict, ids2, vals2)
+    assert new2 == ["c"]
+    assert all_int2 == 1
+    assert ids2.tolist() == [1, 2]
+
+
+def test_kv_encode_rolls_back_on_error():
+    import numpy as np
+    import pytest
+
+    from bytewax_tpu.native import kv_encode
+
+    iddict = {"pre": 0}
+    items = [("pre", 1), ("new1", 2), ("bad", "not-a-number")]
+    ids = np.empty(3, dtype=np.int32)
+    vals = np.empty(3, dtype=np.float64)
+    try:
+        res = kv_encode([], iddict, np.empty(0, np.int32), np.empty(0, np.float64))
+    except TypeError:
+        res = None
+    if res is None:
+        pytest.skip("no native toolchain")
+    with pytest.raises(TypeError):
+        kv_encode(items, iddict, ids, vals)
+    # The keys added before the failure are rolled back.
+    assert iddict == {"pre": 0}

@@ -1,0 +1,89 @@
+"""Native tokenizer → device count path (wordcount fast path)."""
+
+import numpy as np
+import pytest
+
+from bytewax_tpu.models.wordcount import _TOKEN_RE, wordcount_flow
+from bytewax_tpu.ops.text import native_tokenizer_available
+from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+needs_native = pytest.mark.skipif(
+    not native_tokenizer_available(), reason="no native toolchain"
+)
+
+LINES = [
+    "Hello, hello world!",
+    "the quick Brown fox; the lazy dog.",
+    'say "what" twice: what what',
+    "numbers 123 do not 45 count",
+    "héllo wörld the the",  # non-ASCII lines take the regex fallback
+    "",
+    "  spaced   out  words  ",
+    "fs\x1cgs\x1drs\x1eus\x1fdone",  # \s control separators (ASCII path)
+    "tab\tand\x0bvertical\x0cfeeds",
+]
+
+
+def _counts(sink):
+    return dict(sink)
+
+
+@needs_native
+def test_native_wordcount_matches_host_tier():
+    dev, host = [], []
+    run_main(
+        wordcount_flow(TestingSource(LINES, batch_size=3), TestingSink(dev))
+    )
+    run_main(
+        wordcount_flow(
+            TestingSource(LINES, batch_size=3),
+            TestingSink(host),
+            tokenizer=_TOKEN_RE.findall,
+        )
+    )
+    assert _counts(dev) == _counts(host)
+    assert _counts(dev)["the"] == 4
+    assert all(isinstance(c, int) for _, c in dev)
+
+
+@needs_native
+def test_word_tokenizer_vocab_append_only():
+    from bytewax_tpu.ops.text import WordTokenizer
+
+    tok = WordTokenizer()
+    b1 = tok(["alpha beta alpha"])
+    v1 = np.asarray(b1.key_vocab)
+    assert v1.tolist() == ["alpha", "beta"]
+    assert b1.cols["key_id"].tolist() == [0, 1, 0]
+    b2 = tok(["beta gamma"])
+    v2 = np.asarray(b2.key_vocab)
+    # Ids keep their meaning; the vocab only ever extends.
+    assert v2[: len(v1)].tolist() == v1.tolist()
+    assert b2.cols["key_id"].tolist() == [1, 2]
+
+
+@needs_native
+def test_count_final_columnar_counts_rows_not_values():
+    # A columnar batch whose value column is NOT all-ones must still
+    # count one per row (count_final counts items, whatever columns
+    # ride along).
+    import bytewax_tpu.operators as op
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine.arrays import ArrayBatch
+    from tests.test_xla import ArraySource
+
+    batches = [
+        ArrayBatch(
+            {
+                "key": np.array(["a", "b", "a"]),
+                "value": np.array([10.0, 20.0, 30.0]),
+            }
+        )
+    ]
+    out = []
+    flow = Dataflow("count_cols")
+    s = op.input("inp", flow, ArraySource(batches))
+    s = op.count_final("count", s, lambda x: x)
+    op.output("out", s, TestingSink(out))
+    run_main(flow)
+    assert sorted(out) == [("a", 2), ("b", 1)]
