@@ -59,6 +59,20 @@ def _route_hash(key: str) -> int:
     return zlib.adler32(key.encode("utf-8"))
 
 
+def _py_scan_emit(groups, outs):
+    """Python emission of scan output columns over an insertion-
+    ordered group dict — same layout as the native ``scan_emit``,
+    without its dtype limits."""
+    cols = [np.asarray(o).tolist() for o in outs]
+    out_items = []
+    pos = 0
+    for key, values in groups.items():
+        for v in values:
+            out_items.append((key, (v, *(c[pos] for c in cols))))
+            pos += 1
+    return out_items
+
+
 def _route_hashes_of(strs) -> np.ndarray:
     """Vectorized ``_route_hash`` over an iterable of keys (hashes
     only the iterable — callers hash unique keys / vocab entries, not
@@ -857,8 +871,19 @@ class _StatefulBatchRt(_OpRt):
                 except TypeError as ex:
                     raise NonNumericValues(str(ex)) from ex
                 uniq = list(groups)
-                z, anomaly = sagg.update_grouped(uniq, lens, vals)
-                out_items = _native_scan_emit(groups, z, anomaly)
+                outs = sagg.update_grouped(uniq, lens, vals)
+                try:
+                    out_items = _native_scan_emit(
+                        groups,
+                        tuple(np.ascontiguousarray(o) for o in outs),
+                    )
+                except (TypeError, ValueError):
+                    # A kind emitted a column layout the native
+                    # emitter doesn't take (odd dtype, >8 columns):
+                    # the device state is already updated, so emit in
+                    # Python rather than fail the step — matching the
+                    # no-toolchain behavior for the same flow.
+                    out_items = _py_scan_emit(groups, outs)
                 codes = np.repeat(np.arange(len(lens)), lens)
                 return uniq, out_items, uniq, codes
         # No native toolchain: per-item promotion, Python emission.

@@ -38,16 +38,15 @@ def _annotate_accel(op: Operator) -> None:
     elif op.name == "stateful_map" and isinstance(
         op.conf.get("mapper"), ScanMap
     ):
-        mapper = op.conf["mapper"]
-        # Only kinds the device tier implements lower; user-defined
-        # ScanMap subclasses with other kinds stay host-tier (they
+        # The mapper names its own device lowering: any ScanKind —
+        # built-in or user-registered — lowers through the one
+        # generic path; mappers returning None stay host-tier (they
         # are still valid plain mappers).
-        if getattr(mapper, "kind", None) == "zscore" and hasattr(
-            mapper, "threshold"
-        ):
+        kind = op.conf["mapper"].device_kind()
+        if kind is not None:
             from bytewax_tpu.engine.scan_accel import ScanAccelSpec
 
-            spec = ScanAccelSpec("zscore", mapper.threshold)
+            spec = ScanAccelSpec(kind)
     elif op.name in ("count_window", "fold_window", "reduce_window"):
         spec = _window_accel_spec(op)
     if spec is not None:

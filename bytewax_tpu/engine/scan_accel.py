@@ -2,15 +2,20 @@
 
 :class:`bytewax_tpu.engine.xla.DeviceAggState` accelerates keyed
 *aggregations* (emit at EOF/window close); this module accelerates the
-per-item-emitting ``stateful_map`` shape for recognized numeric state
-kinds: per-key state lives in slot-table device arrays, each
-micro-batch is grouped by key on the host and folded through one
-segmented-scan program (:mod:`bytewax_tpu.ops.scan`), and every row's
-output is computed against its pre-update state — semantics identical
-to the host tier's one-mapper-call-per-item, at device batch speed.
+per-item-emitting ``stateful_map`` shape for any
+:class:`bytewax_tpu.ops.scan.ScanKind`: per-key state lives in
+slot-table device arrays (one column per kind field), each micro-batch
+is grouped by key on the host and folded through one segmented-scan
+program (:mod:`bytewax_tpu.ops.scan`), and every row's output is
+computed by the kind's ``emit`` — semantics identical to the host
+tier's one-mapper-call-per-item, at device batch speed.
 
-Snapshots are host-format tuples ``(count, mean, m2)`` interchangeable
-with the host tier (CLAUDE.md contract: cross-tier recovery).
+The state container is fully generic over the kind's declared fields:
+snapshots are host-format tuples in field order (e.g. ``(count, mean,
+m2)`` for z-score) interchangeable with the host tier (CLAUDE.md
+contract: cross-tier recovery), so a kind registered in user code —
+without any engine change — still round-trips through recovery stores
+written by either tier.
 """
 
 import math
@@ -20,6 +25,7 @@ import numpy as np
 
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
 from bytewax_tpu.engine.xla import NonNumericValues
+from bytewax_tpu.ops.scan import ScanKind
 
 __all__ = ["ScanAccelSpec", "DeviceScanState", "ScanEmit"]
 
@@ -30,60 +36,62 @@ class ScanAccelSpec:
     """Annotation on a core ``stateful_batch``: lower the enclosing
     ``stateful_map`` to a device segmented scan of this kind."""
 
-    def __init__(self, kind: str, threshold: float):
-        if kind != "zscore":
-            msg = f"unknown scan kind {kind!r}"
-            raise ValueError(msg)
+    def __init__(self, kind: ScanKind):
+        if not isinstance(kind, ScanKind):
+            msg = (
+                "ScanAccelSpec takes a bytewax_tpu.ops.scan.ScanKind "
+                f"instance; got {kind!r}"
+            )
+            raise TypeError(msg)
         self.kind = kind
-        self.threshold = float(threshold)
 
     def make_state(self) -> "DeviceScanState":
-        return DeviceScanState(self.threshold)
+        return DeviceScanState(self.kind)
 
     def __repr__(self) -> str:
-        return f"ScanAccelSpec({self.kind!r}, {self.threshold})"
+        return f"ScanAccelSpec({self.kind!r})"
 
 
 class ScanEmit:
     """One micro-batch's per-row outputs, in emission order (rows
     grouped by key, groups in first-appearance order, original order
-    within each group — the host tier's per-batch emission order)."""
+    within each group — the host tier's per-batch emission order).
+    ``outs`` holds the kind's output columns (e.g. ``(z, anomaly)``
+    for z-score)."""
 
-    __slots__ = ("keys", "values", "z", "anomaly", "codes", "uniq")
+    __slots__ = ("keys", "values", "outs", "codes", "uniq")
 
-    def __init__(self, keys, values, z, anomaly, codes, uniq):
+    def __init__(self, keys, values, outs, codes, uniq):
         self.keys = keys  # np[str], emission order
         self.values = values  # np, original dtype
-        self.z = z  # np.float32
-        self.anomaly = anomaly  # np.bool_
+        self.outs = outs  # tuple of np columns, emission order
         self.codes = codes  # np.int64 group code per row (emission order)
         self.uniq = uniq  # list[str], one per group code
 
-    def items(self) -> List[Tuple[str, Tuple[float, float, bool]]]:
+    def items(self) -> List[Tuple[str, Tuple]]:
+        cols = [col.tolist() for col in self.outs]
         return list(
             zip(
                 self.keys.tolist(),
-                zip(
-                    self.values.tolist(),
-                    self.z.tolist(),
-                    self.anomaly.tolist(),
-                ),
+                zip(self.values.tolist(), *cols),
             )
         )
 
 
 class DeviceScanState:
-    """Slot-table Welford state for one lowered ``stateful_map`` step.
+    """Slot-table scan state for one lowered ``stateful_map`` step.
 
     Keys occupy slots ``0..capacity-2``; the last slot is scratch for
     padding rows.  Tables double when full so XLA recompiles only
-    O(log n) shapes.
+    O(log n) shapes.  Field columns, their identity values, the
+    kernel, and the snapshot layout all come from the
+    :class:`~bytewax_tpu.ops.scan.ScanKind`.
     """
 
-    def __init__(self, threshold: float):
+    def __init__(self, kind: ScanKind):
         import jax.numpy as jnp
 
-        self.threshold = float(threshold)
+        self.kind = kind
         self.capacity = _MIN_CAPACITY
         self.key_to_slot: Dict[str, int] = {}
         self.slot_keys: List[Optional[str]] = []
@@ -95,12 +103,10 @@ class DeviceScanState:
 
     def _ensure_fields(self) -> None:
         if self._fields is None:
-            from bytewax_tpu.ops.scan import WELFORD_FIELDS
-
             jnp = self._jnp
             self._fields = {
                 name: jnp.full((self.capacity,), init, dtype=dtype)
-                for name, (init, dtype) in WELFORD_FIELDS.items()
+                for name, (init, dtype) in self.kind.fields.items()
             }
 
     def _grow_to(self, needed: int) -> None:
@@ -113,10 +119,14 @@ class DeviceScanState:
             jnp = self._jnp
             grown = {}
             for name, arr in self._fields.items():
-                pad = jnp.zeros((new_cap - self.capacity,), dtype=arr.dtype)
-                # The old scratch slot becomes a real slot: clear it.
+                init = self.kind.fields[name][0]
+                pad = jnp.full(
+                    (new_cap - self.capacity,), init, dtype=arr.dtype
+                )
+                # The old scratch slot becomes a real slot: clear it
+                # back to the field's identity.
                 grown[name] = jnp.concatenate(
-                    [arr.at[self.capacity - 1].set(0), pad]
+                    [arr.at[self.capacity - 1].set(init), pad]
                 )
             self._fields = grown
         self.capacity = new_cap
@@ -131,7 +141,10 @@ class DeviceScanState:
             if self._fields is not None:
                 # Freed slots keep stale state; reset on reuse.
                 for name in self._fields:
-                    self._fields[name] = self._fields[name].at[slot].set(0)
+                    init = self.kind.fields[name][0]
+                    self._fields[name] = (
+                        self._fields[name].at[slot].set(init)
+                    )
         else:
             self._grow_to(len(self.slot_keys) + 2)
             slot = len(self.slot_keys)
@@ -146,12 +159,11 @@ class DeviceScanState:
 
     def scan_rows(
         self, row_slots: np.ndarray, values: np.ndarray
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        """Run the segmented-scan program over pre-grouped rows (all
-        rows of a slot contiguous); returns per-row ``(z, anomaly)``."""
+    ) -> Tuple[np.ndarray, ...]:
+        """Run the kind's kernel over pre-grouped rows (all rows of a
+        slot contiguous); returns the kind's per-row output columns
+        (host numpy, finished by ``kind.post``)."""
         import jax
-
-        from bytewax_tpu.ops.scan import zscore_scan
 
         n = len(values)
         # Pad to the next power of two so XLA sees few distinct
@@ -163,20 +175,19 @@ class DeviceScanState:
         vals_p = np.zeros(padded, dtype=np.float32)
         vals_p[:n] = values
         self._ensure_fields()
-        z, self._fields = zscore_scan(
+        outs, self._fields = self.kind.run(
             self._fields,
             jax.device_put(slots_p),
             jax.device_put(vals_p),
         )
-        z_np = np.asarray(z)[:n]
-        return z_np, np.abs(z_np) > self.threshold
+        return self.kind.post(tuple(np.asarray(o)[:n] for o in outs))
 
     def update_grouped(
         self, uniq: List[str], lens: List[int], values: np.ndarray
-    ) -> Tuple[np.ndarray, np.ndarray]:
+    ) -> Tuple[np.ndarray, ...]:
         """Fold pre-grouped rows in: ``values`` holds each key's rows
         contiguously (group g = ``uniq[g]``, ``lens[g]`` rows);
-        returns per-row ``(z, anomaly)`` in the same order."""
+        returns the per-row output columns in the same order."""
         if values.dtype == object or values.dtype.kind in "USb":
             msg = (
                 "device-accelerated stateful_map requires numeric "
@@ -210,10 +221,8 @@ class DeviceScanState:
         order = np.argsort(codes, kind="stable")
         codes_s = codes[order]
         vals_s = values[order]
-        z_np, an_np = self.scan_rows(slot_of[codes_s], vals_s)
-        emit = ScanEmit(
-            keys[order], vals_s, z_np, an_np, codes_s, uniq_list
-        )
+        outs = self.scan_rows(slot_of[codes_s], vals_s)
+        emit = ScanEmit(keys[order], vals_s, outs, codes_s, uniq_list)
         return uniq_list, emit
 
     def update_batch(self, batch: ArrayBatch) -> Tuple[List[str], ScanEmit]:
@@ -248,25 +257,25 @@ class DeviceScanState:
 
     def load_many(self, items: List[Tuple[str, Any]]) -> None:
         """Batched resume: one scatter per field per page of
-        host-format ``(count, mean, m2)`` snapshots."""
+        host-format field-order state tuples."""
         if not items:
             return
         import jax
 
+        field_items = list(self.kind.fields.items())
         self._grow_to(len(self.key_to_slot) + len(items) + 1)
         self._ensure_fields()
-        counts = np.empty(len(items), dtype=np.int32)
-        means = np.empty(len(items), dtype=np.float32)
-        m2s = np.empty(len(items), dtype=np.float32)
+        cols = [
+            np.empty(len(items), dtype=np.dtype(dtype))
+            for _name, (_init, dtype) in field_items
+        ]
         slots = np.empty(len(items), dtype=np.int32)
         for i, (key, state) in enumerate(items):
-            count, mean, m2 = state
             slots[i] = self.alloc(key)
-            counts[i] = count
-            means[i] = mean
-            m2s[i] = m2
+            for j, part in enumerate(state):
+                cols[j][i] = part
         dev_slots = jax.device_put(slots)
-        for name, col in (("count", counts), ("mean", means), ("m2", m2s)):
+        for (name, _spec), col in zip(field_items, cols):
             self._fields[name] = (
                 self._fields[name].at[dev_slots].set(jax.device_put(col))
             )
@@ -276,6 +285,7 @@ class DeviceScanState:
         if self._fields is None or not keys:
             return [(k, None) for k in keys]
         host = self._fetch()
+        names = tuple(self.kind.fields)
         out = []
         for key in keys:
             slot = self.key_to_slot.get(key)
@@ -285,10 +295,8 @@ class DeviceScanState:
                 out.append(
                     (
                         key,
-                        (
-                            int(host["count"][slot]),
-                            float(host["mean"][slot]),
-                            float(host["m2"][slot]),
+                        self.kind.snapshot_of(
+                            tuple(host[nm][slot] for nm in names)
                         ),
                     )
                 )

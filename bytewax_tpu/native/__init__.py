@@ -162,13 +162,14 @@ def kv_encode(items, iddict, ids, vals) -> Any:
     return None if ext is None else ext.kv_encode(items, iddict, ids, vals)
 
 
-def scan_emit(groups, z, flags) -> Any:
-    """Build the scan emission list ``[(key, (value, z, flag)), ...]``
-    from the group dict plus device results (``z`` float32 buffer,
-    ``flags`` uint8 buffer) in one C pass, reusing the original key
-    and value objects; None without the native module."""
+def scan_emit(groups, outs) -> Any:
+    """Build the scan emission list ``[(key, (value, *outs)), ...]``
+    from the group dict plus the kind's output columns (a tuple of
+    contiguous 1-D numpy arrays — float, bool, or int, decided per
+    column from its buffer format) in one C pass, reusing the
+    original key and value objects; None without the native module."""
     ext = _ext()
-    return None if ext is None else ext.scan_emit(groups, z, flags)
+    return None if ext is None else ext.scan_emit(groups, outs)
 
 
 def _build() -> Optional[ctypes.CDLL]:

@@ -205,34 +205,79 @@ fail:
     return NULL;
 }
 
-/* Build the scan step's emission list [(key, (value, z, flag)), ...]
- * in one C pass over the insertion-ordered group dict plus the
- * device results (z float32 buffer, flags uint8 buffer) — reusing the
- * original key and value objects so only the per-row z float, bool,
- * and two tuples are allocated. */
+/* Build the scan step's emission list
+ * [(key, (value, out0, out1, ...)), ...] in one C pass over the
+ * insertion-ordered group dict plus the device output columns —
+ * reusing the original key and value objects so only the per-row
+ * scalars and two tuples are allocated.  The columns arrive as a
+ * tuple of contiguous 1-D buffers (numpy arrays); each element's
+ * Python conversion is picked from the buffer's format character
+ * (floats, bools, signed ints), so any ScanKind's output layout
+ * rides the same fast path. */
+#define SCAN_EMIT_MAX_OUTS 8
+
 static PyObject *
 scan_emit(PyObject *self, PyObject *args)
 {
-    PyObject *groups, *z_obj, *fl_obj;
-    if (!PyArg_ParseTuple(args, "O!OO", &PyDict_Type, &groups, &z_obj,
-                          &fl_obj)) {
+    PyObject *groups, *outs;
+    if (!PyArg_ParseTuple(args, "O!O!", &PyDict_Type, &groups,
+                          &PyTuple_Type, &outs)) {
         return NULL;
     }
-    Py_buffer zv, fv;
-    if (PyObject_GetBuffer(z_obj, &zv, PyBUF_CONTIG_RO) < 0) {
+    Py_ssize_t n_outs = PyTuple_GET_SIZE(outs);
+    if (n_outs < 1 || n_outs > SCAN_EMIT_MAX_OUTS) {
+        PyErr_Format(PyExc_ValueError,
+                     "scan_emit takes 1..%d output columns, got %zd",
+                     SCAN_EMIT_MAX_OUTS, n_outs);
         return NULL;
     }
-    if (PyObject_GetBuffer(fl_obj, &fv, PyBUF_CONTIG_RO) < 0) {
-        PyBuffer_Release(&zv);
-        return NULL;
-    }
-    const float *z = (const float *)zv.buf;
-    const unsigned char *flags = (const unsigned char *)fv.buf;
-    Py_ssize_t n = zv.len / (Py_ssize_t)sizeof(float);
+    Py_buffer views[SCAN_EMIT_MAX_OUTS];
+    /* 0 = float, 1 = bool, 2 = signed int (by itemsize). */
+    int conv[SCAN_EMIT_MAX_OUTS];
+    Py_ssize_t n_views = 0;
     PyObject *out = NULL;
-    if (fv.len != n) {
-        PyErr_SetString(PyExc_ValueError, "z/flags length mismatch");
-        goto done;
+    Py_ssize_t n = -1;
+    for (Py_ssize_t c = 0; c < n_outs; c++) {
+        if (PyObject_GetBuffer(PyTuple_GET_ITEM(outs, c), &views[c],
+                               PyBUF_CONTIG_RO | PyBUF_FORMAT) < 0) {
+            goto done;
+        }
+        n_views++;
+        Py_buffer *bv = &views[c];
+        char fmt = bv->format != NULL ? bv->format[0] : '\0';
+        if (fmt == '>' || fmt == '!') {
+            /* Non-native byte order would be silently mis-decoded by
+             * the native-endian loads below: make the caller
+             * normalize instead. */
+            PyErr_SetString(PyExc_TypeError,
+                            "scan output columns must be native-endian");
+            goto done;
+        }
+        if (fmt == '<' || fmt == '=' || fmt == '@') {
+            fmt = bv->format[1];
+        }
+        if (fmt == 'f' || fmt == 'd') {
+            conv[c] = 0;
+        } else if (fmt == '?') {
+            conv[c] = 1;
+        } else if (fmt == 'b' || fmt == 'h' || fmt == 'i' || fmt == 'l'
+                   || fmt == 'q') {
+            conv[c] = 2;
+        } else if (fmt == 'B') {
+            conv[c] = 3; /* uint8 data, NOT bool (numpy bool is '?') */
+        } else {
+            PyErr_Format(PyExc_TypeError,
+                         "unsupported scan output format '%c'", fmt);
+            goto done;
+        }
+        Py_ssize_t rows = bv->itemsize > 0 ? bv->len / bv->itemsize : 0;
+        if (n < 0) {
+            n = rows;
+        } else if (rows != n) {
+            PyErr_SetString(PyExc_ValueError,
+                            "scan output column length mismatch");
+            goto done;
+        }
     }
     out = PyList_New(n);
     if (out == NULL) {
@@ -253,25 +298,45 @@ scan_emit(PyObject *self, PyObject *args)
             goto done;
         }
         for (Py_ssize_t i = 0; i < m; i++) {
-            PyObject *zf = PyFloat_FromDouble((double)z[pos]);
-            if (zf == NULL) {
-                Py_CLEAR(out);
-                goto done;
-            }
-            PyObject *fl = flags[pos] ? Py_True : Py_False;
-            Py_INCREF(fl);
-            PyObject *inner = PyTuple_New(3);
+            PyObject *inner = PyTuple_New(1 + n_outs);
             if (inner == NULL) {
-                Py_DECREF(zf);
-                Py_DECREF(fl);
                 Py_CLEAR(out);
                 goto done;
             }
             PyObject *val = PyList_GET_ITEM(v, i);
             Py_INCREF(val);
             PyTuple_SET_ITEM(inner, 0, val);
-            PyTuple_SET_ITEM(inner, 1, zf);
-            PyTuple_SET_ITEM(inner, 2, fl);
+            for (Py_ssize_t c = 0; c < n_outs; c++) {
+                const char *p = (const char *)views[c].buf
+                                + pos * views[c].itemsize;
+                PyObject *cell;
+                if (conv[c] == 0) {
+                    double d = views[c].itemsize == 4
+                                   ? (double)*(const float *)p
+                                   : *(const double *)p;
+                    cell = PyFloat_FromDouble(d);
+                } else if (conv[c] == 1) {
+                    cell = *(const unsigned char *)p ? Py_True : Py_False;
+                    Py_INCREF(cell);
+                } else if (conv[c] == 3) {
+                    cell = PyLong_FromLong(*(const unsigned char *)p);
+                } else {
+                    long long iv;
+                    switch (views[c].itemsize) {
+                    case 1: iv = *(const signed char *)p; break;
+                    case 2: iv = *(const int16_t *)p; break;
+                    case 4: iv = *(const int32_t *)p; break;
+                    default: iv = *(const int64_t *)p; break;
+                    }
+                    cell = PyLong_FromLongLong(iv);
+                }
+                if (cell == NULL) {
+                    Py_DECREF(inner);
+                    Py_CLEAR(out);
+                    goto done;
+                }
+                PyTuple_SET_ITEM(inner, 1 + c, cell);
+            }
             PyObject *pair = PyTuple_New(2);
             if (pair == NULL) {
                 Py_DECREF(inner);
@@ -290,8 +355,9 @@ scan_emit(PyObject *self, PyObject *args)
         Py_CLEAR(out);
     }
 done:
-    PyBuffer_Release(&zv);
-    PyBuffer_Release(&fv);
+    for (Py_ssize_t c = 0; c < n_views; c++) {
+        PyBuffer_Release(&views[c]);
+    }
     return out;
 }
 
@@ -412,7 +478,7 @@ static PyMethodDef HostOpsMethods[] = {
     {"scan_fill_values", scan_fill_values, METH_VARARGS,
      "Flatten {key: [values]} into a float64 buffer; return group sizes."},
     {"scan_emit", scan_emit, METH_VARARGS,
-     "Build [(key, (value, z, flag)), ...] from groups + device results."},
+     "Build [(key, (value, *outs)), ...] from groups + output columns."},
     {"kv_encode", kv_encode, METH_VARARGS,
      "Dict-encode (str key, value) tuples + fill values in one pass."},
     {NULL, NULL, 0, NULL},

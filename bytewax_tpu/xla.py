@@ -38,8 +38,10 @@ __all__ = [
     "ScanMap",
     "SUM",
     "WindowFold",
+    "ema",
     "jit_batch",
     "map_batch",
+    "running_extrema",
     "stats_final",
     "zscore",
 ]
@@ -126,14 +128,27 @@ class ScanMap:
     """A ``stateful_map`` mapper with a device lowering.
 
     Callable like a plain ``(state, value) -> (state, emit)`` mapper
-    (the host tier uses it directly); ``kind`` names the segmented
-    per-key device scan the engine lowers to
-    (:mod:`bytewax_tpu.ops.scan`) when values are numeric.  State is a
-    plain tuple, interchangeable between tiers through recovery
+    (the host tier uses it directly); :meth:`device_kind` returns the
+    :class:`bytewax_tpu.ops.scan.ScanKind` the engine lowers to
+    (:mod:`bytewax_tpu.ops.scan`) when values are numeric — or
+    ``None`` to stay host-tier.  State is a plain tuple in the kind's
+    field order, interchangeable between tiers through recovery
     snapshots.
+
+    Subclass this to register a new device scan in user code: give
+    the host semantics in ``__call__`` and return a ``ScanKind``
+    (built-in or your own) from ``device_kind`` — no engine changes
+    needed.  The reference's ``stateful_map`` takes any mapper
+    (``/root/reference/pysrc/bytewax/operators/__init__.py`` ~2920);
+    here any mapper runs host-tier, and any *monoid-expressible*
+    mapper additionally runs at device batch speed through this hook.
     """
 
-    kind: str
+    kind: str = "?"
+
+    def device_kind(self):
+        """The ``ScanKind`` to lower to, or None for host-only."""
+        return None
 
 
 class _ZScoreMap(ScanMap):
@@ -165,6 +180,11 @@ class _ZScoreMap(ScanMap):
         m2 += delta * (value - mean)
         return (count, mean, m2), (value, z, is_anomaly)
 
+    def device_kind(self):
+        from bytewax_tpu.ops.scan import WelfordZScore
+
+        return WelfordZScore(self.threshold)
+
     def __repr__(self) -> str:
         return f"bytewax_tpu.xla.zscore({self.threshold})"
 
@@ -178,6 +198,78 @@ def zscore(threshold: float = 3.0) -> ScanMap:
     the host tier runs it as a plain mapper with identical semantics.
     """
     return _ZScoreMap(threshold)
+
+
+class _EmaMap(ScanMap):
+    """Per-key debiased exponential moving average: state is
+    ``(count, s)`` with ``s`` the biased accumulator; each value
+    emits ``(value, ema)`` with the debiased mean *after* folding the
+    value in (so a key's first value emits itself)."""
+
+    kind = "ema"
+
+    def __init__(self, alpha: float):
+        if not 0.0 < alpha <= 1.0:
+            msg = f"ema alpha must be in (0, 1], got {alpha}"
+            raise ValueError(msg)
+        self.alpha = float(alpha)
+
+    def __call__(self, state, value):
+        count, s = (0, 0.0) if state is None else state
+        count += 1
+        s = s * (1.0 - self.alpha) + self.alpha * value
+        ema = s / (1.0 - (1.0 - self.alpha) ** count)
+        return (count, s), (value, ema)
+
+    def device_kind(self):
+        from bytewax_tpu.ops.scan import Ema
+
+        return Ema(self.alpha)
+
+    def __repr__(self) -> str:
+        return f"bytewax_tpu.xla.ema({self.alpha})"
+
+
+def ema(alpha: float) -> ScanMap:
+    """A ``stateful_map`` mapper computing each key's debiased
+    exponential moving average (smoothing factor ``alpha``).
+
+    Emits ``(value, ema)`` per item.  The engine lowers it to one
+    segmented-scan device program per micro-batch (the EMA recurrence
+    is an associative affine composition); the host tier runs it as a
+    plain mapper with identical semantics.
+    """
+    return _EmaMap(alpha)
+
+
+class _RunningExtremaMap(ScanMap):
+    """Per-key running min/max: state ``(mn, mx)``; each value emits
+    ``(value, min_so_far, max_so_far)`` including the value itself."""
+
+    kind = "extrema"
+
+    def __call__(self, state, value):
+        mn, mx = (
+            (float("inf"), float("-inf")) if state is None else state
+        )
+        mn = value if value < mn else mn
+        mx = value if value > mx else mx
+        return (mn, mx), (value, mn, mx)
+
+    def device_kind(self):
+        from bytewax_tpu.ops.scan import RunningExtrema
+
+        return RunningExtrema()
+
+    def __repr__(self) -> str:
+        return "bytewax_tpu.xla.running_extrema()"
+
+
+def running_extrema() -> ScanMap:
+    """A ``stateful_map`` mapper tracking each key's running min and
+    max.  Emits ``(value, min_so_far, max_so_far)`` per item; lowers
+    to the device segmented scan like :func:`zscore`."""
+    return _RunningExtremaMap()
 
 
 class JaxUDF:

@@ -67,7 +67,8 @@ def test_annotation_marks_scan_spec():
     ]
     assert len(specs) == 1
     assert isinstance(specs[0], ScanAccelSpec)
-    assert specs[0].threshold == 2.5
+    assert specs[0].kind.name == "zscore"
+    assert specs[0].kind.threshold == 2.5
 
 
 def test_unknown_scanmap_kind_stays_host_tier():
@@ -158,7 +159,9 @@ def test_mixed_malformed_rows_error_like_host():
 
 
 def test_scan_state_snapshot_roundtrip():
-    st = DeviceScanState(2.0)
+    from bytewax_tpu.ops.scan import WelfordZScore
+
+    st = DeviceScanState(WelfordZScore(2.0))
     touched, emit = st.update(
         np.array(["a", "a", "b"]), np.array([1.0, 2.0, 10.0])
     )
@@ -170,14 +173,14 @@ def test_scan_state_snapshot_roundtrip():
     assert mean == pytest.approx(1.5)
     assert m2 == pytest.approx(0.5)
     # Resume into a fresh state: continues identically.
-    st2 = DeviceScanState(2.0)
+    st2 = DeviceScanState(WelfordZScore(2.0))
     st2.load_many([(k, s) for k, s in snaps.items() if s is not None])
     _, emit2 = st2.update(np.array(["a"]), np.array([3.0]))
     mapper = xla.zscore(2.0)
     host_state = (2, 1.5, 0.5)
     _, (v, z, a) = mapper(host_state, 3.0)
-    assert emit2.z[0] == pytest.approx(z, abs=1e-5)
-    assert bool(emit2.anomaly[0]) == a
+    assert emit2.outs[0][0] == pytest.approx(z, abs=1e-5)
+    assert bool(emit2.outs[1][0]) == a
 
 
 def test_device_snapshot_resumes_on_host_tier(tmp_path, recovery_config):
@@ -215,6 +218,267 @@ def test_device_snapshot_resumes_on_host_tier(tmp_path, recovery_config):
         else:
             os.environ["BYTEWAX_TPU_ACCEL"] = env_prev
     _assert_scored_equal(out1 + out2, want, atol=1e-4)
+
+
+def _oracle_for(mapper_factory, items):
+    """Run any host mapper per item in Python (the host tier)."""
+    states = {}
+    out = []
+    mapper = mapper_factory()
+    for k, v in items:
+        st, emit = mapper(states.get(k), v)
+        states[k] = st
+        out.append((k, emit))
+    return states, out
+
+
+def _rand_items(n=300, n_keys=4, seed=11):
+    rng = np.random.RandomState(seed)
+    return [
+        (f"k{rng.randint(0, n_keys)}", float(np.round(rng.randn(), 3)))
+        for _ in range(n)
+    ]
+
+
+def _run_kind_flow(items, mapper, batch_size=7):
+    out = []
+    flow = Dataflow("scan_kind")
+    s = op.input("inp", flow, TestingSource(items, batch_size=batch_size))
+    s = op.stateful_map("scan", s, mapper)
+    op.output("out", s, TestingSink(out))
+    plan = flatten(flow)
+    specs = [
+        o.conf.get("_accel")
+        for o in plan.ops
+        if o.name == "stateful_batch"
+    ]
+    assert isinstance(specs[0], ScanAccelSpec)
+    run_main(flow)
+    return out
+
+
+def _assert_rows_close(got, want, atol=1e-4):
+    assert len(got) == len(want)
+    by_g, by_w = {}, {}
+    for k, row in got:
+        by_g.setdefault(k, []).append(row)
+    for k, row in want:
+        by_w.setdefault(k, []).append(row)
+    assert by_g.keys() == by_w.keys()
+    for k in by_w:
+        for g_row, w_row in zip(by_g[k], by_w[k]):
+            assert len(g_row) == len(w_row)
+            for g_cell, w_cell in zip(g_row, w_row):
+                if isinstance(w_cell, bool):
+                    assert g_cell == w_cell
+                else:
+                    assert g_cell == pytest.approx(w_cell, abs=atol)
+
+
+def test_ema_kind_matches_host_oracle():
+    items = _rand_items()
+    _, want = _oracle_for(lambda: xla.ema(0.3), items)
+    got = _run_kind_flow(items, xla.ema(0.3))
+    _assert_rows_close(got, want)
+
+
+def test_extrema_kind_matches_host_oracle():
+    items = _rand_items(seed=5)
+    _, want = _oracle_for(xla.running_extrema, items)
+    got = _run_kind_flow(items, xla.running_extrema())
+    _assert_rows_close(got, want)
+
+
+def test_ema_cross_tier_snapshot(recovery_config):
+    """EMA snapshots written by the device tier resume on the host
+    tier — the generic field-order snapshot contract."""
+    from datetime import timedelta
+
+    from bytewax_tpu.testing import TestingSource as TS
+
+    items = [("a", 1.0), ("a", 2.0), ("b", 5.0)]
+    tail = [("a", 3.0), ("b", 6.0)]
+    _, want = _oracle_for(lambda: xla.ema(0.5), items + tail)
+    inp = items + [TS.ABORT()] + tail
+
+    def build(out):
+        flow = Dataflow("scan_ema_rt")
+        s = op.input("inp", flow, TestingSource(inp, batch_size=2))
+        s = op.stateful_map("scan", s, xla.ema(0.5))
+        op.output("out", s, TestingSink(out))
+        return flow
+
+    out1 = []
+    run_main(
+        build(out1),
+        epoch_interval=timedelta(0),
+        recovery_config=recovery_config,
+    )
+    out2 = []
+    env_prev = os.environ.get("BYTEWAX_TPU_ACCEL")
+    os.environ["BYTEWAX_TPU_ACCEL"] = "0"
+    try:
+        run_main(
+            build(out2),
+            epoch_interval=timedelta(0),
+            recovery_config=recovery_config,
+        )
+    finally:
+        if env_prev is None:
+            os.environ.pop("BYTEWAX_TPU_ACCEL", None)
+        else:
+            os.environ["BYTEWAX_TPU_ACCEL"] = env_prev
+    _assert_rows_close(out1 + out2, want)
+
+
+def test_user_registered_kind_runs_on_device(recovery_config):
+    """A ScanKind defined HERE — no engine changes — lowers through
+    the generic kernel and round-trips snapshots cross-tier.
+
+    The kind: per-key running sum with count, emitting
+    ``(value, running_total)``.
+    """
+    import jax.numpy as jnp
+
+    from bytewax_tpu.ops.scan import ScanKind
+
+    class RunningSumKind(ScanKind):
+        name = "running_sum"
+        fields = {
+            "count": (0, jnp.int32),
+            "total": (0.0, jnp.float32),
+        }
+
+        def lift(self, values):
+            n = values.shape[0]
+            return jnp.ones((n,), dtype=jnp.int32), values
+
+        def merge(self, a, b):
+            return a[0] + b[0], a[1] + b[1]
+
+        def emit(self, pre, post, values):
+            return (post[1],)
+
+    class RunningSumMap(xla.ScanMap):
+        kind = "running_sum"
+
+        def __call__(self, state, value):
+            count, total = (0, 0.0) if state is None else state
+            count += 1
+            total += value
+            return (count, total), (value, total)
+
+        def device_kind(self):
+            return RunningSumKind()
+
+    items = [("a", 1.0), ("b", 10.0), ("a", 2.0), ("a", 3.0), ("b", 5.0)]
+    _, want = _oracle_for(RunningSumMap, items)
+    got = _run_kind_flow(items, RunningSumMap(), batch_size=2)
+    _assert_rows_close(got, want)
+
+    # Cross-tier: device-written snapshots resume under the host tier.
+    from datetime import timedelta
+
+    from bytewax_tpu.testing import TestingSource as TS
+
+    tail = [("a", 4.0), ("b", 1.0)]
+    _, want_all = _oracle_for(RunningSumMap, items + tail)
+    inp = items + [TS.ABORT()] + tail
+
+    def build(out):
+        flow = Dataflow("scan_user_rt")
+        s = op.input("inp", flow, TestingSource(inp, batch_size=2))
+        s = op.stateful_map("scan", s, RunningSumMap())
+        op.output("out", s, TestingSink(out))
+        return flow
+
+    out1 = []
+    run_main(
+        build(out1),
+        epoch_interval=timedelta(0),
+        recovery_config=recovery_config,
+    )
+    out2 = []
+    env_prev = os.environ.get("BYTEWAX_TPU_ACCEL")
+    os.environ["BYTEWAX_TPU_ACCEL"] = "0"
+    try:
+        run_main(
+            build(out2),
+            epoch_interval=timedelta(0),
+            recovery_config=recovery_config,
+        )
+    finally:
+        if env_prev is None:
+            os.environ.pop("BYTEWAX_TPU_ACCEL", None)
+        else:
+            os.environ["BYTEWAX_TPU_ACCEL"] = env_prev
+    _assert_rows_close(out1 + out2, want_all)
+
+
+def test_zscore_generic_kernel_matches_specialized():
+    """WelfordZScore's lift/merge/emit (the generic-kernel spelling)
+    must agree with its specialized pivot-shifted kernel — they are
+    two formulations of the same scan, and this pins them together so
+    neither drifts."""
+    import jax.numpy as jnp
+
+    from bytewax_tpu.ops.scan import WelfordZScore, generic_scan_kernel
+
+    rng = np.random.RandomState(9)
+    n = 64
+    slots = np.sort(rng.randint(0, 4, size=n)).astype(np.int32)
+    vals = rng.randn(n).astype(np.float32)
+
+    kind = WelfordZScore(2.0)
+    def fresh_fields():
+        # Both kernels donate their state argument: each needs its
+        # own arrays.
+        return {
+            nm: jnp.full((8,), init, dtype=dt)
+            for nm, (init, dt) in kind.fields.items()
+        }
+
+    fields_a = fresh_fields()
+    fields_b = fresh_fields()
+
+    (z_spec,), new_spec = kind.run(fields_a, jnp.asarray(slots), jnp.asarray(vals))
+    generic = generic_scan_kernel(kind)
+    (z_gen,), new_gen = generic(fields_b, jnp.asarray(slots), jnp.asarray(vals))
+
+    np.testing.assert_allclose(
+        np.asarray(z_spec), np.asarray(z_gen), atol=1e-4
+    )
+    for nm in kind.fields:
+        np.testing.assert_allclose(
+            np.asarray(new_spec[nm])[:4],
+            np.asarray(new_gen[nm])[:4],
+            atol=1e-3,
+        )
+
+
+def test_ema_tiny_alpha_stays_finite():
+    """alpha below f32's rounding of 1-alpha must not collapse the
+    debias factor (naive (1-alpha)^n rounds to 1 and divides by ~0);
+    the expm1/log1p spelling keeps device ≈ host."""
+    alpha = 1e-8
+    items = [("a", float(v)) for v in [3.0, 5.0, 4.0, 6.0]]
+    _, want = _oracle_for(lambda: xla.ema(alpha), items)
+    got = _run_kind_flow(items, xla.ema(alpha), batch_size=2)
+    _assert_rows_close(got, want, atol=1e-3)
+
+
+def test_count_stays_exact_past_fp24():
+    """The Welford count rides int32 end-to-end: a key whose lifetime
+    count exceeds 2^24 keeps counting exactly (an fp32 count would
+    freeze at 16,777,216: n + 1 == n)."""
+    from bytewax_tpu.ops.scan import WelfordZScore
+
+    big = 1 << 24
+    st = DeviceScanState(WelfordZScore(3.0))
+    st.load_many([("a", (big, 0.0, 1000.0))])
+    st.update(np.array(["a", "a"]), np.array([1.0, -1.0]))
+    (count, _mean, _m2) = dict(st.snapshots_for(["a"]))["a"]
+    assert count == big + 2
 
 
 def test_welford_merge_matches_sequential():
