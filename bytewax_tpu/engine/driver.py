@@ -706,21 +706,51 @@ class _StatefulBatchRt(_OpRt):
                     )
                 self._emit_window_events(events)
                 continue
+            if isinstance(items, ArrayBatch):
+                items = items.to_pylist()
+            if type(items) is list and items:
+                # Itemized promotion: one native pass turns
+                # (key, datetime) / (key, TsValue) rows into id/ts/
+                # value columns feeding the vectorized ingest — the
+                # same pattern as _process_scan_accel.  Rows that
+                # can't promote fall through to the per-item path
+                # (or, for numeric folds with no state yet, to the
+                # host tier, which re-runs the fold per item with its
+                # own step-qualified errors).
+                try:
+                    with self._timer("stateful_batch_on_batch").time():
+                        events = self.wagg.on_batch_items(items)
+                except NonNumericValues:
+                    events = None
+                    if (
+                        self.wagg.spec.kind != "count"
+                        and self.wagg.is_empty()
+                        and not self.logics
+                    ):
+                        self.wagg = None
+                        self.process("up", entries[i:])
+                        return
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(
+                        self.op.step_id, "the device window fold", ex
+                    )
+                if events is not None:
+                    self._emit_window_events(events)
+                    continue
             if (
                 self.wagg.spec.kind != "count"
                 and self.wagg.is_empty()
                 and not self.logics
             ):
-                # Numeric windowed folds only run on device for
-                # columnar key/ts/value batches; itemized deliveries
-                # can't promise timestamp-bearing numeric values, so
-                # permanently fall back to the host tier before any
-                # device state exists.
+                # Numeric windowed folds with no native toolchain
+                # only run on device for columnar key/ts/value
+                # batches; itemized deliveries can't promise
+                # timestamp-bearing numeric values, so permanently
+                # fall back to the host tier before any device state
+                # exists.
                 self.wagg = None
                 self.process("up", entries[i:])
                 return
-            if isinstance(items, ArrayBatch):
-                items = items.to_pylist()
             keys: List[str] = []
             values: List[Any] = []
             for item in items:

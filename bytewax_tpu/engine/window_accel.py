@@ -50,6 +50,20 @@ class _LateTs:
         )
 
 
+class _ItemVals:
+    """Late-value view for promoted itemized batches: row index →
+    the row's original value object (so late events carry the same
+    object the host tier would emit — a TsValue keeps its ``.ts``)."""
+
+    __slots__ = ("_items",)
+
+    def __init__(self, items):
+        self._items = items
+
+    def __getitem__(self, row: int):
+        return self._items[row][1]
+
+
 class WindowAccelSpec:
     """Flatten-time annotation: lower this windowed fold to device."""
 
@@ -142,6 +156,9 @@ class DeviceWindowAggState:
         self._vocab = VocabMap(dtype=np.int64)
         # Automatic encoder for plain string key columns.
         self._enc = KeyEncoder()
+        # Sticky marker: itemized promotion failed a deterministic
+        # check; stop re-trying it every batch.
+        self._promote_failed = False
 
     # -- clock -------------------------------------------------------------
 
@@ -211,6 +228,92 @@ class DeviceWindowAggState:
     def is_empty(self) -> bool:
         return not self.open_close_us and not self.keys and not self.touched
 
+    def on_batch_items(
+        self, items: List[Any]
+    ) -> Optional[List[Tuple[str, Tuple[int, str, Any]]]]:
+        """Itemized promotion: one native pass dictionary-encodes the
+        keys of timestamped ``(key, value)`` tuples and extracts
+        epoch-us timestamps — ``(key, datetime)`` rows (counts) or
+        ``(key, TsValue)`` rows (numeric folds) — then ingests the
+        columns exactly like ``on_batch_columnar``.  Returns None when
+        the native module is unavailable (caller runs the per-item
+        path); raises :class:`NonNumericValues` when the rows can't
+        promote (malformed/mixed shapes, non-UTC timestamps, a
+        ts_getter that disagrees with the row's own timestamp) so the
+        caller can fall back, matching ``_process_scan_accel``.
+        """
+        from bytewax_tpu.engine.xla import NonNumericValues
+        from bytewax_tpu.native import wa_encode
+
+        if getattr(self, "_promote_failed", False):
+            # A previous batch failed a deterministic promotion check
+            # (getter disagreement, shape/kind mismatch): don't pay
+            # the full encode + rejection on every batch.
+            return None
+        n = len(items)
+        ids = np.empty(n, dtype=np.int32)
+        ts_us = np.empty(n, dtype=np.float64)
+        vals = np.empty(n, dtype=np.float64)
+        # The native id dict shares the engine's key-id space; resync
+        # when other ingest paths (columnar, per-item) allocated ids
+        # this dict hasn't seen.
+        iddict = getattr(self, "_item_iddict", None)
+        if iddict is None or len(iddict) != len(self.key_ids):
+            iddict = dict(self.key_ids)
+            self._item_iddict = iddict
+        try:
+            res = wa_encode(items, iddict, ids, ts_us, vals)
+        except (TypeError, AttributeError) as ex:
+            # AttributeError: a float-coercible value without the
+            # TsValue `.ts` attribute.
+            raise NonNumericValues(str(ex)) from ex
+        if res is None:
+            return None
+        new_keys, mode = res
+        if mode == 1 and self.spec.kind != "count":
+            # Bare datetimes carry no foldable value; the numeric
+            # fold must see the rows itemized (and will raise the
+            # host tier's own error).
+            self._promote_failed = True
+            msg = "datetime-only rows can't feed a numeric windowed fold"
+            raise NonNumericValues(msg)
+        # The promotion bypasses spec.ts_getter; verify on a spread
+        # sample of rows that the getter agrees with the row's own
+        # timestamp.  This is the promotion contract (documented on
+        # EventClock): the getter must read the row's datetime /
+        # TsValue ``.ts`` — a getter transforming timestamps
+        # nonuniformly within one batch can evade a finite sample and
+        # must not be combined with promotable row shapes.  Sub-us
+        # slack: .timestamp() arithmetic is float, the native path is
+        # exact integer microseconds.
+        probes = sorted(
+            {int(p) for p in np.linspace(0, n - 1, min(n, 8))}
+        ) if n else ()
+        for probe in probes:
+            try:
+                got = _to_us(self.spec.ts_getter(items[probe][1]))
+            except Exception as ex:  # noqa: BLE001 — getter rejects row
+                raise NonNumericValues(str(ex)) from ex
+            if abs(got - ts_us[probe]) > 1.0:
+                self._promote_failed = True
+                msg = (
+                    "ts_getter disagrees with the row timestamp; "
+                    "itemized windowing promotion needs a getter "
+                    "reading the row's own datetime/TsValue.ts"
+                )
+                raise NonNumericValues(msg)
+        if new_keys:
+            kids_new = self._key_ids_for(new_keys)
+            # wa_encode assigned len(iddict)-ordered ids; they must
+            # line up with the engine's first-seen allocation.
+            assert int(kids_new[-1]) == len(self.keys) - 1
+        kids = ids.astype(np.int64)
+        if self.spec.kind == "count":
+            return self._ingest(kids, ts_us, _LateTs(ts_us))
+        # Late events carry the original value objects (a TsValue
+        # keeps its .ts); the fold consumes the encoded column.
+        return self._ingest(kids, ts_us, _ItemVals(items), fold_vals=vals)
+
     def on_batch(
         self, keys: List[str], values: List[Any]
     ) -> List[Tuple[str, Tuple[int, str, Any]]]:
@@ -226,8 +329,11 @@ class DeviceWindowAggState:
         return self._ingest(kids, ts_us, values)
 
     def _ingest(
-        self, kids: np.ndarray, ts_us: np.ndarray, values
+        self, kids: np.ndarray, ts_us: np.ndarray, values, fold_vals=None
     ) -> List[Tuple[str, Tuple[int, str, Any]]]:
+        """``values`` is indexed per late row (original objects where
+        available); ``fold_vals`` optionally supplies the numeric fold
+        column when ``values`` is a lazy view rather than an array."""
         spec = self.spec
         now_us = datetime.now(timezone.utc).timestamp() * _US
         self.touched.update(
@@ -308,6 +414,8 @@ class DeviceWindowAggState:
             ts_ok = ts_us[ok]
             if spec.kind == "count":
                 vals_ok = np.ones(int(ok.sum()), dtype=np.float64)
+            elif fold_vals is not None:
+                vals_ok = fold_vals[ok]
             else:
                 vals_ok = np.asarray(values)[ok]  # keep dtype for exact ints
             self._absorb(kids_ok, ts_ok, vals_ok)

@@ -20,6 +20,7 @@ import numpy as np
 
 __all__ = [
     "BrcParser",
+    "any_isinstance",
     "bucket_adler",
     "group_kv",
     "is_available",
@@ -27,6 +28,7 @@ __all__ = [
     "lib",
     "scan_emit",
     "scan_fill_values",
+    "wa_encode",
 ]
 
 _HERE = Path(__file__).parent
@@ -160,6 +162,29 @@ def kv_encode(items, iddict, ids, vals) -> Any:
     ``iddict`` rolled back) — callers fall back on that."""
     ext = _ext()
     return None if ext is None else ext.kv_encode(items, iddict, ids, vals)
+
+
+def any_isinstance(items, types) -> Optional[bool]:
+    """``any(isinstance(x, types) for x in items)`` in one C pass
+    with a last-clean-type cache (homogeneous lists cost one pointer
+    compare per item); None without the native module."""
+    ext = _ext()
+    return None if ext is None else ext.any_isinstance(items, types)
+
+
+def wa_encode(items, iddict, ids, tss, vals) -> Any:
+    """One-pass itemized→columnar promotion for event-time windowing:
+    dictionary-encode the keys of timestamped ``(str key, value)``
+    tuples through ``iddict`` and fill epoch-us timestamps into the
+    float64 buffer ``tss`` / values into ``vals`` / ids into the
+    int32 buffer ``ids``.  Two uniform row shapes: value is a UTC
+    datetime (mode 1: counts) or a float carrying a UTC datetime
+    ``ts`` attribute (mode 2: the TsValue degrade shape).  Returns
+    ``(new_keys, mode)``, or None without the native module.  Raises
+    TypeError on malformed/mixed rows or non-UTC timestamps (with
+    ``iddict`` rolled back) — callers fall back on that."""
+    ext = _ext()
+    return None if ext is None else ext.wa_encode(items, iddict, ids, tss, vals)
 
 
 def scan_emit(groups, outs) -> Any:

@@ -10,6 +10,15 @@
  */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <datetime.h>
+
+#if PY_VERSION_HEX < 0x030A0000
+/* 3.9 lacks the tzinfo accessor macro; same layout read. */
+#define PyDateTime_DATE_GET_TZINFO(o)                                  \
+    (((PyDateTime_DateTime *)(o))->hastzinfo                           \
+         ? ((PyDateTime_DateTime *)(o))->tzinfo                        \
+         : Py_None)
+#endif
 
 static PyObject *
 group_kv(PyObject *self, PyObject *args)
@@ -470,6 +479,217 @@ fail:
     return NULL;
 }
 
+/* any(isinstance(x, types) for x in items) in one C pass with a
+ * last-clean-type cache: homogeneous lists (the overwhelmingly common
+ * benchmark/test shape) cost one pointer compare per item after the
+ * first isinstance check. */
+static PyObject *
+any_isinstance(PyObject *self, PyObject *args)
+{
+    PyObject *items, *types;
+    if (!PyArg_ParseTuple(args, "O!O", &PyList_Type, &items, &types)) {
+        return NULL;
+    }
+    PyTypeObject *clean = NULL;
+    Py_ssize_t n = PyList_GET_SIZE(items);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *it = PyList_GET_ITEM(items, i); /* borrowed */
+        if (Py_TYPE(it) == clean) {
+            continue;
+        }
+        int r = PyObject_IsInstance(it, types);
+        if (r < 0) {
+            return NULL;
+        }
+        if (r) {
+            Py_RETURN_TRUE;
+        }
+        clean = Py_TYPE(it);
+    }
+    Py_RETURN_FALSE;
+}
+
+/* Days since the Unix epoch for a proleptic-Gregorian civil date
+ * (Howard Hinnant's days_from_civil). */
+static int64_t
+days_from_civil(int y, int m, int d)
+{
+    y -= m <= 2;
+    int64_t era = (y >= 0 ? y : y - 399) / 400;
+    unsigned yoe = (unsigned)(y - era * 400);
+    unsigned doy = (unsigned)((153 * (m + (m > 2 ? -3 : 9)) + 2) / 5
+                              + d - 1);
+    unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+    return era * 146097 + (int64_t)doe - 719468;
+}
+
+/* Epoch-microseconds of a UTC-tzinfo datetime via pure arithmetic
+ * (no per-item .timestamp() call).  Returns -1 with an exception set
+ * when the object is not a datetime carrying the UTC singleton
+ * tzinfo — non-UTC (or naive) timestamps take the per-item Python
+ * path, which handles any tzinfo via .timestamp(). */
+static int
+utc_dt_to_us(PyObject *v, double *out)
+{
+    if (!PyDateTime_Check(v)
+        || PyDateTime_DATE_GET_TZINFO(v) != PyDateTime_TimeZone_UTC) {
+        PyErr_SetString(PyExc_TypeError,
+                        "timestamp is not a UTC-tzinfo datetime");
+        return -1;
+    }
+    int64_t days = days_from_civil(PyDateTime_GET_YEAR(v),
+                                   PyDateTime_GET_MONTH(v),
+                                   PyDateTime_GET_DAY(v));
+    int64_t secs = days * 86400
+                   + PyDateTime_DATE_GET_HOUR(v) * 3600
+                   + PyDateTime_DATE_GET_MINUTE(v) * 60
+                   + PyDateTime_DATE_GET_SECOND(v);
+    *out = (double)(secs * 1000000 + PyDateTime_DATE_GET_MICROSECOND(v));
+    return 0;
+}
+
+/* One-pass itemized->columnar promotion for event-time windowing:
+ * dictionary-encode the keys of (str key, value) 2-tuples through the
+ * caller's {key: dense_id} dict (assigning len(dict) to first-seen
+ * keys, like kv_encode) and fill per-row (epoch-us timestamp, float
+ * value) columns.  Two row shapes, uniform per call:
+ *   mode 1: value is a UTC datetime (windowed counts) -> ts = value,
+ *           val = 1.0;
+ *   mode 2: value is float-coercible and carries a UTC datetime in a
+ *           `ts` attribute (the TsValue degrade shape) -> val =
+ *           float(value), ts = value.ts.
+ * Returns (new_keys, mode); raises TypeError (with the iddict rolled
+ * back) on malformed or mixed rows so the caller can fall back. */
+static PyObject *
+wa_encode(PyObject *self, PyObject *args)
+{
+    PyObject *items, *iddict, *ids_obj, *ts_obj, *vals_obj;
+    if (!PyArg_ParseTuple(args, "O!O!OOO", &PyList_Type, &items,
+                          &PyDict_Type, &iddict, &ids_obj, &ts_obj,
+                          &vals_obj)) {
+        return NULL;
+    }
+    Py_buffer iv, tv, vv;
+    if (PyObject_GetBuffer(ids_obj, &iv, PyBUF_CONTIG | PyBUF_WRITABLE) < 0) {
+        return NULL;
+    }
+    if (PyObject_GetBuffer(ts_obj, &tv, PyBUF_CONTIG | PyBUF_WRITABLE) < 0) {
+        PyBuffer_Release(&iv);
+        return NULL;
+    }
+    if (PyObject_GetBuffer(vals_obj, &vv, PyBUF_CONTIG | PyBUF_WRITABLE) < 0) {
+        PyBuffer_Release(&iv);
+        PyBuffer_Release(&tv);
+        return NULL;
+    }
+    int32_t *ids = (int32_t *)iv.buf;
+    double *tss = (double *)tv.buf;
+    double *vals = (double *)vv.buf;
+    Py_ssize_t n = PyList_GET_SIZE(items);
+    PyObject *new_keys = NULL;
+    int mode = 0;
+    if (iv.len / (Py_ssize_t)sizeof(int32_t) < n
+        || tv.len / (Py_ssize_t)sizeof(double) < n
+        || vv.len / (Py_ssize_t)sizeof(double) < n) {
+        PyErr_SetString(PyExc_ValueError, "output buffers too small");
+        goto fail;
+    }
+    new_keys = PyList_New(0);
+    if (new_keys == NULL) {
+        goto fail;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GET_ITEM(items, i); /* borrowed */
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2) {
+            PyErr_SetString(PyExc_TypeError,
+                            "row is not a (key, value) 2-tuple");
+            goto fail;
+        }
+        PyObject *k = PyTuple_GET_ITEM(item, 0);
+        PyObject *v = PyTuple_GET_ITEM(item, 1);
+        if (!PyUnicode_Check(k)) {
+            PyErr_SetString(PyExc_TypeError, "key is not a str");
+            goto fail;
+        }
+        if (PyDateTime_Check(v)) {
+            if (mode == 2) {
+                PyErr_SetString(PyExc_TypeError,
+                                "mixed datetime/value row shapes");
+                goto fail;
+            }
+            mode = 1;
+            if (utc_dt_to_us(v, &tss[i]) < 0) {
+                goto fail;
+            }
+            vals[i] = 1.0;
+        } else {
+            if (mode == 1) {
+                PyErr_SetString(PyExc_TypeError,
+                                "mixed datetime/value row shapes");
+                goto fail;
+            }
+            mode = 2;
+            double d = PyFloat_AsDouble(v);
+            if (d == -1.0 && PyErr_Occurred()) {
+                goto fail;
+            }
+            PyObject *ts = PyObject_GetAttrString(v, "ts");
+            if (ts == NULL) {
+                goto fail;
+            }
+            int bad = utc_dt_to_us(ts, &tss[i]);
+            Py_DECREF(ts);
+            if (bad < 0) {
+                goto fail;
+            }
+            vals[i] = d;
+        }
+        PyObject *id_obj = PyDict_GetItemWithError(iddict, k); /* borrowed */
+        long id;
+        if (id_obj != NULL) {
+            id = PyLong_AsLong(id_obj);
+        } else {
+            if (PyErr_Occurred()) {
+                goto fail;
+            }
+            id = (long)PyDict_GET_SIZE(iddict);
+            id_obj = PyLong_FromLong(id);
+            if (id_obj == NULL || PyDict_SetItem(iddict, k, id_obj) < 0) {
+                Py_XDECREF(id_obj);
+                goto fail;
+            }
+            Py_DECREF(id_obj);
+            if (PyList_Append(new_keys, k) < 0) {
+                goto fail;
+            }
+        }
+        ids[i] = (int32_t)id;
+    }
+    PyBuffer_Release(&iv);
+    PyBuffer_Release(&tv);
+    PyBuffer_Release(&vv);
+    PyObject *res = Py_BuildValue("(Oi)", new_keys, mode);
+    Py_DECREF(new_keys);
+    return res;
+fail:
+    if (new_keys != NULL) {
+        PyObject *et, *ev, *tb;
+        PyErr_Fetch(&et, &ev, &tb);
+        Py_ssize_t added = PyList_GET_SIZE(new_keys);
+        for (Py_ssize_t j = 0; j < added; j++) {
+            if (PyDict_DelItem(iddict, PyList_GET_ITEM(new_keys, j)) < 0) {
+                PyErr_Clear();
+            }
+        }
+        PyErr_Restore(et, ev, tb);
+        Py_DECREF(new_keys);
+    }
+    PyBuffer_Release(&iv);
+    PyBuffer_Release(&tv);
+    PyBuffer_Release(&vv);
+    return NULL;
+}
+
 static PyMethodDef HostOpsMethods[] = {
     {"group_kv", group_kv, METH_VARARGS,
      "Group a list of (str key, value) tuples into {key: [values]}."},
@@ -481,6 +701,11 @@ static PyMethodDef HostOpsMethods[] = {
      "Build [(key, (value, *outs)), ...] from groups + output columns."},
     {"kv_encode", kv_encode, METH_VARARGS,
      "Dict-encode (str key, value) tuples + fill values in one pass."},
+    {"any_isinstance", any_isinstance, METH_VARARGS,
+     "any(isinstance(x, types) for x in items) with a clean-type cache."},
+    {"wa_encode", wa_encode, METH_VARARGS,
+     "Dict-encode timestamped (str key, value) tuples + fill (ts, value) "
+     "columns in one pass."},
     {NULL, NULL, 0, NULL},
 };
 
@@ -492,5 +717,9 @@ static struct PyModuleDef hostopsmodule = {
 PyMODINIT_FUNC
 PyInit_host_ops(void)
 {
+    PyDateTime_IMPORT;
+    if (PyDateTimeAPI == NULL) {
+        return NULL;
+    }
     return PyModule_Create(&hostopsmodule);
 }

@@ -329,7 +329,14 @@ class EventClock(Clock[V, _EventClockState]):
     they are not out-of-order by more than the waiting duration.
 
     :arg ts_getter: Called once per value to get its (timezone-aware,
-        UTC) timestamp.
+        UTC) timestamp.  Device-tier note: when values carry their own
+        timestamp (bare ``datetime`` items or ``TsValue``), the
+        engine's itemized promotion reads that timestamp directly and
+        verifies the getter agrees on a spread sample of each batch —
+        a getter that *transforms* timestamps (rather than reading the
+        value's own) nonuniformly within a batch must not be paired
+        with those promotable shapes (use a wrapper value type or
+        pre-transform upstream).
     :arg wait_for_system_duration: How long to wait for out-of-order
         values after seeing a timestamp.
     :arg now_getter: Source of "system" time; defaults to the current

@@ -145,9 +145,19 @@ class _IterSourcePartition(StatefulSourcePartition[X, int]):
             self._lst: Optional[List] = ib
             self._idx = self._start_idx
             self._it = iter(())
-            self._lst_clean = not any(
-                isinstance(x, self._SENTINELS) for x in ib
-            )
+            has_sentinel = None
+            if len(ib) >= 4096:
+                # Long lists (the benchmark shape) take the C scan;
+                # short ones stay pure Python so constructing a tiny
+                # test source never triggers the lazy native build.
+                from bytewax_tpu.native import any_isinstance
+
+                has_sentinel = any_isinstance(ib, self._SENTINELS)
+            if has_sentinel is None:  # short list / no toolchain
+                has_sentinel = any(
+                    isinstance(x, self._SENTINELS) for x in ib
+                )
+            self._lst_clean = not has_sentinel
         else:
             self._lst = None
             self._it = iter(ib)

@@ -1059,3 +1059,152 @@ def test_key_id_without_vocab_raises_clearly():
     ):
         with pytest.raises(TypeError, match="key_vocab"):
             ArrayBatch(cols).to_pylist()
+
+
+def test_itemized_promotion_unit_matches_per_item_path():
+    """on_batch_items (native wa_encode promotion) must produce the
+    same events and snapshots as the per-item on_batch path for both
+    row shapes: (key, datetime) counts and (key, TsValue) sums."""
+    from bytewax_tpu import xla
+    from bytewax_tpu.engine.window_accel import (
+        DeviceWindowAggState,
+        WindowAccelSpec,
+    )
+
+    pytest.importorskip("bytewax_tpu.native")
+    from bytewax_tpu.native import wa_encode as _probe
+
+    if _probe([], {}, np.empty(0, np.int32), np.empty(0), np.empty(0)) is None:
+        pytest.skip("native toolchain unavailable")
+
+    def specs(kind, getter):
+        return WindowAccelSpec(
+            kind,
+            getter,
+            ALIGN,
+            timedelta(minutes=1),
+            timedelta(minutes=1),
+            timedelta(0),
+        )
+
+    # Count shape: values ARE the timestamps.
+    items = [
+        ("a", ALIGN + timedelta(seconds=s)) for s in (1, 2, 61, 150)
+    ] + [("b", ALIGN + timedelta(seconds=5))]
+    st_promo = specs("count", lambda x: x).make_state()
+    st_items = specs("count", lambda x: x).make_state()
+    ev_promo = st_promo.on_batch_items(list(items))
+    assert ev_promo is not None
+    ev_items = st_items.on_batch(
+        [k for k, _ in items], [v for _, v in items]
+    )
+    assert ev_promo == ev_items
+    assert dict(st_promo.snapshots_for(["a", "b"])).keys() == dict(
+        st_items.snapshots_for(["a", "b"])
+    ).keys()
+
+    # TsValue shape: floats carrying their event timestamp.
+    rows = [
+        ("a", xla.TsValue(2.0, ALIGN + timedelta(seconds=1))),
+        ("a", xla.TsValue(3.0, ALIGN + timedelta(seconds=2))),
+        ("b", xla.TsValue(7.0, ALIGN + timedelta(seconds=61))),
+    ]
+    st2_promo = specs("sum", xla.column_ts).make_state()
+    st2_items = specs("sum", xla.column_ts).make_state()
+    ev2_promo = st2_promo.on_batch_items(list(rows))
+    assert ev2_promo is not None
+    ev2_items = st2_items.on_batch(
+        [k for k, _ in rows], [v for _, v in rows]
+    )
+    assert ev2_promo == ev2_items
+
+
+def test_itemized_promotion_rejects_disagreeing_getter():
+    """A ts_getter that does NOT read the row's own timestamp must
+    force the per-item path (NonNumericValues), not silently use the
+    row timestamp."""
+    from bytewax_tpu.engine.window_accel import WindowAccelSpec
+    from bytewax_tpu.engine.xla import NonNumericValues
+    from bytewax_tpu.native import wa_encode as _probe
+
+    if _probe([], {}, np.empty(0, np.int32), np.empty(0), np.empty(0)) is None:
+        pytest.skip("native toolchain unavailable")
+
+    shifted = WindowAccelSpec(
+        "count",
+        lambda x: x + timedelta(hours=1),  # disagrees with the row ts
+        ALIGN,
+        timedelta(minutes=1),
+        timedelta(minutes=1),
+        timedelta(0),
+    ).make_state()
+    with pytest.raises(NonNumericValues):
+        shifted.on_batch_items([("a", ALIGN + timedelta(seconds=1))])
+
+
+def test_itemized_promotion_rejects_non_utc():
+    """Non-UTC tzinfo rows take the per-item path (its .timestamp()
+    handles any tz); the native promotion must refuse them."""
+    from bytewax_tpu.engine.window_accel import WindowAccelSpec
+    from bytewax_tpu.engine.xla import NonNumericValues
+    from bytewax_tpu.native import wa_encode as _probe
+
+    if _probe([], {}, np.empty(0, np.int32), np.empty(0), np.empty(0)) is None:
+        pytest.skip("native toolchain unavailable")
+
+    offset_tz = timezone(timedelta(hours=2))
+    st = WindowAccelSpec(
+        "count",
+        lambda x: x,
+        ALIGN,
+        timedelta(minutes=1),
+        timedelta(minutes=1),
+        timedelta(0),
+    ).make_state()
+    with pytest.raises(NonNumericValues):
+        st.on_batch_items(
+            [("a", datetime(2022, 1, 1, 2, 0, 1, tzinfo=offset_tz))]
+        )
+
+
+def test_itemized_tsvalue_flow_device_matches_host(monkeypatch):
+    """End-to-end: a TsValue itemized stream through reduce_window
+    rides the promotion on the device tier and matches the host tier
+    exactly."""
+    from bytewax_tpu import xla
+
+    rng = np.random.RandomState(4)
+    inp = [
+        (
+            f"k{rng.randint(0, 3)}",
+            xla.TsValue(
+                float(np.round(rng.randn(), 3)),
+                ALIGN + timedelta(seconds=int(s)),
+            ),
+        )
+        for s in range(300)
+    ]
+    windower = TumblingWindower(length=timedelta(minutes=1), align_to=ALIGN)
+
+    def run(accel):
+        monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1" if accel else "0")
+        clock = EventClock(
+            ts_getter=xla.column_ts,
+            wait_for_system_duration=timedelta(0),
+        )
+        out = []
+        flow = Dataflow("test_df")
+        s = op.input("inp", flow, TestingSource(list(inp), batch_size=32))
+        wo = w.reduce_window("sum", s, clock, windower, xla.SUM)
+        op.output("out", wo.down, TestingSink(out))
+        run_main(flow)
+        return out
+
+    got = run(True)
+    want = run(False)
+    gd = {(k, wid): v for k, (wid, v) in got}
+    wd = {(k, wid): v for k, (wid, v) in want}
+    assert gd.keys() == wd.keys()
+    for kw in wd:
+        # Device folds in f32; host in f64.
+        assert gd[kw] == pytest.approx(wd[kw], abs=1e-4)
