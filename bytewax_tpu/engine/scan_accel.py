@@ -16,6 +16,12 @@ m2)`` for z-score) interchangeable with the host tier (CLAUDE.md
 contract: cross-tier recovery), so a kind registered in user code —
 without any engine change — still round-trips through recovery stores
 written by either tier.
+
+On hosts with more than one local device the spec builds the
+mesh-sharded sibling instead
+(:class:`bytewax_tpu.engine.sharded_state.ShardedScanState`), which
+shares this module's update surface (:class:`ScanUpdates`) and
+snapshot format.
 """
 
 import math
@@ -27,9 +33,38 @@ from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
 from bytewax_tpu.engine.xla import NonNumericValues
 from bytewax_tpu.ops.scan import ScanKind
 
-__all__ = ["ScanAccelSpec", "DeviceScanState", "ScanEmit"]
+__all__ = ["ScanAccelSpec", "DeviceScanState", "ScanEmit", "ScanUpdates"]
 
 _MIN_CAPACITY = 1024
+
+
+def _require_numeric(values: np.ndarray) -> None:
+    if values.dtype == object or values.dtype.kind in "USb":
+        msg = (
+            "device-accelerated stateful_map requires numeric "
+            "values; arbitrary-state mappers run on the host tier"
+        )
+        raise NonNumericValues(msg)
+
+
+def _batch_keys(batch: ArrayBatch) -> np.ndarray:
+    """The key strings of a columnar batch feeding a scan step."""
+    if "value" not in batch.cols:
+        msg = (
+            "columnar batch feeding an accelerated stateful_map "
+            "needs a 'value' column"
+        )
+        raise TypeError(msg)
+    if "key_id" in batch.cols and batch.key_vocab is not None:
+        vocab = np.asarray(batch.key_vocab)
+        return vocab[batch.numpy("key_id")]
+    if "key" in batch.cols:
+        return batch.numpy("key")
+    msg = (
+        "columnar batch feeding an accelerated stateful_map "
+        "needs a 'key' or dictionary-encoded 'key_id' column"
+    )
+    raise TypeError(msg)
 
 
 class ScanAccelSpec:
@@ -45,8 +80,12 @@ class ScanAccelSpec:
             raise TypeError(msg)
         self.kind = kind
 
-    def make_state(self) -> "DeviceScanState":
-        return DeviceScanState(self.kind)
+    def make_state(self):
+        # Mesh-sharded (exchange + per-shard segmented scan over ICI)
+        # when >1 local device; single-device slot table otherwise.
+        from bytewax_tpu.engine.sharded_state import make_scan_state
+
+        return make_scan_state(self.kind)
 
     def __repr__(self) -> str:
         return f"ScanAccelSpec({self.kind!r})"
@@ -78,7 +117,52 @@ class ScanEmit:
         )
 
 
-class DeviceScanState:
+class ScanUpdates:
+    """The scan-state update surface, shared by the single-device and
+    mesh-sharded tiers.  Hosts provide ``alloc(key) -> id`` and
+    ``_dispatch(ids, values) -> outs`` — the per-row output columns in
+    row order (both callers feed pre-grouped rows, so row order IS the
+    grouped emission order)."""
+
+    def update_grouped(
+        self, uniq: List[str], lens: List[int], values: np.ndarray
+    ) -> Tuple[np.ndarray, ...]:
+        """Fold pre-grouped rows in: ``values`` holds each key's rows
+        contiguously (group g = ``uniq[g]``, ``lens[g]`` rows);
+        returns the per-row output columns in the same order."""
+        _require_numeric(values)
+        id_of = np.fromiter(
+            (self.alloc(k) for k in uniq), dtype=np.int32, count=len(uniq)
+        )
+        return self._dispatch(np.repeat(id_of, lens), values)
+
+    def update(
+        self, keys: np.ndarray, values: np.ndarray
+    ) -> Tuple[List[str], ScanEmit]:
+        """Fold ``(key, value)`` rows in; returns the unique keys
+        touched plus the per-row outputs in grouped emission order."""
+        keys = np.asarray(keys)
+        values = np.asarray(values)
+        _require_numeric(values)
+        codes, uniq = factorize_keys(keys)
+        uniq_list = [str(k) for k in uniq.tolist()]
+        id_of = np.fromiter(
+            (self.alloc(k) for k in uniq_list),
+            dtype=np.int32,
+            count=len(uniq_list),
+        )
+        order = np.argsort(codes, kind="stable")
+        codes_s = codes[order]
+        vals_s = values[order]
+        outs = self._dispatch(id_of[codes_s], vals_s)
+        emit = ScanEmit(keys[order], vals_s, outs, codes_s, uniq_list)
+        return uniq_list, emit
+
+    def update_batch(self, batch: ArrayBatch) -> Tuple[List[str], ScanEmit]:
+        return self.update(_batch_keys(batch), batch._scaled_values())
+
+
+class DeviceScanState(ScanUpdates):
     """Slot-table scan state for one lowered ``stateful_map`` step.
 
     Keys occupy slots ``0..capacity-2``; the last slot is scratch for
@@ -162,7 +246,8 @@ class DeviceScanState:
     ) -> Tuple[np.ndarray, ...]:
         """Run the kind's kernel over pre-grouped rows (all rows of a
         slot contiguous); returns the kind's per-row output columns
-        (host numpy, finished by ``kind.post``)."""
+        (host numpy, finished by ``kind.post``).  This is the
+        ``ScanUpdates`` dispatch hook."""
         import jax
 
         n = len(values)
@@ -182,68 +267,7 @@ class DeviceScanState:
         )
         return self.kind.post(tuple(np.asarray(o)[:n] for o in outs))
 
-    def update_grouped(
-        self, uniq: List[str], lens: List[int], values: np.ndarray
-    ) -> Tuple[np.ndarray, ...]:
-        """Fold pre-grouped rows in: ``values`` holds each key's rows
-        contiguously (group g = ``uniq[g]``, ``lens[g]`` rows);
-        returns the per-row output columns in the same order."""
-        if values.dtype == object or values.dtype.kind in "USb":
-            msg = (
-                "device-accelerated stateful_map requires numeric "
-                "values; arbitrary-state mappers run on the host tier"
-            )
-            raise NonNumericValues(msg)
-        slot_of = np.fromiter(
-            (self.alloc(k) for k in uniq), dtype=np.int32, count=len(uniq)
-        )
-        row_slots = np.repeat(slot_of, lens)
-        return self.scan_rows(row_slots, values)
-
-    def update(self, keys: np.ndarray, values: np.ndarray) -> Tuple[List[str], ScanEmit]:
-        """Fold ``(key, value)`` rows in; returns the unique keys
-        touched plus the per-row outputs in emission order."""
-        keys = np.asarray(keys)
-        values = np.asarray(values)
-        if values.dtype == object or values.dtype.kind in "USb":
-            msg = (
-                "device-accelerated stateful_map requires numeric "
-                "values; arbitrary-state mappers run on the host tier"
-            )
-            raise NonNumericValues(msg)
-        codes, uniq = factorize_keys(keys)
-        uniq_list = [str(k) for k in uniq.tolist()]
-        slot_of = np.fromiter(
-            (self.alloc(k) for k in uniq_list),
-            dtype=np.int32,
-            count=len(uniq_list),
-        )
-        order = np.argsort(codes, kind="stable")
-        codes_s = codes[order]
-        vals_s = values[order]
-        outs = self.scan_rows(slot_of[codes_s], vals_s)
-        emit = ScanEmit(keys[order], vals_s, outs, codes_s, uniq_list)
-        return uniq_list, emit
-
-    def update_batch(self, batch: ArrayBatch) -> Tuple[List[str], ScanEmit]:
-        if "value" not in batch.cols:
-            msg = (
-                "columnar batch feeding an accelerated stateful_map "
-                "needs a 'value' column"
-            )
-            raise TypeError(msg)
-        if "key_id" in batch.cols and batch.key_vocab is not None:
-            vocab = np.asarray(batch.key_vocab)
-            keys = vocab[batch.numpy("key_id")]
-        elif "key" in batch.cols:
-            keys = batch.numpy("key")
-        else:
-            msg = (
-                "columnar batch feeding an accelerated stateful_map "
-                "needs a 'key' or dictionary-encoded 'key_id' column"
-            )
-            raise TypeError(msg)
-        return self.update(keys, batch._scaled_values())
+    _dispatch = scan_rows
 
     # -- recovery ----------------------------------------------------------
 

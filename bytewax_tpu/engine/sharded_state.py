@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from bytewax_tpu.engine.arrays import ArrayBatch, KeyEncoder, VocabMap
+from bytewax_tpu.engine.scan_accel import ScanUpdates
 from bytewax_tpu.engine.xla import (
     DeviceAggState,
     NonNumericValues,
@@ -39,23 +40,27 @@ from bytewax_tpu.engine.xla import (
 )
 from bytewax_tpu.ops.segment import AGG_KINDS
 
-__all__ = ["ShardedAggState", "make_agg_state"]
+__all__ = [
+    "ShardedAggState",
+    "ShardedScanState",
+    "make_agg_state",
+    "make_scan_state",
+]
 
 _MIN_CAP_PER_SHARD = 128
 _MIN_ROWS_PER_SHARD = 64
 
 
-def make_agg_state(kind: str):
-    """Build aggregation state for one stateful step: mesh-sharded
-    when more than one local device is available (the pod is the
-    cluster), single-device otherwise.
+def _shard_devices() -> Optional[list]:
+    """The local devices to shard one step's state over, or None for
+    single-device execution.
 
     ``BYTEWAX_TPU_SHARD`` overrides: ``0`` forces single-device,
     ``auto``/unset uses all local devices, an integer uses that many.
     """
     want = os.environ.get("BYTEWAX_TPU_SHARD", "auto")
     if want == "0":
-        return DeviceAggState(kind)
+        return None
     if want not in ("auto", ""):
         try:
             limit = int(want)
@@ -77,21 +82,170 @@ def make_agg_state(kind: str):
         # builds its own mesh; cross-process routing stays host-tier).
         devices = jax.local_devices()
     except Exception:  # noqa: BLE001 — no reachable backend
-        return DeviceAggState(kind)
+        return None
     if limit is not None:
         devices = devices[:limit]
-    if len(devices) <= 1:
+    return devices if len(devices) > 1 else None
+
+
+def make_agg_state(kind: str):
+    """Build aggregation state for one stateful step: mesh-sharded
+    when more than one local device is available (the pod is the
+    cluster), single-device otherwise."""
+    devices = _shard_devices()
+    if devices is None:
         return DeviceAggState(kind)
     from bytewax_tpu.parallel.mesh import make_mesh
 
     return ShardedAggState(kind, make_mesh(devices=devices))
 
 
+def make_scan_state(scan_kind):
+    """Build ``stateful_map`` scan state for one step: mesh-sharded
+    (exchange + per-shard segmented scan + outputs home) when more
+    than one local device is available, single-device otherwise."""
+    from bytewax_tpu.engine.scan_accel import DeviceScanState
+
+    devices = _shard_devices()
+    if devices is None:
+        return DeviceScanState(scan_kind)
+    from bytewax_tpu.parallel.mesh import make_mesh
+
+    return ShardedScanState(scan_kind, make_mesh(devices=devices))
+
+
 def _pow2(n: int, floor: int) -> int:
     return 1 << max(floor, math.ceil(math.log2(max(n, 1))))
 
 
-class ShardedAggState:
+class _ShardedSlots:
+    """Key placement shared by the sharded state tiers.
+
+    A key's owner shard is ``adler32(key) % n_shards`` (the same
+    family of stable hash the host tier routes with); its slot within
+    the owner is assigned densely per shard.  The wire id is
+    ``kid = slot * n_shards + shard`` so a compiled step recovers
+    both with one mod/div.  Each shard's last slot is scratch for
+    padding rows; blocks double on demand (key ids stay stable — only
+    the scratch index moves, and the old scratch is reset to each
+    field's identity), and freed slots reset lazily via the
+    pending-reset list.
+
+    Hosts set ``n_shards`` / ``cap_per_shard`` / ``_sharding``, call
+    :meth:`_init_slots`, and implement :meth:`_iter_fields` yielding
+    ``(name, identity, dtype)`` per state column.
+    """
+
+    def _init_slots(self) -> None:
+        self.key_to_kid: Dict[str, int] = {}
+        #: per-shard count of assigned slots
+        self._shard_fill = [0] * self.n_shards
+        #: per-shard free (discarded) slot lists
+        self._free: List[List[int]] = [[] for _ in range(self.n_shards)]
+        self._pending_reset: List[int] = []
+        self._fields = None  # lazy until first update/load
+
+    def _iter_fields(self):
+        """``(name, identity, dtype)`` per state column."""
+        raise NotImplementedError
+
+    def _owner(self, key: str) -> int:
+        return zlib.adler32(key.encode()) % self.n_shards
+
+    def alloc(self, key: str) -> int:
+        """Assign (or return) the wire key id for a key."""
+        kid = self.key_to_kid.get(key)
+        if kid is not None:
+            return kid
+        shard = self._owner(key)
+        if self._free[shard]:
+            slot = self._free[shard].pop()
+            self._pending_reset.append(shard * self.cap_per_shard + slot)
+        else:
+            slot = self._shard_fill[shard]
+            if slot >= self.cap_per_shard - 1:
+                self._grow()
+            self._shard_fill[shard] += 1
+        kid = slot * self.n_shards + shard
+        self.key_to_kid[key] = kid
+        self._on_alloc(key, kid)
+        return kid
+
+    def _on_alloc(self, key: str, kid: int) -> None:
+        """Hook: bookkeeping for a newly-assigned key."""
+
+    def discard(self, key: str) -> None:
+        kid = self.key_to_kid.pop(key, None)
+        if kid is not None:
+            shard, slot = kid % self.n_shards, kid // self.n_shards
+            self._free[shard].append(slot)
+            self._on_discard(key, kid)
+
+    def _on_discard(self, key: str, kid: int) -> None:
+        """Hook: bookkeeping for a released key."""
+
+    def _global_idx(self, kid: int) -> int:
+        shard, slot = kid % self.n_shards, kid // self.n_shards
+        return shard * self.cap_per_shard + slot
+
+    def _grow(self) -> None:
+        """Double every shard's block.  Key ids are unchanged; only
+        the per-shard scratch slot (the block's last) moves, and the
+        old scratch becomes a real slot (reset to identity)."""
+        import jax
+        import jax.numpy as jnp
+
+        old_cap = self.cap_per_shard
+        new_cap = old_cap * 2
+        if self._fields is not None:
+            grown = {}
+            for name, ident, dtype in self._iter_fields():
+                blocks = self._fields[name].reshape(self.n_shards, old_cap)
+                blocks = blocks.at[:, old_cap - 1].set(ident)
+                pad = jnp.full(
+                    (self.n_shards, new_cap - old_cap), ident, dtype=dtype
+                )
+                arr = jnp.concatenate([blocks, pad], axis=1).reshape(-1)
+                grown[name] = jax.device_put(arr, self._sharding)
+            self._fields = grown
+        # Remap pending resets (stored as global idx of the OLD
+        # layout; the shard/slot split survives via the old capacity).
+        self._pending_reset = [
+            (idx // old_cap) * new_cap + (idx % old_cap)
+            for idx in self._pending_reset
+        ]
+        self.cap_per_shard = new_cap
+
+    def _ensure_fields(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if self._fields is None:
+            self._fields = {
+                name: jax.device_put(
+                    jnp.full(
+                        (self.n_shards * self.cap_per_shard,),
+                        ident,
+                        dtype=dtype,
+                    ),
+                    self._sharding,
+                )
+                for name, ident, dtype in self._iter_fields()
+            }
+            self._pending_reset.clear()
+        elif self._pending_reset:
+            idxs = jnp.asarray(
+                np.asarray(self._pending_reset, dtype=np.int32)
+            )
+            for name, ident, _dtype in self._iter_fields():
+                self._fields[name] = self._fields[name].at[idxs].set(ident)
+            self._pending_reset.clear()
+
+    def keys(self) -> List[str]:
+        return list(self.key_to_kid)
+
+
+class ShardedAggState(_ShardedSlots):
     """Slot-table aggregation state sharded over a device mesh.
 
     Duck-types the ``DeviceAggState`` surface the engine driver uses
@@ -120,13 +274,7 @@ class ShardedAggState:
         self.dtype = jnp.float32
         # Rows and state blocks use the same leading-axis split.
         self._sharding = key_sharding(mesh)
-        self.key_to_kid: Dict[str, int] = {}
-        #: per-shard count of assigned slots
-        self._shard_fill = [0] * self.n_shards
-        #: per-shard free (discarded) slot lists
-        self._free: List[List[int]] = [[] for _ in range(self.n_shards)]
-        self._pending_reset: List[int] = []
-        self._fields = None  # lazy until first update/load
+        self._init_slots()
         self._steps: Dict[Tuple[int, int, int, Any], Any] = {}
         # Dictionary-encoded fast path: external id -> wire key id.
         self._vocab = VocabMap(dtype=np.int32)
@@ -140,102 +288,29 @@ class ShardedAggState:
         self._id_keys: List[str] = []
         self._id_to_kid = np.empty(0, dtype=np.int32)
 
-    # -- key placement -----------------------------------------------------
+    # -- key placement hooks (_ShardedSlots) --------------------------------
 
-    def _owner(self, key: str) -> int:
-        return zlib.adler32(key.encode()) % self.n_shards
-
-    def alloc(self, key: str) -> int:
-        """Assign (or return) the wire key id for a key."""
-        kid = self.key_to_kid.get(key)
-        if kid is not None:
-            return kid
-        shard = self._owner(key)
-        if self._free[shard]:
-            slot = self._free[shard].pop()
-            self._pending_reset.append(shard * self.cap_per_shard + slot)
-        else:
-            slot = self._shard_fill[shard]
-            if slot >= self.cap_per_shard - 1:
-                self._grow()
-            self._shard_fill[shard] += 1
-        kid = slot * self.n_shards + shard
-        self.key_to_kid[key] = kid
-        self._kid_key[kid] = key
-        return kid
-
-    def discard(self, key: str) -> None:
-        kid = self.key_to_kid.pop(key, None)
-        if kid is not None:
-            shard, slot = kid % self.n_shards, kid // self.n_shards
-            self._free[shard].append(slot)
-            self._kid_key.pop(kid, None)
-            self._enc.drop(key)
-            if self._iddict:
-                # Dense ids must stay collision-free (kv_encode
-                # assigns len(dict)): a discard resets the itemized
-                # cache (see DeviceAggState.discard).
-                self._iddict = {}
-                self._id_keys = []
-                self._id_to_kid = np.empty(0, dtype=np.int32)
-
-    def _global_idx(self, kid: int) -> int:
-        shard, slot = kid % self.n_shards, kid // self.n_shards
-        return shard * self.cap_per_shard + slot
-
-    def _grow(self) -> None:
-        """Double every shard's block.  Key ids are unchanged; only
-        the per-shard scratch slot (the block's last) moves, and the
-        old scratch becomes a real slot (cleared)."""
-        import jax
-        import jax.numpy as jnp
-
+    def _iter_fields(self):
         from bytewax_tpu.ops.segment import identity_for
 
-        old_cap = self.cap_per_shard
-        new_cap = old_cap * 2
-        if self._fields is not None:
-            grown = {}
-            for name, (init, _op) in self.kind.fields.items():
-                ident = identity_for(init, self.dtype)
-                blocks = self._fields[name].reshape(self.n_shards, old_cap)
-                blocks = blocks.at[:, old_cap - 1].set(ident)
-                pad = jnp.full(
-                    (self.n_shards, new_cap - old_cap), ident, self.dtype
-                )
-                arr = jnp.concatenate([blocks, pad], axis=1).reshape(-1)
-                grown[name] = jax.device_put(arr, self._sharding)
-            self._fields = grown
-        # Remap pending resets (their shard/slot split is cap-free
-        # only via kid; stored as global idx of the OLD layout).
-        self._pending_reset = [
-            (idx // old_cap) * new_cap + (idx % old_cap)
-            for idx in self._pending_reset
+        return [
+            (name, identity_for(init, self.dtype), self.dtype)
+            for name, (init, _op) in self.kind.fields.items()
         ]
-        self.cap_per_shard = new_cap
 
-    # -- state materialization ---------------------------------------------
+    def _on_alloc(self, key: str, kid: int) -> None:
+        self._kid_key[kid] = key
 
-    def _ensure_fields(self) -> None:
-        from bytewax_tpu.ops.sharded import init_sharded_fields
-
-        if self._fields is None:
-            self._fields = init_sharded_fields(
-                self.kind, self.mesh, self.cap_per_shard, self.dtype
-            )
-            self._pending_reset.clear()
-        elif self._pending_reset:
-            import jax.numpy as jnp
-
-            from bytewax_tpu.ops.segment import identity_for
-
-            idxs = jnp.asarray(
-                np.asarray(self._pending_reset, dtype=np.int32)
-            )
-            for name, (init, _op) in self.kind.fields.items():
-                ident = identity_for(init, self.dtype)
-                self._fields[name] = self._fields[name].at[idxs].set(ident)
-            self._pending_reset.clear()
+    def _on_discard(self, key: str, kid: int) -> None:
+        self._kid_key.pop(kid, None)
+        self._enc.drop(key)
+        if self._iddict:
+            # Dense ids must stay collision-free (kv_encode assigns
+            # len(dict)): a discard resets the itemized cache (see
+            # DeviceAggState.discard).
+            self._iddict = {}
+            self._id_keys = []
+            self._id_to_kid = np.empty(0, dtype=np.int32)
 
     def _step_for(self, total_rows: int, capacity: int):
         from bytewax_tpu.ops.sharded import make_sharded_step
@@ -572,5 +647,155 @@ class ShardedAggState:
         self._id_to_kid = np.empty(0, dtype=np.int32)
         return out
 
-    def keys(self) -> List[str]:
-        return list(self.key_to_kid)
+
+class ShardedScanState(_ShardedSlots, ScanUpdates):
+    """Mesh-sharded per-key scan state (``stateful_map`` lowering).
+
+    The multi-chip sibling of
+    :class:`bytewax_tpu.engine.scan_accel.DeviceScanState`: per-key
+    state columns (one per :class:`~bytewax_tpu.ops.scan.ScanKind`
+    field) live sharded over the mesh, and each micro-batch runs ONE
+    compiled program that exchanges rows to their owner shard, runs
+    the kind's segmented scan against the local block, and ships each
+    row's output back to its source position
+    (:func:`bytewax_tpu.ops.sharded.make_sharded_scan_step`).
+
+    Key placement and wire ids follow :class:`ShardedAggState`
+    (``kid = slot * n_shards + shard``, per-shard scratch at the
+    block's last slot); snapshots stay in the host tier's field-order
+    tuple format, so recovery interchanges between the host tier, the
+    single-device tier, and any mesh size.
+    """
+
+    def __init__(self, scan_kind, mesh, cap_per_shard: int = _MIN_CAP_PER_SHARD):
+        from bytewax_tpu.parallel.mesh import SHARD_AXIS, key_sharding
+
+        self.kind = scan_kind
+        self.mesh = mesh
+        self.n_shards = mesh.shape[SHARD_AXIS]
+        self.cap_per_shard = cap_per_shard
+        self._sharding = key_sharding(mesh)
+        self._init_slots()
+        self._steps: Dict[Tuple[int, int, int], Any] = {}
+
+    def _iter_fields(self):
+        return [
+            (name, init, dtype)
+            for name, (init, dtype) in self.kind.fields.items()
+        ]
+
+    # -- updates -------------------------------------------------------------
+
+    def _step_for(self, total_rows: int, capacity: int):
+        from bytewax_tpu.ops.sharded import make_sharded_scan_step
+
+        key = (self.cap_per_shard, capacity, total_rows)
+        step = self._steps.get(key)
+        if step is None:
+            step = make_sharded_scan_step(
+                self.mesh, self.kind, self.cap_per_shard, capacity
+            )
+            self._steps[key] = step
+        return step
+
+    def _dispatch(
+        self, kids: np.ndarray, values: np.ndarray
+    ) -> Tuple[np.ndarray, ...]:
+        """One compiled exchange + scan + return trip; outputs are
+        aligned with the input rows (finished by ``kind.post``)."""
+        import jax
+
+        n = len(kids)
+        if n == 0:
+            return tuple()
+        self._ensure_fields()
+        rows_per_shard = _pow2(
+            -(-n // self.n_shards), int(math.log2(_MIN_ROWS_PER_SHARD))
+        )
+        total = rows_per_shard * self.n_shards
+
+        kids_p = np.zeros(total, dtype=np.int32)
+        kids_p[:n] = kids
+        vals_p = np.zeros(total, dtype=np.float32)
+        vals_p[:n] = values
+        valid_p = np.zeros(total, dtype=bool)
+        valid_p[:n] = True
+
+        dest = kids % self.n_shards
+        block_of = np.arange(n) // rows_per_shard
+        pair_counts = np.bincount(
+            block_of * self.n_shards + dest,
+            minlength=self.n_shards * self.n_shards,
+        )
+        capacity = _pow2(int(pair_counts.max()), 4)
+
+        step = self._step_for(total, capacity)
+        outs, self._fields = step(
+            self._fields,
+            jax.device_put(kids_p, self._sharding),
+            jax.device_put(vals_p, self._sharding),
+            jax.device_put(valid_p, self._sharding),
+        )
+        return self.kind.post(tuple(np.asarray(o)[:n] for o in outs))
+
+    # update_grouped / update / update_batch come from ScanUpdates;
+    # _dispatch is its hook (the compiled round trip returns outputs
+    # in row order, which for pre-grouped rows IS the grouped
+    # emission order).
+
+    # -- recovery ------------------------------------------------------------
+
+    def load(self, key: str, state: Any) -> None:
+        self.load_many([(key, state)])
+
+    def load_many(self, items: List[Tuple[str, Any]]) -> None:
+        """Batched resume from host-format field-order tuples: one
+        scatter per field per page (wire ids resolved after every
+        alloc so capacity growth mid-page can't skew indices)."""
+        import jax
+
+        if not items:
+            return
+        field_items = list(self.kind.fields.items())
+        cols = [
+            np.empty(len(items), dtype=np.dtype(dtype))
+            for _name, (_init, dtype) in field_items
+        ]
+        kids = []
+        for i, (key, state) in enumerate(items):
+            kids.append(self.alloc(key))
+            for j, part in enumerate(state):
+                cols[j][i] = part
+        self._ensure_fields()
+        idxs = np.fromiter(
+            (self._global_idx(k) for k in kids),
+            dtype=np.int64,
+            count=len(kids),
+        )
+        for (name, _spec), col in zip(field_items, cols):
+            self._fields[name] = (
+                self._fields[name].at[idxs].set(jax.device_put(col))
+            )
+
+    def snapshots_for(self, keys: List[str]) -> List[Tuple[str, Any]]:
+        if self._fields is None or not keys:
+            return [(k, None) for k in keys]
+        names = tuple(self.kind.fields)
+        host = {name: np.asarray(self._fields[name]) for name in names}
+        out = []
+        for key in keys:
+            kid = self.key_to_kid.get(key)
+            if kid is None:
+                out.append((key, None))
+            else:
+                idx = self._global_idx(kid)
+                out.append(
+                    (
+                        key,
+                        self.kind.snapshot_of(
+                            tuple(host[nm][idx] for nm in names)
+                        ),
+                    )
+                )
+        return out
+

@@ -33,9 +33,11 @@ __all__ = [
     "WelfordZScore",
     "Ema",
     "RunningExtrema",
+    "generic_scan_body",
     "generic_scan_kernel",
     "welford_merge",
     "zscore_scan",
+    "zscore_scan_body",
     "WELFORD_FIELDS",
 ]
 
@@ -91,18 +93,34 @@ class ScanKind:
         default)."""
         return outs
 
+    def raw_run(
+        self,
+        fields: Dict[str, jax.Array],
+        slots: jax.Array,
+        values: jax.Array,
+    ) -> Tuple[Tuple[jax.Array, ...], Dict[str, jax.Array]]:
+        """The kernel body, uncompiled — callable inside an enclosing
+        jit/shard_map (the sharded tier inlines it per shard).
+        Override to supply a specialized kernel."""
+        body = self.__dict__.get("_raw_body")
+        if body is None:
+            body = generic_scan_body(self)
+            self.__dict__["_raw_body"] = body
+        return body(fields, slots, values)
+
     def run(
         self,
         fields: Dict[str, jax.Array],
         slots: jax.Array,
         values: jax.Array,
     ) -> Tuple[Tuple[jax.Array, ...], Dict[str, jax.Array]]:
-        """Execute one micro-batch; override to supply a specialized
-        kernel.  The default compiles (once per kind instance) the
-        generic segmented-scan program."""
+        """Execute one micro-batch; compiled (once per kind instance)
+        with the state donated in place."""
         kernel = self.__dict__.get("_kernel")
         if kernel is None:
-            kernel = generic_scan_kernel(self)
+            kernel = functools.partial(jax.jit, donate_argnums=(0,))(
+                self.raw_run
+            )
             self.__dict__["_kernel"] = kernel
         return kernel(fields, slots, values)
 
@@ -120,21 +138,21 @@ class ScanKind:
         return f"ScanKind({self.name!r})"
 
 
-def generic_scan_kernel(kind: ScanKind) -> Callable:
+def generic_scan_body(kind: ScanKind) -> Callable:
     """Build the one generic device program for a kind: a flagged
     segmented ``associative_scan`` over the kind's state monoid.
 
     ``slots`` must be grouped (all rows of a key contiguous); padding
     rows carry the scratch slot ``capacity - 1`` and must form the
     trailing segment.  Returns the kind's per-row outputs and the
-    updated slot tables (donated in place in HBM); segment tails write
-    ``table carry ⊕ inclusive in-batch state`` back, every other row
-    is redirected to the scratch slot.
+    updated slot tables; segment tails write ``table carry ⊕
+    inclusive in-batch state`` back, every other row is redirected to
+    the scratch slot.  Uncompiled — wrap in jit (``ScanKind.run``) or
+    inline per shard (``ops/sharded.py``).
     """
     names = tuple(kind.fields)
     inits = tuple(init for init, _ in kind.fields.values())
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
     def run(fields, slots, values):
         capacity = fields[names[0]].shape[0]
         seg_start = jnp.concatenate(
@@ -178,6 +196,13 @@ def generic_scan_kernel(kind: ScanKind) -> Callable:
     return run
 
 
+def generic_scan_kernel(kind: ScanKind) -> Callable:
+    """Compiled form of :func:`generic_scan_body` (state donated)."""
+    return functools.partial(jax.jit, donate_argnums=(0,))(
+        generic_scan_body(kind)
+    )
+
+
 def welford_merge(a, b):
     """Chan's parallel Welford merge: combine two ``(count, mean, m2)``
     summaries of disjoint samples.  Associative, identity (0, 0, 0)."""
@@ -195,14 +220,14 @@ def welford_merge(a, b):
     return n, mean, m2
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def zscore_scan(
+def zscore_scan_body(
     state: Dict[str, jax.Array],
     slots: jax.Array,
     values: jax.Array,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One micro-batch of the per-key rolling z-score (the
-    :class:`WelfordZScore` kind's specialized kernel).
+    :class:`WelfordZScore` kind's specialized kernel; uncompiled —
+    see :data:`zscore_scan`).
 
     ``slots`` must be grouped (all rows of a key contiguous); padding
     rows carry the scratch slot ``capacity - 1`` and must form the
@@ -295,6 +320,12 @@ def zscore_scan(
     return (z,), new_state
 
 
+#: Compiled z-score kernel (state donated), shared across states.
+zscore_scan = functools.partial(jax.jit, donate_argnums=(0,))(
+    zscore_scan_body
+)
+
+
 class WelfordZScore(ScanKind):
     """Per-key rolling z-score over Welford ``(count, mean, m2)``
     state; emits ``(value, z, abs(z) > threshold)`` per row, z scored
@@ -325,6 +356,9 @@ class WelfordZScore(ScanKind):
         denom = jnp.sqrt(p_m2 / jnp.maximum(p_n.astype(f) - 1, 1.0))
         z = jnp.where(have_var, (values - p_mean) / denom, 0.0)
         return (z,)
+
+    def raw_run(self, fields, slots, values):
+        return zscore_scan_body(fields, slots, values)
 
     def run(self, fields, slots, values):
         return zscore_scan(fields, slots, values)

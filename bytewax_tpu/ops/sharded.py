@@ -22,7 +22,12 @@ from bytewax_tpu.ops.segment import AGG_KINDS, AggKind, identity_for
 from bytewax_tpu.parallel.exchange import bucket_by_shard
 from bytewax_tpu.parallel.mesh import SHARD_AXIS
 
-__all__ = ["init_sharded_fields", "make_sharded_step"]
+__all__ = [
+    "init_sharded_fields",
+    "init_sharded_scan_fields",
+    "make_sharded_scan_step",
+    "make_sharded_step",
+]
 
 
 def init_sharded_fields(
@@ -138,5 +143,141 @@ def make_sharded_step(
         mesh=mesh,
         in_specs=(field_specs, P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=field_specs,
+    )
+    return jax.jit(shard_fn, donate_argnums=(0,))
+
+
+def init_sharded_scan_fields(scan_kind, mesh: Mesh, cap_per_shard: int):
+    """Scan-state table sharded over the mesh, one column per
+    :class:`~bytewax_tpu.ops.scan.ScanKind` field (each with its own
+    dtype and identity): ``n_shards * cap_per_shard`` slots, block
+    ``d`` on device ``d``."""
+    n_shards = mesh.shape[SHARD_AXIS]
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    return {
+        name: jax.device_put(
+            jnp.full((n_shards * cap_per_shard,), init, dtype=dtype),
+            sharding,
+        )
+        for name, (init, dtype) in scan_kind.fields.items()
+    }
+
+
+def _lane_encode(col: jax.Array) -> jax.Array:
+    """Encode an output column as an int32 wire lane (floats bitcast
+    so the exchange can't round them; bools/ints widen/narrow)."""
+    if col.dtype == jnp.bool_ or jnp.issubdtype(col.dtype, jnp.integer):
+        return col.astype(jnp.int32)
+    return jax.lax.bitcast_convert_type(col.astype(jnp.float32), jnp.int32)
+
+
+def _lane_decode(lane: jax.Array, like: jax.Array) -> jax.Array:
+    if like.dtype == jnp.bool_ or jnp.issubdtype(like.dtype, jnp.integer):
+        return lane.astype(like.dtype)
+    return jax.lax.bitcast_convert_type(lane, jnp.float32).astype(like.dtype)
+
+
+def make_sharded_scan_step(
+    mesh: Mesh,
+    scan_kind,
+    cap_per_shard: int,
+    exchange_capacity: int,
+):
+    """Build the jitted sharded *scan* step: keyed exchange +
+    segmented per-key scan + per-row outputs exchanged back.
+
+    Where :func:`make_sharded_step` folds rows into state and returns
+    only the state, a scan also emits one output tuple per ROW
+    (``stateful_map`` semantics), so the program makes a round trip:
+    rows ship to their owner shard (``key_id % n_shards``) carrying
+    their source position, each shard sorts its received rows by slot
+    (a stable sort, so a key's rows keep arrival order across source
+    blocks) and runs the kind's segmented-scan body over its local
+    state block, and the per-row outputs ride a second ``all_to_all``
+    back to their source positions.
+
+    Returned ``step(fields, key_ids, values, valid) -> (outs, fields)``
+    with every array sharded on the leading axis; ``outs`` columns are
+    aligned with the input rows.  ``exchange_capacity`` must be sized
+    to the batch's true per-(source, destination) maximum (see
+    ``engine/sharded_state.py``).  Output columns travel as 32-bit
+    lanes: float64 outputs narrow to float32 and integers to int32 on
+    the return trip.
+    """
+    n_shards = mesh.shape[SHARD_AXIS]
+    cap = exchange_capacity
+
+    def body(fields, key_ids, values, valid):
+        rows = key_ids.shape[0]
+        shard_ids = (key_ids % n_shards).astype(jnp.int32)
+        vbits = jax.lax.bitcast_convert_type(
+            values.astype(jnp.float32), jnp.int32
+        )
+        pos = jnp.arange(rows, dtype=jnp.int32)
+        payload = jnp.stack(
+            [key_ids.astype(jnp.int32), vbits, pos], axis=1
+        )
+        buckets, counts, _dropped = bucket_by_shard(
+            shard_ids, payload, valid, n_shards, cap
+        )
+        got = jax.lax.all_to_all(
+            buckets, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )
+        got_counts = jax.lax.all_to_all(
+            counts, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )
+        mask = (
+            jnp.arange(cap)[None, :] < got_counts[:, None]
+        ).reshape(-1)
+        recv = got.reshape(-1, 3)
+        recv_ids = recv[:, 0]
+        recv_vals = jax.lax.bitcast_convert_type(recv[:, 1], jnp.float32)
+        recv_pos = recv[:, 2]
+
+        # Group by slot with ONE stable sort: received buckets are
+        # ordered by source block and source order within each block,
+        # so the stable sort preserves each key's global arrival
+        # order.  Padding rows target the scratch slot (the block's
+        # last), which sorts to the tail — the kernel's contract.
+        local_slot = jnp.where(
+            mask, recv_ids // n_shards, cap_per_shard - 1
+        ).astype(jnp.int32)
+        order = jnp.argsort(local_slot, stable=True)
+        outs_s, new_fields = scan_kind.raw_run(
+            fields, local_slot[order], recv_vals[order]
+        )
+        # Un-sort back to received order, then ship outputs home.
+        outs_r = tuple(
+            jnp.zeros_like(o).at[order].set(o) for o in outs_s
+        )
+        ret = jnp.stack(
+            [*(_lane_encode(o) for o in outs_r), recv_pos], axis=1
+        ).reshape(n_shards, cap, -1)
+        back = jax.lax.all_to_all(
+            ret, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True
+        ).reshape(-1, len(outs_r) + 1)
+        # This device's send counts bound each returned bucket's
+        # valid prefix (bucket d of `back` holds shard d's outputs
+        # for the rows we sent it, in the order we sent them).
+        src_mask = (
+            jnp.arange(cap)[None, :] < counts[:, None]
+        ).reshape(-1)
+        back_pos = jnp.where(src_mask, back[:, -1], rows)
+        outs_local = []
+        for j, o in enumerate(outs_r):
+            buf = (
+                jnp.zeros((rows + 1,), dtype=jnp.int32)
+                .at[back_pos]
+                .set(back[:, j])
+            )
+            outs_local.append(_lane_decode(buf[:rows], o))
+        return tuple(outs_local), new_fields
+
+    field_specs = {name: P(SHARD_AXIS) for name in scan_kind.fields}
+    shard_fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(field_specs, P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), field_specs),
     )
     return jax.jit(shard_fn, donate_argnums=(0,))
