@@ -93,6 +93,10 @@ DURATION_HISTOGRAMS: Dict[str, Histogram] = {
     "stateful_batch_notify_at": _duration(
         "stateful_batch_notify_at", "Time running stateful logic notify_at"
     ),
+    "stateful_batch_flush": _duration(
+        "stateful_batch_flush",
+        "Time in the global-mesh exchange flush at epoch close",
+    ),
 }
 
 

@@ -231,6 +231,12 @@ class _OpRt:
 
     # -- epoch snapshot hooks ---------------------------------------------
 
+    def pre_close(self) -> None:
+        """Runs at the start of every epoch close, before snapshots —
+        on every cluster process, in the same global order (the
+        close_epoch broadcast serializes it), so collective device
+        steps (the global-mesh exchange flush) may run here."""
+
     def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
         """Return (state_key, state-or-None) changed this epoch."""
         return []
@@ -499,9 +505,11 @@ class _StatefulBatchRt(_OpRt):
             if isinstance(spec, AccelSpec):
                 from bytewax_tpu.engine.sharded_state import make_agg_state
 
-                # Mesh-sharded (all_to_all over ICI) when >1 local
-                # device; single-device slot table otherwise.
-                self.agg = make_agg_state(spec.kind)
+                # Global-mesh exchange tier (all_to_all spanning every
+                # cluster process) when the distributed runtime is up;
+                # per-process mesh-sharded when >1 local device;
+                # single-device slot table otherwise.
+                self.agg = make_agg_state(spec.kind, driver=driver)
             elif isinstance(spec, WindowAccelSpec):
                 # Sliding/tumbling or session device windower, per
                 # the spec subtype.
@@ -644,6 +652,14 @@ class _StatefulBatchRt(_OpRt):
         item lists bucket in one native pass when available."""
         driver = self.driver
         if driver.comm is None:
+            return entries
+        if self.agg is not None and getattr(
+            self.agg, "global_exchange", False
+        ):
+            # The global-mesh tier routes rows to their owner shard
+            # inside the collective exchange step at epoch close —
+            # keyed rows never ride the host TCP mesh (which keeps
+            # the control plane and non-columnar traffic only).
             return entries
         w_count = driver.worker_count
         local: List[Entry] = []
@@ -842,6 +858,22 @@ class _StatefulBatchRt(_OpRt):
                                 np.asarray(keys), np.asarray(values)
                             )
             except NonNumericValues as ex:
+                if getattr(self.agg, "global_exchange", False):
+                    # The global tier's flush is COLLECTIVE: a local
+                    # fallback would leave the peers blocking in the
+                    # exchange forever.  Fail fast with direction
+                    # (the raising process's abort broadcast unblocks
+                    # any peer already waiting in a sync round).
+                    msg = (
+                        f"{ex} — the cluster-wide device exchange "
+                        "cannot fall back per-process; run this flow "
+                        "with BYTEWAX_TPU_GLOBAL_EXCHANGE=0"
+                    )
+                    _reraise(
+                        self.op.step_id,
+                        "the device aggregation",
+                        NonNumericValues(msg),
+                    )
                 if not self.agg.keys() and not self.logics:
                     # Non-numeric values: permanently fall back to the
                     # host tier before any device state exists.
@@ -970,6 +1002,15 @@ class _StatefulBatchRt(_OpRt):
                 _reraise(self.op.step_id, "`on_notify`", ex)
             self._handle(key, emits, discard, out)
         self._flush(out)
+
+    def pre_close(self) -> None:
+        if self.agg is not None and getattr(
+            self.agg, "global_exchange", False
+        ):
+            # Collective: every cluster process enters the flush for
+            # the same epoch (the close broadcast ordered us here).
+            with self._timer("stateful_batch_flush").time():
+                self.agg.flush()
 
     def on_upstream_eof(self) -> None:
         if self.wagg is not None:
@@ -1236,6 +1277,11 @@ class _Driver:
             self.comm = Comm(addresses, proc_id)
         self.sent = [0] * self.proc_count
         self.rcvd = [0] * self.proc_count
+        #: gsync frames from peers ahead of this process's sync round.
+        self._gsync_stash: Dict[Any, List[Tuple[int, Any]]] = {}
+        #: data/control frames received mid-sync, replayed by _pump.
+        self._pump_stash: List[Tuple[int, Any]] = []
+        self._gsync_seq = 0
         worker_count = self.worker_count
         self.epoch_interval = (
             epoch_interval
@@ -1405,6 +1451,11 @@ class _Driver:
                 self._last_gc = _time.monotonic()
 
     def _close_epoch_inner(self, workers: Optional[range] = None) -> None:
+        # Collective pre-close hooks first: every process reaches this
+        # point exactly once per epoch (close_epoch broadcast), so
+        # global-mesh exchange flushes align across the cluster.
+        for rt in self.rts:
+            rt.pre_close()
         if self.store is not None:
             snaps: List[Tuple[str, str, Optional[bytes]]] = []
             for rt in self.rts:
@@ -1442,32 +1493,106 @@ class _Driver:
 
     def _pump(self, timeout: float = 0.0) -> None:
         """Receive cluster messages: inject shipped data, apply
-        control decisions."""
-        for _src, msg in self.comm.recv_ready(timeout):
-            kind = msg[0]
-            if kind == "deliver":
-                _kind, op_idx, port, entry = msg
-                self.rcvd[_src] += 1
-                self.rts[op_idx].queues[port].append(entry)
-                self._progressed = True
-            elif kind == "route":
-                _kind, stream_id, entry = msg
-                self.rcvd[_src] += 1
-                self.route(stream_id, entry)
-            elif kind == "report_msg":
-                self._reports[_src] = msg[1]
-            elif kind == "hold":
-                self._holding = True
-                self._gen = msg[1]
-            elif kind == "eof_step":
-                self._apply_eof_step(msg[1])
-                self._gen = msg[2]
-            elif kind == "close_epoch":
-                self._pending_close = msg[1:]  # (epoch, final)
-            elif kind == "abort":
-                raise _Abort()
-            else:  # pragma: no cover
-                raise AssertionError(f"unknown ctrl message {msg!r}")
+        control decisions.
+
+        Messages drain through the stash queue one at a time: a
+        handler may BLOCK inside a collective sync (the EOF ladder's
+        global-exchange finalize), during which a peer's gsync frame
+        may already sit behind it in this very batch — the sync's own
+        receive loop pulls from the stash, so queued frames stay
+        reachable mid-handler."""
+        self._pump_stash.extend(self.comm.recv_ready(timeout))
+        while self._pump_stash:
+            _src, msg = self._pump_stash.pop(0)
+            self._handle_ctrl(_src, msg)
+
+    def _handle_ctrl(self, _src: int, msg: tuple) -> None:
+        kind = msg[0]
+        if kind == "deliver":
+            _kind, op_idx, port, entry = msg
+            self.rcvd[_src] += 1
+            self.rts[op_idx].queues[port].append(entry)
+            self._progressed = True
+        elif kind == "route":
+            _kind, stream_id, entry = msg
+            self.rcvd[_src] += 1
+            self.route(stream_id, entry)
+        elif kind == "report_msg":
+            self._reports[_src] = msg[1]
+        elif kind == "hold":
+            self._holding = True
+            self._gen = msg[1]
+        elif kind == "eof_step":
+            self._apply_eof_step(msg[1])
+            self._gen = msg[2]
+        elif kind == "close_epoch":
+            self._pending_close = msg[1:]  # (epoch, final)
+        elif kind == "gsync":
+            # A peer already inside a global-exchange sync round; park
+            # its payload for this process's matching global_sync call
+            # (rounds are globally ordered, so it can only be for a
+            # round this process has not entered yet).
+            _kind, tag, pid, payload = msg
+            self._gsync_stash.setdefault(tag, []).append((pid, payload))
+        elif kind == "abort":
+            raise _Abort()
+        else:  # pragma: no cover
+            raise AssertionError(f"unknown ctrl message {msg!r}")
+
+    def next_gsync_tag(self) -> int:
+        """Monotone sync-round id.  Sync rounds run only at
+        globally-ordered points, so every process draws the same
+        sequence — the id names the round identically cluster-wide."""
+        self._gsync_seq += 1
+        return self._gsync_seq
+
+    def global_sync(self, tag: Any, payload: Any) -> Dict[int, Any]:
+        """Exchange one (small, control-plane) payload per process —
+        the metadata round preceding a global-mesh collective step
+        (new keys, row counts, dtype votes).  Blocking: returns
+        ``{proc_id: payload}`` for every process.
+
+        May only be called at globally-ordered points (epoch close /
+        the EOF ladder), where every process performs the same
+        sequence of sync rounds; ``tag`` identifies the round so
+        frames from a peer that is already one skipped-collective
+        round ahead park in the stash instead of corrupting this one.
+        Data-plane frames arriving mid-sync are stashed for the next
+        ``_pump`` — counting (sent/rcvd) is untouched, so the epoch
+        barrier's in-flight accounting stays exact.
+        """
+        self.comm.broadcast(("gsync", tag, self.proc_id, payload))
+        got = {self.proc_id: payload}
+        for pid, pl in self._gsync_stash.pop(tag, []):
+            got[pid] = pl
+
+        def absorb(msg: tuple) -> bool:
+            if msg[0] != "gsync":
+                return False
+            if msg[1] == tag:
+                got[msg[2]] = msg[3]
+            else:
+                self._gsync_stash.setdefault(msg[1], []).append(
+                    (msg[2], msg[3])
+                )
+            return True
+
+        # Frames that were queued behind the handler we're blocking
+        # inside of (this sync may run mid-_pump).
+        remaining = [
+            (src, msg)
+            for src, msg in self._pump_stash
+            if not absorb(msg)
+        ]
+        self._pump_stash[:] = remaining
+        while len(got) < self.proc_count:
+            for _src, msg in self.comm.recv_ready(0.01):
+                if absorb(msg):
+                    continue
+                if msg[0] == "abort":
+                    raise _Abort()
+                self._pump_stash.append((_src, msg))
+        return got
 
     def _apply_eof_step(self, k: int) -> None:
         rt = self.rts[k]

@@ -802,3 +802,109 @@ def test_comm_heartbeat_no_false_positive_on_partial_traffic(monkeypatch):
     for t in threads:
         t.join(timeout=40)
     assert not errors, errors
+
+
+def test_cluster_global_mesh_exchange(tmp_path):
+    """BYTEWAX_TPU_DISTRIBUTED=1 + accel, no recovery store: keyed
+    aggregation rows ride ONE collective all_to_all over the global
+    device mesh at epoch close (GlobalAggState) — the host TCP mesh
+    carries only control-plane metadata.  Both workers produce rows
+    for every key, so a correct answer REQUIRES the cross-process
+    exchange; the debug marker proves the collective ran on both
+    processes, and the output must match the same flow over the
+    pickled-TCP tier."""
+    flow_py = tmp_path / "gx_flow.py"
+    out_path = str(tmp_path / "out.txt")
+    flow_py.write_text(
+        f'''
+import bytewax_tpu.operators as op
+from bytewax_tpu import xla
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+
+
+class _Part(StatelessSourcePartition):
+    def __init__(self, worker_index):
+        base = worker_index * 1000
+        self._batches = [
+            [(f"k{{i % 7}}", float(base + i)) for i in range(200)],
+            [(f"k{{i % 7}}", float(base + 200 + i)) for i in range(200)],
+        ]
+
+    def next_batch(self):
+        if not self._batches:
+            raise StopIteration()
+        return self._batches.pop(0)
+
+
+class Src(DynamicSource):
+    def build(self, step_id, worker_index, worker_count):
+        return _Part(worker_index)
+
+
+flow = Dataflow("gx_df")
+s = op.input("inp", flow, Src())
+st = xla.stats_final("stats", s)
+fmt = op.map(
+    "fmt",
+    st,
+    lambda kv: (
+        kv[0],
+        f"{{kv[0]}};{{kv[1][0]}};{{kv[1][1]:.6f}};{{kv[1][2]}};{{kv[1][3]}}",
+    ),
+)
+vals = op.map_value("val", fmt, lambda v: v)
+op.output("out", vals, FileSink({out_path!r}))
+'''
+    )
+
+    def run(global_exchange):
+        env = _env()
+        env["BYTEWAX_TPU_ACCEL"] = "1"
+        env["BYTEWAX_TPU_DISTRIBUTED"] = "1"
+        env["BYTEWAX_TPU_GLOBAL_EXCHANGE"] = (
+            "1" if global_exchange else "0"
+        )
+        env["BYTEWAX_TPU_GLOBAL_EXCHANGE_DEBUG"] = "1"
+        res = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "bytewax_tpu.testing",
+                f"{flow_py}:flow",
+                "-p",
+                "2",
+            ],
+            env=env,
+            cwd=tmp_path,
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        assert res.returncode == 0, res.stderr[-3000:]
+        lines = sorted(Path(out_path).read_text().split())
+        Path(out_path).unlink()
+        return lines, res.stderr
+
+    got, stderr = run(global_exchange=True)
+    # Both processes entered the collective flush.
+    assert stderr.count("global-exchange: proc 0 flushed") >= 1, stderr[-2000:]
+    assert stderr.count("global-exchange: proc 1 flushed") >= 1, stderr[-2000:]
+
+    # Oracle: stats per key over both workers' rows.
+    rows = {}
+    for base in (0, 1000):
+        for i in range(200):
+            rows.setdefault(f"k{i % 7}", []).append(float(base + i))
+            rows.setdefault(f"k{i % 7}", []).append(float(base + 200 + i))
+    want = sorted(
+        f"{k};{min(g)};{sum(g) / len(g):.6f};{max(g)};{len(g)}"
+        for k, g in rows.items()
+    )
+    assert got == want
+
+    # And byte-identical with the TCP keyed-exchange tier.
+    got_tcp, stderr_tcp = run(global_exchange=False)
+    assert "global-exchange" not in stderr_tcp
+    assert got_tcp == got
