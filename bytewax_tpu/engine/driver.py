@@ -1578,12 +1578,16 @@ class _Driver:
             return True
 
         # Frames that were queued behind the handler we're blocking
-        # inside of (this sync may run mid-_pump).
-        remaining = [
-            (src, msg)
-            for src, msg in self._pump_stash
-            if not absorb(msg)
-        ]
+        # inside of (this sync may run mid-_pump) — including a peer's
+        # abort, which must cut the sync short, not wait out the
+        # heartbeat limit.
+        remaining = []
+        for src, msg in self._pump_stash:
+            if absorb(msg):
+                continue
+            if msg[0] == "abort":
+                raise _Abort()
+            remaining.append((src, msg))
         self._pump_stash[:] = remaining
         while len(got) < self.proc_count:
             for _src, msg in self.comm.recv_ready(0.01):

@@ -4,16 +4,31 @@ Surface parity with the reference (``/root/reference/src/tracing/``):
 ``setup_tracing(tracing_config, log_level)`` returns a guard that
 keeps exporters alive.  The default backend logs spans via
 :mod:`logging`; :class:`OtlpTracingConfig` / :class:`JaegerConfig`
-export via the ``opentelemetry`` SDK when it is installed (it is an
-optional dependency — configuring an exporting backend without it
-raises at setup, never at import).
+export spans to a collector.
+
+Export transports, in preference order:
+
+- the ``opentelemetry`` SDK when installed (gRPC OTLP / the Jaeger
+  thrift agent — optional dependencies);
+- a built-in OTLP/HTTP+JSON exporter (pure stdlib) for ``http(s)://``
+  endpoints: real ``ExportTraceServiceRequest`` JSON POSTed to
+  ``/v1/traces``, batched on a background flush with head sampling by
+  ``sampling_ratio`` — any OTLP-ingesting collector (an OpenTelemetry
+  Collector, Jaeger ≥1.35, Tempo, ...) accepts it.  This is what runs
+  in environments without the optional SDK, and what the stub-collector
+  tests pin (``tests/test_tracing_export.py``).
 """
 
 import contextlib
+import contextvars
+import json as _json
 import logging
+import random
+import threading
 import time
+import urllib.request
 from dataclasses import dataclass
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional, Tuple
 
 __all__ = [
     "BytewaxTracer",
@@ -65,14 +80,128 @@ class BytewaxTracer:
     """Guard returned by :func:`setup_tracing`; keeps the exporter
     alive until dropped."""
 
-    def __init__(self, config: Optional[TracingConfig], provider=None):
+    def __init__(
+        self, config: Optional[TracingConfig], provider=None, inline=None
+    ):
         self._config = config
         self._provider = provider
+        self._inline = inline
 
     def shutdown(self) -> None:
         if self._provider is not None:
             self._provider.shutdown()
             self._provider = None
+        if self._inline is not None:
+            self._inline.shutdown()
+            self._inline = None
+
+
+#: (trace_id, span_id, sampled) ancestry of the active inline span.
+_span_stack: contextvars.ContextVar[Tuple] = contextvars.ContextVar(
+    "bytewax_tpu_span_stack", default=()
+)
+
+
+class _InlineOtlpExporter:
+    """Pure-stdlib OTLP/HTTP+JSON span exporter.
+
+    Spans batch in memory and POST as one
+    ``ExportTraceServiceRequest`` JSON document per flush (size- or
+    shutdown-triggered, plus a background timer) to the collector's
+    ``/v1/traces``.  Head sampling: the root span of each trace draws
+    against ``sampling_ratio`` and its descendants inherit the
+    decision, so traces arrive whole or not at all.  Export failures
+    are logged at DEBUG and never disturb the dataflow.
+    """
+
+    BATCH = 64
+    FLUSH_S = 2.0
+
+    def __init__(self, service_name: str, url: str, ratio: float):
+        # Bare collector endpoints (no path, or just "/") get the
+        # standard OTLP traces path appended; explicit paths are kept.
+        rest = url.split("://", 1)[1] if "://" in url else url
+        _host, slash, path = rest.partition("/")
+        if not slash or not path:
+            url = url.rstrip("/") + "/v1/traces"
+        self.url = url
+        self.service_name = service_name
+        self.ratio = float(ratio)
+        self._buf: List[dict] = []
+        self._lock = threading.Lock()
+        self._rng = random.Random()
+        self._closed = False
+        self._timer: Optional[threading.Timer] = None
+        self._arm_timer()
+
+    def _arm_timer(self) -> None:
+        if self._closed:
+            return
+        self._timer = threading.Timer(self.FLUSH_S, self._on_timer)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _on_timer(self) -> None:
+        self.flush()
+        self._arm_timer()
+
+    def sample_root(self) -> bool:
+        return self._rng.random() < self.ratio
+
+    def on_span_end(self, span: dict) -> None:
+        with self._lock:
+            self._buf.append(span)
+            full = len(self._buf) >= self.BATCH
+        if full:
+            self.flush()
+
+    def _payload(self, spans: List[dict]) -> bytes:
+        doc = {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {
+                                "key": "service.name",
+                                "value": {
+                                    "stringValue": self.service_name
+                                },
+                            }
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "bytewax_tpu"},
+                            "spans": spans,
+                        }
+                    ],
+                }
+            ]
+        }
+        return _json.dumps(doc).encode("utf-8")
+
+    def flush(self) -> None:
+        with self._lock:
+            spans, self._buf = self._buf, []
+        if not spans:
+            return
+        req = urllib.request.Request(
+            self.url,
+            data=self._payload(spans),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                resp.read()
+        except Exception as ex:  # noqa: BLE001 — telemetry must not kill flows
+            logger.debug("OTLP export to %s failed: %s", self.url, ex)
+
+    def shutdown(self) -> None:
+        self._closed = True
+        if self._timer is not None:
+            self._timer.cancel()
+        self.flush()
 
 
 _tracer: Optional[BytewaxTracer] = None
@@ -95,40 +224,61 @@ def setup_tracing(
     logger.setLevel(level)
 
     provider = None
+    inline = None
     if isinstance(tracing_config, (OtlpTracingConfig, JaegerConfig)):
-        try:
-            from opentelemetry import trace as ot_trace
-            from opentelemetry.sdk.resources import Resource
-            from opentelemetry.sdk.trace import TracerProvider
-            from opentelemetry.sdk.trace.export import BatchSpanProcessor
-        except ImportError as ex:
-            msg = (
-                "exporting traces requires the `opentelemetry-sdk` "
-                "package; install it or use the default local-logging "
-                "tracing config"
-            )
-            raise ImportError(msg) from ex
-        resource = Resource.create(
-            {"service.name": tracing_config.service_name}
-        )
-        provider = TracerProvider(resource=resource)
         if isinstance(tracing_config, OtlpTracingConfig):
-            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
-                OTLPSpanExporter,
-            )
-
-            exporter = OTLPSpanExporter(endpoint=tracing_config.url)
+            endpoint = tracing_config.url
         else:
-            from opentelemetry.exporter.jaeger.thrift import JaegerExporter
-
-            host, _, port = tracing_config.endpoint.partition(":")
-            exporter = JaegerExporter(
-                agent_host_name=host, agent_port=int(port or 6831)
+            endpoint = tracing_config.endpoint
+        if endpoint.startswith(("http://", "https://")):
+            # Built-in OTLP/HTTP+JSON transport (pure stdlib).  For
+            # Jaeger this targets the collector's native OTLP
+            # ingestion (Jaeger ≥1.35); the classic thrift UDP agent
+            # needs the SDK path below.
+            inline = _InlineOtlpExporter(
+                tracing_config.service_name,
+                endpoint,
+                tracing_config.sampling_ratio,
             )
-        provider.add_span_processor(BatchSpanProcessor(exporter))
-        ot_trace.set_tracer_provider(provider)
+        else:
+            try:
+                from opentelemetry import trace as ot_trace
+                from opentelemetry.sdk.resources import Resource
+                from opentelemetry.sdk.trace import TracerProvider
+                from opentelemetry.sdk.trace.export import (
+                    BatchSpanProcessor,
+                )
+            except ImportError as ex:
+                msg = (
+                    "exporting traces over gRPC/thrift requires the "
+                    "`opentelemetry-sdk` package; install it, or point "
+                    "the config at an http(s):// OTLP endpoint to use "
+                    "the built-in OTLP/HTTP exporter"
+                )
+                raise ImportError(msg) from ex
+            resource = Resource.create(
+                {"service.name": tracing_config.service_name}
+            )
+            provider = TracerProvider(resource=resource)
+            if isinstance(tracing_config, OtlpTracingConfig):
+                from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+                    OTLPSpanExporter,
+                )
 
-    _tracer = BytewaxTracer(tracing_config, provider)
+                exporter = OTLPSpanExporter(endpoint=tracing_config.url)
+            else:
+                from opentelemetry.exporter.jaeger.thrift import (
+                    JaegerExporter,
+                )
+
+                host, _, port = tracing_config.endpoint.partition(":")
+                exporter = JaegerExporter(
+                    agent_host_name=host, agent_port=int(port or 6831)
+                )
+            provider.add_span_processor(BatchSpanProcessor(exporter))
+            ot_trace.set_tracer_provider(provider)
+
+    _tracer = BytewaxTracer(tracing_config, provider, inline)
     return _tracer
 
 
@@ -136,9 +286,46 @@ def spans_active() -> bool:
     """Whether spans currently go anywhere (an exporting backend is
     configured, or local DEBUG logging is on) — callers on hot paths
     check this once instead of paying the span plumbing per call."""
-    if _tracer is not None and _tracer._provider is not None:
+    if _tracer is not None and (
+        _tracer._provider is not None or _tracer._inline is not None
+    ):
         return True
     return logger.isEnabledFor(logging.DEBUG)
+
+
+@contextlib.contextmanager
+def _inline_span(exporter: _InlineOtlpExporter, name: str, attrs) -> Iterator[None]:
+    stack = _span_stack.get()
+    if stack:
+        trace_id, parent_id, sampled = stack[-1]
+    else:
+        trace_id = f"{random.getrandbits(128):032x}"
+        parent_id = None
+        sampled = exporter.sample_root()
+    span_id = f"{random.getrandbits(64):016x}"
+    token = _span_stack.set(stack + ((trace_id, span_id, sampled),))
+    start_ns = time.time_ns()
+    try:
+        yield
+    finally:
+        end_ns = time.time_ns()
+        _span_stack.reset(token)
+        if sampled:
+            rec = {
+                "traceId": trace_id,
+                "spanId": span_id,
+                "name": name,
+                "kind": 1,
+                "startTimeUnixNano": str(start_ns),
+                "endTimeUnixNano": str(end_ns),
+                "attributes": [
+                    {"key": k, "value": {"stringValue": str(v)}}
+                    for k, v in attrs.items()
+                ],
+            }
+            if parent_id is not None:
+                rec["parentSpanId"] = parent_id
+            exporter.on_span_end(rec)
 
 
 @contextlib.contextmanager
@@ -157,6 +344,10 @@ def span(name: str, **attrs) -> Iterator[None]:
 
         tracer = ot_trace.get_tracer("bytewax_tpu")
         with tracer.start_as_current_span(name, attributes=attrs):
+            yield
+        return
+    if _tracer is not None and _tracer._inline is not None:
+        with _inline_span(_tracer._inline, name, attrs):
             yield
         return
     start = time.perf_counter()
