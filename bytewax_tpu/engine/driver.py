@@ -1788,6 +1788,19 @@ class _Driver:
                     # iteration, before any further pump — peers may
                     # already have closed their sockets by then.
 
+                if self._gc_managed and interval_s > 10.0:
+                    # Long/infinite epochs must not defer collection
+                    # to an epoch close that may be minutes away
+                    # (embedding hosts and other threads still make
+                    # cyclic garbage): collect on a flat 10s wall
+                    # clock between closes.
+                    now_m = time.monotonic()
+                    if now_m - self._last_gc >= 10.0:
+                        import gc as _gc
+
+                        _gc.collect()
+                        self._last_gc = time.monotonic()
+
                 if not self._progressed:
                     waits = []
                     for rt in inputs:

@@ -462,15 +462,17 @@ class ShardedAggState(_ShardedSlots):
         n = len(items)
         ids = np.empty(n, dtype=np.int32)
         vals = np.empty(n, dtype=np.float64)
+        ivals = np.empty(n, dtype=np.int64)
         try:
-            res = _kv_encode(items, self._iddict, ids, vals)
+            res = _kv_encode(items, self._iddict, ids, vals, ivals)
         except TypeError as ex:
             raise _NNV(str(ex)) from ex
         if res is None:
             return None
         new_keys, all_int = res
         if all_int:
-            vals = vals.astype(np.int64)
+            # Exact int64 lane from the C pass (no float round-trip).
+            vals = ivals
         try:
             vals = self._pick_dtype(vals)
         except (_NNV, TypeError):

@@ -282,8 +282,9 @@ class DeviceAggState:
         n = len(items)
         ids = np.empty(n, dtype=np.int32)
         vals = np.empty(n, dtype=np.float64)
+        ivals = np.empty(n, dtype=np.int64)
         try:
-            res = _kv_encode(items, self._iddict, ids, vals)
+            res = _kv_encode(items, self._iddict, ids, vals, ivals)
         except TypeError as ex:
             raise NonNumericValues(str(ex)) from ex
         if res is None:
@@ -291,8 +292,10 @@ class DeviceAggState:
         new_keys, all_int = res
         if all_int:
             # Preserve the exact-integer accumulator the per-item
-            # path would have picked.
-            vals = vals.astype(np.int64)
+            # path would have picked: the int64 lane is filled
+            # directly by the C pass (a float64 round-trip would
+            # round integers past 2^53).
+            vals = ivals
         try:
             vals = self._pick_dtype(vals)
         except (NonNumericValues, TypeError):

@@ -40,13 +40,26 @@ def _update(
     return ZScoreState(count, mean, m2), out
 
 
-def anomaly_flow(source, sink: Sink, threshold: float = 3.0) -> Dataflow:
+def anomaly_flow(
+    source,
+    sink: Sink,
+    threshold: float = 3.0,
+    fmt=None,
+) -> Dataflow:
     """Items are ``(key, value)``; emits ``(key, (value, zscore,
-    is_anomaly))`` per item with per-key online mean/variance state."""
+    is_anomaly))`` per item with per-key online mean/variance state.
+
+    ``fmt`` optionally maps each scored item before the sink (the
+    human-facing example uses it for pretty printing) — benches and
+    ``examples/anomaly_detector.py`` both run THIS flow, so the two
+    can't drift.
+    """
     from bytewax_tpu.xla import zscore
 
     flow = Dataflow("anomaly_detector")
     s = op.input("inp", flow, source)
     scored = op.stateful_map("zscore", s, zscore(threshold))
+    if fmt is not None:
+        scored = op.map("fmt", scored, fmt)
     op.output("out", scored, sink)
     return flow

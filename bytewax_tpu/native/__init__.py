@@ -152,16 +152,23 @@ def scan_fill_values(groups, out) -> Any:
     return None if ext is None else ext.scan_fill_values(groups, out)
 
 
-def kv_encode(items, iddict, ids, vals) -> Any:
+def kv_encode(items, iddict, ids, vals, ivals=None) -> Any:
     """One-pass itemized→columnar promotion: dictionary-encode the
     keys of ``(str key, value)`` tuples through ``iddict`` (first-
     sight dense ids) and fill values into the float64 buffer
-    ``vals`` / ids into the int32 buffer ``ids``.  Returns
-    ``(new_keys, all_int)``, or None without the native module.
-    Raises TypeError on malformed rows or non-numeric values (with
-    ``iddict`` rolled back) — callers fall back on that."""
+    ``vals`` / ids into the int32 buffer ``ids``.  With the optional
+    int64 buffer ``ivals``, exact-integer streams also fill it
+    losslessly (values past 2^53 survive; past int64 the batch drops
+    to the float lane).  Returns ``(new_keys, all_int)``, or None
+    without the native module.  Raises TypeError on malformed rows or
+    non-numeric values (with ``iddict`` rolled back) — callers fall
+    back on that."""
     ext = _ext()
-    return None if ext is None else ext.kv_encode(items, iddict, ids, vals)
+    return (
+        None
+        if ext is None
+        else ext.kv_encode(items, iddict, ids, vals, ivals)
+    )
 
 
 def any_isinstance(items, types) -> Optional[bool]:

@@ -381,12 +381,17 @@ done:
 static PyObject *
 kv_encode(PyObject *self, PyObject *args)
 {
-    PyObject *items, *iddict, *ids_obj, *vals_obj;
-    if (!PyArg_ParseTuple(args, "O!O!OO", &PyList_Type, &items,
-                          &PyDict_Type, &iddict, &ids_obj, &vals_obj)) {
+    PyObject *items, *iddict, *ids_obj, *vals_obj, *ivals_obj = NULL;
+    if (!PyArg_ParseTuple(args, "O!O!OO|O", &PyList_Type, &items,
+                          &PyDict_Type, &iddict, &ids_obj, &vals_obj,
+                          &ivals_obj)) {
         return NULL;
     }
-    Py_buffer iv, vv;
+    if (ivals_obj == Py_None) {
+        ivals_obj = NULL;
+    }
+    Py_buffer iv, vv, iiv;
+    iiv.buf = NULL;
     if (PyObject_GetBuffer(ids_obj, &iv, PyBUF_CONTIG | PyBUF_WRITABLE) < 0) {
         return NULL;
     }
@@ -394,12 +399,21 @@ kv_encode(PyObject *self, PyObject *args)
         PyBuffer_Release(&iv);
         return NULL;
     }
+    if (ivals_obj != NULL
+        && PyObject_GetBuffer(ivals_obj, &iiv,
+                              PyBUF_CONTIG | PyBUF_WRITABLE) < 0) {
+        PyBuffer_Release(&iv);
+        PyBuffer_Release(&vv);
+        return NULL;
+    }
     int32_t *ids = (int32_t *)iv.buf;
     double *vals = (double *)vv.buf;
+    int64_t *ivals = (int64_t *)iiv.buf; /* NULL without the buffer */
     Py_ssize_t n = PyList_GET_SIZE(items);
     PyObject *new_keys = NULL;
     if (iv.len / (Py_ssize_t)sizeof(int32_t) < n
-        || vv.len / (Py_ssize_t)sizeof(double) < n) {
+        || vv.len / (Py_ssize_t)sizeof(double) < n
+        || (ivals != NULL && iiv.len / (Py_ssize_t)sizeof(int64_t) < n)) {
         PyErr_SetString(PyExc_ValueError, "output buffers too small");
         goto fail;
     }
@@ -426,6 +440,27 @@ kv_encode(PyObject *self, PyObject *args)
          * streams keep the exact integer accumulator. */
         if (all_int && !PyIndex_Check(v)) {
             all_int = 0;
+        }
+        if (all_int && ivals != NULL) {
+            /* Exact int64 lane: values beyond 2^53 survive (the
+             * float64 lane would round them).  Overflow past int64
+             * drops the whole batch to the float path, like the
+             * per-item fallback's numpy coercion would error. */
+            PyObject *exact = PyNumber_Index(v);
+            if (exact == NULL) {
+                goto fail;
+            }
+            int overflow = 0;
+            long long llv = PyLong_AsLongLongAndOverflow(exact, &overflow);
+            Py_DECREF(exact);
+            if (llv == -1 && PyErr_Occurred()) {
+                goto fail;
+            }
+            if (overflow) {
+                all_int = 0;
+            } else {
+                ivals[i] = (int64_t)llv;
+            }
         }
         double d = PyFloat_AsDouble(v);
         if (d == -1.0 && PyErr_Occurred()) {
@@ -455,6 +490,9 @@ kv_encode(PyObject *self, PyObject *args)
     }
     PyBuffer_Release(&iv);
     PyBuffer_Release(&vv);
+    if (iiv.buf != NULL) {
+        PyBuffer_Release(&iiv);
+    }
     PyObject *res = Py_BuildValue("(Oi)", new_keys, all_int);
     Py_DECREF(new_keys);
     return res;
@@ -476,6 +514,9 @@ fail:
     }
     PyBuffer_Release(&iv);
     PyBuffer_Release(&vv);
+    if (iiv.buf != NULL) {
+        PyBuffer_Release(&iiv);
+    }
     return NULL;
 }
 

@@ -1051,6 +1051,11 @@ def count_final(
 
     Vectorized on the XLA tier as a segment-sum over hashed key ids.
 
+    ``key`` applies to itemized rows only: a columnar ``ArrayBatch``
+    already carrying a ``key``/``key_id`` column counts by that
+    column directly (the rows' keys ARE the keys — a non-trivial
+    ``key`` transform belongs upstream of batch construction).
+
     >>> import bytewax_tpu.operators as op
     >>> from bytewax_tpu.dataflow import Dataflow
     >>> from bytewax_tpu.testing import TestingSink, TestingSource, run_main

@@ -10,8 +10,9 @@ Export transports, in preference order:
 
 - the ``opentelemetry`` SDK when installed (gRPC OTLP / the Jaeger
   thrift agent — optional dependencies);
-- a built-in OTLP/HTTP+JSON exporter (pure stdlib) for ``http(s)://``
-  endpoints: real ``ExportTraceServiceRequest`` JSON POSTed to
+- with the SDK absent, a built-in OTLP/HTTP+JSON exporter (pure
+  stdlib) for ``http(s)://`` endpoints:
+  real ``ExportTraceServiceRequest`` JSON POSTed to
   ``/v1/traces``, batched on a background flush with head sampling by
   ``sampling_ratio`` — any OTLP-ingesting collector (an OpenTelemetry
   Collector, Jaeger ≥1.35, Tempo, ...) accepts it.  This is what runs
@@ -131,6 +132,7 @@ class _InlineOtlpExporter:
         self._lock = threading.Lock()
         self._rng = random.Random()
         self._closed = False
+        self._flushing = False
         self._timer: Optional[threading.Timer] = None
         self._arm_timer()
 
@@ -152,8 +154,22 @@ class _InlineOtlpExporter:
         with self._lock:
             self._buf.append(span)
             full = len(self._buf) >= self.BATCH
-        if full:
+            kick = full and not self._flushing
+            if kick:
+                self._flushing = True
+        if kick:
+            # Export off the span-ending thread: a slow collector
+            # must never stall the dataflow hot loop.
+            threading.Thread(
+                target=self._flush_async, daemon=True
+            ).start()
+
+    def _flush_async(self) -> None:
+        try:
             self.flush()
+        finally:
+            with self._lock:
+                self._flushing = False
 
     def _payload(self, spans: List[dict]) -> bytes:
         doc = {
@@ -230,53 +246,56 @@ def setup_tracing(
             endpoint = tracing_config.url
         else:
             endpoint = tracing_config.endpoint
-        if endpoint.startswith(("http://", "https://")):
-            # Built-in OTLP/HTTP+JSON transport (pure stdlib).  For
-            # Jaeger this targets the collector's native OTLP
-            # ingestion (Jaeger ≥1.35); the classic thrift UDP agent
-            # needs the SDK path below.
-            inline = _InlineOtlpExporter(
-                tracing_config.service_name,
-                endpoint,
-                tracing_config.sampling_ratio,
+        try:
+            from opentelemetry import trace as ot_trace  # noqa: F401
+            from opentelemetry.sdk.resources import Resource  # noqa: F401
+            from opentelemetry.sdk.trace import TracerProvider
+            from opentelemetry.sdk.trace.export import BatchSpanProcessor
+        except ImportError as ex:
+            # The optional SDK is absent: http(s):// endpoints ride
+            # the built-in OTLP/HTTP+JSON transport (pure stdlib) —
+            # for Jaeger that targets the collector's native OTLP
+            # ingestion (Jaeger ≥1.35); gRPC URLs and the classic
+            # thrift UDP agent need the SDK.
+            if endpoint.startswith(("http://", "https://")):
+                inline = _InlineOtlpExporter(
+                    tracing_config.service_name,
+                    endpoint,
+                    tracing_config.sampling_ratio,
+                )
+                _tracer = BytewaxTracer(tracing_config, None, inline)
+                return _tracer
+            msg = (
+                "exporting traces over gRPC/thrift requires the "
+                "`opentelemetry-sdk` package; install it, or point "
+                "the config at an http(s):// OTLP endpoint to use "
+                "the built-in OTLP/HTTP exporter"
             )
+            raise ImportError(msg) from ex
+        # SDK installed: it handles every endpoint form (including
+        # http:// gRPC endpoints), so it always wins over the
+        # built-in transport.
+        resource = Resource.create(
+            {"service.name": tracing_config.service_name}
+        )
+        provider = TracerProvider(resource=resource)
+        if isinstance(tracing_config, OtlpTracingConfig):
+            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+                OTLPSpanExporter,
+            )
+
+            exporter = OTLPSpanExporter(endpoint=tracing_config.url)
         else:
-            try:
-                from opentelemetry import trace as ot_trace
-                from opentelemetry.sdk.resources import Resource
-                from opentelemetry.sdk.trace import TracerProvider
-                from opentelemetry.sdk.trace.export import (
-                    BatchSpanProcessor,
-                )
-            except ImportError as ex:
-                msg = (
-                    "exporting traces over gRPC/thrift requires the "
-                    "`opentelemetry-sdk` package; install it, or point "
-                    "the config at an http(s):// OTLP endpoint to use "
-                    "the built-in OTLP/HTTP exporter"
-                )
-                raise ImportError(msg) from ex
-            resource = Resource.create(
-                {"service.name": tracing_config.service_name}
+            from opentelemetry.exporter.jaeger.thrift import (
+                JaegerExporter,
             )
-            provider = TracerProvider(resource=resource)
-            if isinstance(tracing_config, OtlpTracingConfig):
-                from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
-                    OTLPSpanExporter,
-                )
 
-                exporter = OTLPSpanExporter(endpoint=tracing_config.url)
-            else:
-                from opentelemetry.exporter.jaeger.thrift import (
-                    JaegerExporter,
-                )
-
-                host, _, port = tracing_config.endpoint.partition(":")
-                exporter = JaegerExporter(
-                    agent_host_name=host, agent_port=int(port or 6831)
-                )
-            provider.add_span_processor(BatchSpanProcessor(exporter))
-            ot_trace.set_tracer_provider(provider)
+            host, _, port = tracing_config.endpoint.partition(":")
+            exporter = JaegerExporter(
+                agent_host_name=host, agent_port=int(port or 6831)
+            )
+        provider.add_span_processor(BatchSpanProcessor(exporter))
+        ot_trace.set_tracer_provider(provider)
 
     _tracer = BytewaxTracer(tracing_config, provider, inline)
     return _tracer

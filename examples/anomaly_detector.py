@@ -1,12 +1,17 @@
 """Per-key rolling z-score anomaly detection
-(reference: examples/anomaly_detector.py)."""
+(reference: examples/anomaly_detector.py).
+
+Wires the SAME flow the benchmarks measure
+(:func:`bytewax_tpu.models.anomaly.anomaly_flow`) to a demo metric
+source and stdout — the marked :func:`bytewax_tpu.xla.zscore` mapper
+lowers to a segmented-scan device program per micro-batch.
+"""
 
 from datetime import timedelta
 
-import bytewax_tpu.operators as op
 from bytewax_tpu.connectors.demo import RandomMetricSource
 from bytewax_tpu.connectors.stdio import StdOutSink
-from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.models.anomaly import anomaly_flow
 
 
 def _fmt(kv):
@@ -15,23 +20,11 @@ def _fmt(kv):
     return f"{key}: value={value:+.3f} z={z:+.2f}{flag}"
 
 
-def get_flow():
-    from bytewax_tpu.xla import zscore
-
-    flow = Dataflow("anomaly_detector")
-    s = op.input(
-        "inp",
-        flow,
-        RandomMetricSource(
-            "system_metric", interval=timedelta(0), count=200, seed=42
-        ),
-    )
-    # A marked mapper: the engine lowers this stateful_map to a
-    # segmented-scan device program; unmarked lambdas run host-tier.
-    scored = op.stateful_map("zscore", s, zscore(2.5))
-    pretty = op.map("fmt", scored, _fmt)
-    op.output("out", pretty, StdOutSink())
-    return flow
-
-
-flow = get_flow()
+flow = anomaly_flow(
+    RandomMetricSource(
+        "system_metric", interval=timedelta(0), count=200, seed=42
+    ),
+    StdOutSink(),
+    threshold=2.5,
+    fmt=_fmt,
+)

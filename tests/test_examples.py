@@ -20,7 +20,6 @@ KAFKA_GATED = {
 
 BUILD_ONLY = KAFKA_GATED | {
     "brc.py",  # needs a measurements file
-    "wordcount_tpu.py",  # relative path; covered via wordcount.py
     "wordcount.py",  # relative sample path; run from repo root below
     "benchmark_windowing.py",  # 1M items; covered by bench tests
 }

@@ -178,3 +178,47 @@ def test_kv_encode_rolls_back_on_error():
         kv_encode(items, iddict, ids, vals)
     # The keys added before the failure are rolled back.
     assert iddict == {"pre": 0}
+
+
+def test_kv_encode_int64_exact_past_2_53():
+    """Exact-int streams keep exact values beyond float64's 2^53
+    integer range via the int64 lane (ADVICE r4: the float64
+    round-trip silently rounded large counters/timestamps)."""
+    import numpy as np
+
+    from bytewax_tpu.native import kv_encode
+
+    big = (1 << 53) + 1  # not representable in float64
+    items = [("a", big), ("a", 1), ("b", 7)]
+    ids = np.empty(3, dtype=np.int32)
+    vals = np.empty(3, dtype=np.float64)
+    ivals = np.empty(3, dtype=np.int64)
+    res = kv_encode(items, {}, ids, vals, ivals)
+    if res is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    _new, all_int = res
+    assert all_int
+    assert ivals.tolist() == [big, 1, 7]
+    assert int(vals[0]) != big  # the float lane rounds; the int lane is why
+
+
+def test_kv_encode_int_overflow_falls_to_float():
+    import numpy as np
+
+    from bytewax_tpu.native import kv_encode
+
+    over = 1 << 70
+    items = [("a", over)]
+    ids = np.empty(1, dtype=np.int32)
+    vals = np.empty(1, dtype=np.float64)
+    ivals = np.empty(1, dtype=np.int64)
+    res = kv_encode(items, {}, ids, vals, ivals)
+    if res is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    _new, all_int = res
+    assert not all_int
+    assert vals[0] == float(over)
