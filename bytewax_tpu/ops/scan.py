@@ -32,6 +32,7 @@ __all__ = [
     "ScanKind",
     "WelfordZScore",
     "Ema",
+    "JaxUdfScan",
     "RunningExtrema",
     "generic_scan_body",
     "generic_scan_kernel",
@@ -443,3 +444,61 @@ class RunningExtrema(ScanKind):
 
     def emit(self, pre, post, values):
         return post
+
+
+class JaxUdfScan(ScanKind):
+    """ANY jax-traceable per-key mapper at device speed — the
+    traceable-UDF tier for ``stateful_map``.
+
+    Where the monoid kinds above parallelize their fold (O(log n)
+    segmented scan), an arbitrary mapper has no associative structure
+    to exploit: this kind runs the rows through ONE compiled
+    ``lax.scan`` instead — still one device program per micro-batch
+    with per-key state in slot tables (no per-item Python, no GIL),
+    just sequential in the scan dimension.  On a mesh it shards like
+    every other kind (each shard scans only its own keys' rows), so
+    devices divide the sequential length.
+
+    ``fn(state_tuple, value) -> (state_tuple, outs_tuple)`` — scalar
+    jax ops over a tuple of scalar state fields; ``init`` gives each
+    field's initial value (and, by Python type, its dtype: float →
+    f32, int → int32, bool → bool).  The emitted item per row is
+    ``(value, *outs)``.  Snapshots are the plain state tuple, in
+    field order, interchangeable with the host tier.
+    """
+
+    name = "jax_udf"
+
+    def __init__(self, fn: Callable, init: Tuple):
+        self.fn = fn
+        self.init = tuple(init)
+
+        def dtype_of(v):
+            if isinstance(v, bool):
+                return jnp.bool_
+            if isinstance(v, int):
+                return jnp.int32
+            return jnp.float32
+
+        self.fields = {
+            f"s{i}": (v, dtype_of(v)) for i, v in enumerate(self.init)
+        }
+
+    def raw_run(self, fields, slots, values):
+        names = tuple(self.fields)
+
+        def step(tables, row):
+            slot, v = row
+            state = tuple(t[slot] for t in tables)
+            new_state, outs = self.fn(state, v)
+            tables = tuple(
+                t.at[slot].set(jnp.asarray(ns).astype(t.dtype))
+                for t, ns in zip(tables, new_state)
+            )
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            return tables, tuple(jnp.asarray(o) for o in outs)
+
+        tables0 = tuple(fields[nm] for nm in names)
+        tables_n, emits = jax.lax.scan(step, tables0, (slots, values))
+        return tuple(emits), dict(zip(names, tables_n))

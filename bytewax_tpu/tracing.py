@@ -117,6 +117,9 @@ class _InlineOtlpExporter:
 
     BATCH = 64
     FLUSH_S = 2.0
+    #: Buffer cap: beyond this, oldest spans drop (export is
+    #: best-effort; a wedged collector must not grow memory).
+    MAX_BUFFERED = 4096
 
     def __init__(self, service_name: str, url: str, ratio: float):
         # Bare collector endpoints (no path, or just "/") get the
@@ -153,6 +156,14 @@ class _InlineOtlpExporter:
     def on_span_end(self, span: dict) -> None:
         with self._lock:
             self._buf.append(span)
+            if len(self._buf) > self.MAX_BUFFERED:
+                # Oldest-first drop: a slow/wedged collector bounds
+                # memory, not the pipeline.
+                dropped = len(self._buf) - self.MAX_BUFFERED
+                del self._buf[:dropped]
+                logger.debug(
+                    "OTLP buffer full; dropped %d oldest spans", dropped
+                )
             full = len(self._buf) >= self.BATCH
             kick = full and not self._flushing
             if kick:
@@ -246,50 +257,49 @@ def setup_tracing(
             endpoint = tracing_config.url
         else:
             endpoint = tracing_config.endpoint
+        if endpoint.startswith(("http://", "https://")):
+            # Transport selection is by PROTOCOL, deterministically:
+            # an http(s):// endpoint speaks OTLP/HTTP, which the
+            # built-in exporter implements (for Jaeger: the
+            # collector's native OTLP ingestion, Jaeger ≥1.35).
+            # gRPC stays spelled grpc:// (the config default).
+            inline = _InlineOtlpExporter(
+                tracing_config.service_name,
+                endpoint,
+                tracing_config.sampling_ratio,
+            )
+            _tracer = BytewaxTracer(tracing_config, None, inline)
+            return _tracer
         try:
-            from opentelemetry import trace as ot_trace  # noqa: F401
-            from opentelemetry.sdk.resources import Resource  # noqa: F401
+            from opentelemetry import trace as ot_trace
+            from opentelemetry.sdk.resources import Resource
             from opentelemetry.sdk.trace import TracerProvider
             from opentelemetry.sdk.trace.export import BatchSpanProcessor
-        except ImportError as ex:
-            # The optional SDK is absent: http(s):// endpoints ride
-            # the built-in OTLP/HTTP+JSON transport (pure stdlib) —
-            # for Jaeger that targets the collector's native OTLP
-            # ingestion (Jaeger ≥1.35); gRPC URLs and the classic
-            # thrift UDP agent need the SDK.
-            if endpoint.startswith(("http://", "https://")):
-                inline = _InlineOtlpExporter(
-                    tracing_config.service_name,
-                    endpoint,
-                    tracing_config.sampling_ratio,
+
+            if isinstance(tracing_config, OtlpTracingConfig):
+                from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+                    OTLPSpanExporter,
                 )
-                _tracer = BytewaxTracer(tracing_config, None, inline)
-                return _tracer
+            else:
+                from opentelemetry.exporter.jaeger.thrift import (
+                    JaegerExporter,
+                )
+        except ImportError as ex:
             msg = (
                 "exporting traces over gRPC/thrift requires the "
-                "`opentelemetry-sdk` package; install it, or point "
-                "the config at an http(s):// OTLP endpoint to use "
-                "the built-in OTLP/HTTP exporter"
+                "`opentelemetry-sdk` package (plus the matching "
+                "exporter package); install them, or point the config "
+                "at an http(s):// OTLP endpoint to use the built-in "
+                "OTLP/HTTP exporter"
             )
             raise ImportError(msg) from ex
-        # SDK installed: it handles every endpoint form (including
-        # http:// gRPC endpoints), so it always wins over the
-        # built-in transport.
         resource = Resource.create(
             {"service.name": tracing_config.service_name}
         )
         provider = TracerProvider(resource=resource)
         if isinstance(tracing_config, OtlpTracingConfig):
-            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
-                OTLPSpanExporter,
-            )
-
             exporter = OTLPSpanExporter(endpoint=tracing_config.url)
         else:
-            from opentelemetry.exporter.jaeger.thrift import (
-                JaegerExporter,
-            )
-
             host, _, port = tracing_config.endpoint.partition(":")
             exporter = JaegerExporter(
                 agent_host_name=host, agent_port=int(port or 6831)

@@ -39,6 +39,7 @@ __all__ = [
     "SUM",
     "WindowFold",
     "ema",
+    "jax_stateful_map",
     "jit_batch",
     "map_batch",
     "running_extrema",
@@ -270,6 +271,75 @@ def running_extrema() -> ScanMap:
     max.  Emits ``(value, min_so_far, max_so_far)`` per item; lowers
     to the device segmented scan like :func:`zscore`."""
     return _RunningExtremaMap()
+
+
+class _JaxStatefulMap(ScanMap):
+    """Traceable-UDF ``stateful_map`` mapper: any jax function over
+    per-key scalar state runs as one compiled ``lax.scan`` per
+    micro-batch on the device tier, and eagerly per item on the host
+    tier — identical semantics, interchangeable snapshots."""
+
+    kind = "jax_udf"
+
+    def __init__(self, fn: Callable, init: tuple):
+        self.fn = fn
+        self.init = tuple(init)
+
+    def __call__(self, state, value):
+        state = self.init if state is None else tuple(state)
+        new_state, outs = self.fn(state, value)
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+
+        def scalar(x, like):
+            x = x.item() if hasattr(x, "item") else x
+            return type(like)(x)
+
+        host_state = tuple(
+            scalar(ns, i) for ns, i in zip(new_state, self.init)
+        )
+        host_outs = tuple(
+            x.item() if hasattr(x, "item") else x for x in outs
+        )
+        return host_state, (value, *host_outs)
+
+    def device_kind(self):
+        from bytewax_tpu.ops.scan import JaxUdfScan
+
+        return JaxUdfScan(self.fn, self.init)
+
+    def __repr__(self) -> str:
+        return f"bytewax_tpu.xla.jax_stateful_map({self.fn!r})"
+
+
+def jax_stateful_map(
+    fn: Callable, init: tuple
+) -> ScanMap:
+    """A ``stateful_map`` mapper from ANY jax-traceable per-key
+    function — the traceable-UDF tier the monoid kinds
+    (:func:`zscore`, :func:`ema`, ...) don't cover.
+
+    ``fn(state_tuple, value) -> (state_tuple, outs)`` using scalar
+    jax ops; ``init`` is the per-key initial state tuple (Python
+    floats/ints/bools fix each field's dtype).  Each item emits
+    ``(value, *outs)``.  The engine lowers the whole micro-batch to
+    one compiled ``lax.scan`` over slot-table state (sequential in
+    the scan dimension — an associative fold expressed as a
+    :class:`~bytewax_tpu.ops.scan.ScanKind` parallelizes instead);
+    the host tier runs ``fn`` eagerly per item with identical
+    semantics, and snapshots interchange between tiers.
+
+    >>> import jax.numpy as jnp
+    >>> from bytewax_tpu import xla
+    >>> def capped_total(state, v):
+    ...     (total,) = state
+    ...     total = jnp.minimum(total + v, 100.0)
+    ...     return (total,), (total,)
+    >>> mapper = xla.jax_stateful_map(capped_total, (0.0,))
+    >>> mapper(None, 3.0)
+    ((3.0,), (3.0, 3.0))
+    """
+    return _JaxStatefulMap(fn, init)
 
 
 class JaxUDF:
