@@ -491,6 +491,12 @@ class JaxUdfScan(ScanKind):
             slot, v = row
             state = tuple(t[slot] for t in tables)
             new_state, outs = self.fn(state, v)
+            if len(new_state) != len(tables):
+                msg = (
+                    f"jax_stateful_map fn returned {len(new_state)} "
+                    f"state fields; init declared {len(tables)}"
+                )
+                raise TypeError(msg)
             tables = tuple(
                 t.at[slot].set(jnp.asarray(ns).astype(t.dtype))
                 for t, ns in zip(tables, new_state)

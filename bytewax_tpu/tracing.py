@@ -136,6 +136,7 @@ class _InlineOtlpExporter:
         self._rng = random.Random()
         self._closed = False
         self._flushing = False
+        self._warned = False
         self._timer: Optional[threading.Timer] = None
         self._arm_timer()
 
@@ -222,7 +223,11 @@ class _InlineOtlpExporter:
             with urllib.request.urlopen(req, timeout=5) as resp:
                 resp.read()
         except Exception as ex:  # noqa: BLE001 — telemetry must not kill flows
-            logger.debug("OTLP export to %s failed: %s", self.url, ex)
+            # First failure is VISIBLE (a misconfigured collector
+            # must not silently eat all telemetry); repeats at DEBUG.
+            log = logger.debug if self._warned else logger.warning
+            self._warned = True
+            log("OTLP export to %s failed: %s", self.url, ex)
 
     def shutdown(self) -> None:
         self._closed = True
@@ -257,12 +262,21 @@ def setup_tracing(
             endpoint = tracing_config.url
         else:
             endpoint = tracing_config.endpoint
-        if endpoint.startswith(("http://", "https://")):
-            # Transport selection is by PROTOCOL, deterministically:
-            # an http(s):// endpoint speaks OTLP/HTTP, which the
-            # built-in exporter implements (for Jaeger: the
-            # collector's native OTLP ingestion, Jaeger ≥1.35).
-            # gRPC stays spelled grpc:// (the config default).
+        # Transport selection is by protocol, deterministically: an
+        # http(s):// endpoint speaks OTLP/HTTP (the built-in
+        # exporter; for Jaeger: the collector's native OTLP
+        # ingestion, Jaeger ≥1.35) — EXCEPT the registered OTLP/gRPC
+        # port 4317 with no path, the ecosystem's conventional
+        # spelling for a gRPC endpoint (OTEL_EXPORTER_OTLP_ENDPOINT),
+        # which routes to the SDK's gRPC exporter.  grpc:// is the
+        # config default.
+        is_http = endpoint.startswith(("http://", "https://"))
+        if is_http:
+            rest = endpoint.split("://", 1)[1]
+            hostport, _slash, path = rest.partition("/")
+            if hostport.endswith(":4317") and not path:
+                is_http = False
+        if is_http:
             inline = _InlineOtlpExporter(
                 tracing_config.service_name,
                 endpoint,

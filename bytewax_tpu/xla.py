@@ -288,6 +288,12 @@ class _JaxStatefulMap(ScanMap):
     def __call__(self, state, value):
         state = self.init if state is None else tuple(state)
         new_state, outs = self.fn(state, value)
+        if len(new_state) != len(self.init):
+            msg = (
+                f"jax_stateful_map fn returned {len(new_state)} "
+                f"state fields; init declared {len(self.init)}"
+            )
+            raise TypeError(msg)
         if not isinstance(outs, tuple):
             outs = (outs,)
 
@@ -339,7 +345,39 @@ def jax_stateful_map(
     >>> mapper(None, 3.0)
     ((3.0,), (3.0, 3.0))
     """
-    return _JaxStatefulMap(fn, init)
+    mapper = _JaxStatefulMap(fn, init)
+    # Fail at CONSTRUCTION, not mid-stream: trace fn abstractly (no
+    # device work) so Python control flow on traced state, wrong
+    # state arity, and shape bugs surface where the user wrote them —
+    # an untraceable fn would otherwise run fine on the host tier and
+    # crash only accelerated runs deep in the engine.
+    import jax
+    import jax.numpy as jnp
+
+    abstract_state = tuple(
+        jnp.zeros((), dtype=(jnp.bool_ if isinstance(v, bool)
+                             else jnp.int32 if isinstance(v, int)
+                             else jnp.float32))
+        for v in mapper.init
+    )
+    try:
+        state_out, _outs = jax.eval_shape(
+            fn, abstract_state, jnp.zeros((), dtype=jnp.float32)
+        )
+    except Exception as ex:  # noqa: BLE001 — surface as a clear TypeError
+        msg = (
+            "jax_stateful_map requires a jax-traceable "
+            "(state_tuple, value) -> (state_tuple, outs) function "
+            f"(no Python control flow on state); tracing failed: {ex}"
+        )
+        raise TypeError(msg) from ex
+    if len(state_out) != len(mapper.init):
+        msg = (
+            f"jax_stateful_map fn returns {len(state_out)} state "
+            f"fields; init declares {len(mapper.init)}"
+        )
+        raise TypeError(msg)
+    return mapper
 
 
 class JaxUDF:

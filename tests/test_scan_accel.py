@@ -613,3 +613,32 @@ def test_jax_stateful_map_cross_tier_snapshot(recovery_config):
         else:
             os.environ["BYTEWAX_TPU_ACCEL"] = env_prev
     _assert_rows_close(out1 + out2, want)
+
+
+def test_jax_stateful_map_rejects_bad_fns_at_construction():
+    import jax.numpy as jnp
+
+    # Python control flow on traced state: rejected up front.
+    def branchy(state, v):
+        (total,) = state
+        if total > 50:  # concretizes a tracer
+            total = 0.0
+        return (total + v,), (total,)
+
+    with pytest.raises(TypeError, match="traceable"):
+        xla.jax_stateful_map(branchy, (0.0,))
+
+    # Wrong state arity: rejected up front.
+    def shrinker(state, v):
+        total, _n = state
+        return (total + v,), (total,)
+
+    with pytest.raises(TypeError, match="state fields"):
+        xla.jax_stateful_map(shrinker, (0.0, 0))
+
+    # A valid fn still constructs.
+    def ok(state, v):
+        (total,) = state
+        return (jnp.minimum(total + v, 9.0),), (total,)
+
+    assert xla.jax_stateful_map(ok, (0.0,)) is not None
