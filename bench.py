@@ -766,6 +766,26 @@ def main() -> None:
             __import__("jax").local_devices()
         )
 
+    # The flight recorder's counters and close-percentile buffer are
+    # always on (the ring stays off, so the measured loops are not
+    # perturbed): report compile counts and epoch-close latency so
+    # BENCH_* files track recompile regressions round over round.
+    from bytewax_tpu.engine import flight
+
+    rec = flight.RECORDER
+    extra["xla_compile_count"] = int(
+        rec.counters.get("xla_compile_count", 0)
+    )
+    extra["xla_compile_seconds"] = round(
+        rec.counters.get("xla_compile_seconds", 0.0), 3
+    )
+    pct = rec.epoch_close_percentiles()
+    if pct is not None:
+        p50_s, p99_s_close, n_closes_rec = pct
+        extra["epoch_close_p50_ms"] = round(p50_s * 1e3, 3)
+        extra["epoch_close_p99_ms"] = round(p99_s_close * 1e3, 3)
+        extra["epoch_closes_recorded"] = n_closes_rec
+
     extra["backend"] = backend
     _note_regressions(extra, xla_rate)
     print(

@@ -17,9 +17,17 @@ from prometheus_client.exposition import generate_latest
 __all__ = [
     "DURATION_BUCKETS",
     "DURATION_HISTOGRAMS",
+    "barrier_wait_seconds",
+    "comm_bytes",
+    "comm_frames",
+    "device_transfer_bytes",
+    "epoch_close_duration_seconds",
     "generate_python_metrics",
+    "gsync_round_count",
     "item_inp_count",
     "item_out_count",
+    "xla_compile_count",
+    "xla_compile_seconds",
 ]
 
 #: Explicit histogram buckets, matching the reference
@@ -98,6 +106,61 @@ DURATION_HISTOGRAMS: Dict[str, Histogram] = {
         "Time in the global-mesh exchange flush at epoch close",
     ),
 }
+
+
+# -- engine flight-recorder families ------------------------------------
+#
+# The reference instruments only user-code call sites; these cover the
+# parts this reproduction adds — the device tier and the clustered
+# epoch protocol (fed by ``bytewax_tpu/engine/flight.py``).
+
+epoch_close_duration_seconds = Histogram(
+    "bytewax_epoch_close_duration_seconds",
+    "Time closing an epoch (pre-close flushes + snapshots + commit)",
+    buckets=DURATION_BUCKETS,
+)
+
+barrier_wait_seconds = Histogram(
+    "bytewax_barrier_wait_seconds",
+    "Time from entering the cluster epoch barrier (hold) to the "
+    "close broadcast taking effect on this process",
+    buckets=DURATION_BUCKETS,
+)
+
+gsync_round_count = Counter(
+    "bytewax_gsync_round_count",
+    "Control-plane global_sync rounds completed (global-mesh "
+    "exchange metadata + the epoch-close telemetry piggyback)",
+)
+
+xla_compile_count = Counter(
+    "bytewax_xla_compile_count",
+    "XLA backend compiles observed via jax.monitoring (a compile "
+    "is a jit cache miss; steady state should add none)",
+)
+
+xla_compile_seconds = Counter(
+    "bytewax_xla_compile_seconds",
+    "Total seconds spent in XLA backend compiles",
+)
+
+device_transfer_bytes = Counter(
+    "bytewax_device_transfer_bytes",
+    "Host<->device bytes moved by the engine's device tier",
+    ["direction"],  # h2d | d2h
+)
+
+comm_frames = Counter(
+    "bytewax_comm_frames",
+    "Cluster-mesh frames shipped per peer (includes heartbeats)",
+    ["peer", "direction"],  # direction: tx | rx
+)
+
+comm_bytes = Counter(
+    "bytewax_comm_bytes",
+    "Cluster-mesh bytes shipped per peer (framed, pickled)",
+    ["peer", "direction"],
+)
 
 
 def generate_python_metrics() -> str:

@@ -20,9 +20,15 @@ import struct
 import time
 from typing import Any, List, Optional, Tuple
 
+from bytewax_tpu.engine import flight as _flight
+
 __all__ = ["Comm"]
 
 _LEN = struct.Struct("<Q")
+#: Default handshake budget: how long to keep dialing/accepting peers
+#: at startup.  ``BYTEWAX_TPU_DIAL_TIMEOUT_S`` overrides (read per
+#: connection, like the other comm knobs) because a loaded host can
+#: take longer than this just to start every process's interpreter.
 _DIAL_TIMEOUT_S = 30.0
 #: In-band liveness frame, swallowed before delivery.
 _HB = ("__bytewax_tpu_hb__",)
@@ -104,15 +110,24 @@ class Comm:
 
         # Dial every higher-id peer; accept from every lower-id peer.
         expect_accepts = proc_id
-        deadline = time.monotonic() + _DIAL_TIMEOUT_S
+        dial_timeout = float(
+            os.environ.get("BYTEWAX_TPU_DIAL_TIMEOUT_S", _DIAL_TIMEOUT_S)
+        )
+        deadline = time.monotonic() + dial_timeout
         for peer in range(proc_id + 1, self.proc_count):
             phost, _, pport = addresses[peer].rpartition(":")
-            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             while True:
+                # A fresh socket per attempt: a socket whose connect()
+                # failed (peer not listening yet) is left in an error
+                # state, and retrying connect() on the SAME fd can
+                # fail forever on some kernels — turning a lost
+                # startup race into a spurious dial timeout.
+                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
                 try:
                     sock.connect((phost or "127.0.0.1", int(pport)))
                     break
                 except OSError:
+                    sock.close()
                     if time.monotonic() > deadline:
                         msg = f"could not dial cluster peer {addresses[peer]!r}"
                         raise ConnectionError(msg) from None
@@ -157,6 +172,7 @@ class Comm:
         data = memoryview(_LEN.pack(len(payload)) + payload)
         sock = self._socks[dest]
         self._last_tx[dest] = time.monotonic()
+        _flight.note_comm("tx", dest, len(data))
         while data:
             try:
                 sent = sock.send(data)
@@ -206,6 +222,7 @@ class Comm:
                 break
             frame = bytes(buf[_LEN.size : _LEN.size + length])
             del buf[: _LEN.size + length]
+            _flight.note_comm("rx", peer, _LEN.size + length)
             msg = pickle.loads(frame)
             if msg == _HB:
                 continue  # liveness only; never delivered

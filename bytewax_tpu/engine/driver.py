@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from bytewax_tpu.dataflow import Dataflow, Operator
+from bytewax_tpu.engine import flight as _flight
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
 from bytewax_tpu.errors import note_context
 from bytewax_tpu.engine.flatten import Plan, flatten
@@ -784,14 +785,24 @@ class _StatefulBatchRt(_OpRt):
 
     def process(self, port: str, entries: List[Entry]) -> None:
         entries = self._split_remote(entries)
-        if self.wagg is not None:
-            self._process_window_accel(entries)
-            return
-        if self.agg is not None:
-            self._process_accel(entries)
-            return
-        if self.sagg is not None:
-            self._process_scan_accel(entries)
+        if (
+            self.wagg is not None
+            or self.agg is not None
+            or self.sagg is not None
+        ):
+            # Device-tier dispatch: visible as its own span (nested
+            # under the per-activation "operator" span) so OTLP traces
+            # show where the device tier starts, and as a ring event.
+            _flight.RECORDER.record(
+                "device_dispatch",
+                step=self.op.step_id,
+                entries=len(entries),
+            )
+            if self.driver.trace_ops:
+                with _span("device_dispatch", step_id=self.op.step_id):
+                    self._process_device(entries)
+            else:
+                self._process_device(entries)
             return
         out: Dict[int, List[Any]] = {}
         for _w, items in entries:
@@ -827,6 +838,17 @@ class _StatefulBatchRt(_OpRt):
                     _reraise(self.op.step_id, "`on_batch`", ex)
                 self._handle(key, emits, discard, out)
         self._flush(out)
+
+    def _process_device(self, entries: List[Entry]) -> None:
+        """Route a delivery to whichever device-tier state this step
+        lowered to.  The fallback paths inside may null the state and
+        re-enter :meth:`process` for the host tier."""
+        if self.wagg is not None:
+            self._process_window_accel(entries)
+        elif self.agg is not None:
+            self._process_accel(entries)
+        else:
+            self._process_scan_accel(entries)
 
     def _process_accel(self, entries: List[Entry]) -> None:
         assert self.agg is not None
@@ -1270,6 +1292,19 @@ class _Driver:
         self.worker_count = worker_count * self.proc_count
         self.local_lo = proc_id * worker_count
         self.local_hi = self.local_lo + worker_count
+        # API-server port offset: this process's rank among processes
+        # on the SAME host, so co-located processes (localhost
+        # testing) don't collide while one-process-per-host
+        # deployments (k8s StatefulSets) keep the fixed configured
+        # port on every pod.
+        self.api_port_offset = 0
+        if addresses:
+            host = addresses[proc_id].rpartition(":")[0]
+            self.api_port_offset = sum(
+                1
+                for a in addresses[:proc_id]
+                if a.rpartition(":")[0] == host
+            )
         self.comm = None
         if self.proc_count > 1:
             from bytewax_tpu.engine.comm import Comm
@@ -1322,7 +1357,22 @@ class _Driver:
         ):
             import jax
 
-            if not jax.distributed.is_initialized():
+            from bytewax_tpu.parallel.mesh import (
+                distributed_is_initialized,
+            )
+
+            if not distributed_is_initialized():
+                try:
+                    # The CPU backend only supports cross-process
+                    # collectives through gloo, and the choice must
+                    # land before the backend comes up; harmless on
+                    # TPU (the option only affects CPU) and on jax
+                    # versions without the knob.
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo"
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
                 coord = os.environ.get("BYTEWAX_TPU_COORDINATOR")
                 if not coord:
                     # Derive a deterministic coordinator port from the
@@ -1430,8 +1480,15 @@ class _Driver:
     def _close_epoch(self, workers: Optional[range] = None) -> None:
         from bytewax_tpu.tracing import span
 
-        with span("epoch_close", epoch=self.epoch):
+        closing = self.epoch
+        t0 = time.monotonic()
+        with span("epoch_close", epoch=closing):
             self._close_epoch_inner(workers)
+        dt = time.monotonic() - t0
+        from bytewax_tpu._metrics import epoch_close_duration_seconds
+
+        epoch_close_duration_seconds.observe(dt)
+        _flight.RECORDER.note_epoch_close(closing, dt)
         if self._gc_managed:
             # Deterministic collection points: the cycle collector is
             # off during the hot loop (its periodic full scans over a
@@ -1464,6 +1521,9 @@ class _Driver:
                         pickle.dumps(state) if state is not None else None
                     )
                     snaps.append((rt.op.step_id, state_key, ser))
+            _flight.RECORDER.record(
+                "snapshot", epoch=self.epoch, states=len(snaps)
+            )
             if self._commit_delay is None:
                 commit_epoch = None
             else:
@@ -1489,7 +1549,21 @@ class _Driver:
         else:
             for rt in self.rts:
                 rt.epoch_snaps()  # still clears awoken sets
+        if self.comm is not None and self._flight_sync:
+            # Telemetry piggyback on the epoch-close sync ladder:
+            # one gsync round carrying each process's compact
+            # flight-recorder summary, so any process's /status shows
+            # the whole cluster.  Legal control-plane metadata — an
+            # uncounted gsync frame at a globally-ordered point; the
+            # gate was agreed cluster-wide by the startup "fcfg"
+            # round, so every process runs the same round sequence.
+            replies = self.global_sync(
+                ("fstat", self.next_gsync_tag()),
+                _flight.RECORDER.summary(self.epoch),
+            )
+            _flight.RECORDER.cluster = dict(sorted(replies.items()))
         self.epoch += 1
+        _flight.RECORDER.record("epoch_open", epoch=self.epoch)
 
     def _pump(self, timeout: float = 0.0) -> None:
         """Receive cluster messages: inject shipped data, apply
@@ -1520,6 +1594,11 @@ class _Driver:
         elif kind == "report_msg":
             self._reports[_src] = msg[1]
         elif kind == "hold":
+            if not self._holding:
+                self._hold_t0 = time.monotonic()
+                _flight.RECORDER.record(
+                    "barrier_enter", epoch=self.epoch, gen=msg[1]
+                )
             self._holding = True
             self._gen = msg[1]
         elif kind == "eof_step":
@@ -1561,6 +1640,7 @@ class _Driver:
         ``_pump`` — counting (sent/rcvd) is untouched, so the epoch
         barrier's in-flight accounting stays exact.
         """
+        t0 = time.monotonic()
         self.comm.broadcast(("gsync", tag, self.proc_id, payload))
         got = {self.proc_id: payload}
         for pid, pl in self._gsync_stash.pop(tag, []):
@@ -1596,6 +1676,7 @@ class _Driver:
                 if msg[0] == "abort":
                     raise _Abort()
                 self._pump_stash.append((_src, msg))
+        _flight.note_gsync(tag, time.monotonic() - t0)
         return got
 
     def _apply_eof_step(self, k: int) -> None:
@@ -1645,6 +1726,10 @@ class _Driver:
                 self._gen += 1
                 self.comm.broadcast(("hold", self._gen))
                 self._holding = True
+                self._hold_t0 = time.monotonic()
+                _flight.RECORDER.record(
+                    "barrier_enter", epoch=self.epoch, gen=self._gen
+                )
             return
         if not all(
             r[2] and r[6] == self._gen for r in reports.values()
@@ -1674,6 +1759,29 @@ class _Driver:
             self.comm.broadcast(("close_epoch", self.epoch, False))
             self._pending_close = (self.epoch, False)
 
+    def _status(self) -> Dict[str, Any]:
+        """Live ``GET /status`` document (read racily off the API
+        server thread — observability, not the epoch protocol)."""
+        rts = self.rts
+        return {
+            "flow_id": self.plan.flow.flow_id,
+            "proc_id": self.proc_id,
+            "proc_count": self.proc_count,
+            "worker_count": self.worker_count,
+            "workers": [self.local_lo, self.local_hi],
+            "epoch": self.epoch,
+            "eof": bool(rts) and all(rt.eof for rt in rts),
+            "queue_depths": {
+                rt.op.step_id: sum(len(q) for q in rt.queues.values())
+                for rt in rts
+            },
+            "recorder": _flight.RECORDER.snapshot(),
+            "cluster": {
+                str(pid): summary
+                for pid, summary in _flight.RECORDER.cluster.items()
+            },
+        }
+
     def run(self) -> None:
         # Build runtimes (applies resume state).
         for i, op in enumerate(self.plan.ops):
@@ -1696,15 +1804,37 @@ class _Driver:
         aborted = False
         clustered = self.comm is not None
         self._holding = False
+        self._hold_t0: Optional[float] = None
         self._pending_close: Optional[tuple] = None
         self._eof_k = 0
         self._gen = 0
         self._reports: Dict[int, tuple] = {}
         self._last_report: Optional[tuple] = None
 
+        # Flight recorder: ring writes on only when someone can look
+        # at them; the compile listener is counters-only and always
+        # on.  The epoch-close telemetry piggyback is a sync round
+        # every process must enter, so the cluster AGREES on it at
+        # startup with one unconditional gsync round (all processes
+        # run this exact sequence, making env divergence a disabled
+        # piggyback instead of a hung barrier).
+        _flight.ensure_compile_listener()
+        _flight.RECORDER.activate(_flight.enabled())
+        if clustered:
+            replies = self.global_sync(
+                ("fcfg", self.next_gsync_tag()), _flight.enabled()
+            )
+            self._flight_sync = all(replies.values())
+        else:
+            self._flight_sync = False
+
         from bytewax_tpu.engine.webserver import maybe_start_server
 
-        api_server = maybe_start_server(self.plan.flow)
+        api_server = maybe_start_server(
+            self.plan.flow,
+            status_fn=self._status,
+            port_offset=self.api_port_offset,
+        )
 
         # Epoch-aligned garbage collection (see _close_epoch); opt
         # out with BYTEWAX_TPU_GC=auto to keep Python's automatic
@@ -1727,6 +1857,11 @@ class _Driver:
                 if clustered and self._pending_close is not None:
                     _epoch, final = self._pending_close
                     self._pending_close = None
+                    if self._hold_t0 is not None:
+                        _flight.note_barrier(
+                            time.monotonic() - self._hold_t0
+                        )
+                        self._hold_t0 = None
                     self._close_epoch(workers=local_workers)
                     self._holding = False
                     epoch_started = time.monotonic()

@@ -1,0 +1,280 @@
+"""Per-process engine flight recorder.
+
+The host tier already meters user-code call sites
+(:mod:`bytewax_tpu._metrics`); this module is the telemetry floor for
+the parts the reference never had — the device tier and the clustered
+epoch protocol.  It keeps, per process:
+
+- a bounded in-memory **ring** of structured events (epoch open/close,
+  snapshot, barrier enter/exit, gsync round, device dispatch, XLA
+  compile, host↔device transfer) — written only when the recorder is
+  :func:`enabled` (``BYTEWAX_FLIGHT_RECORDER`` or the dataflow API
+  server), so the hot path pays nothing for it otherwise;
+- always-on scalar **counters** (plain dict adds — allocation-free),
+  mirrored into the Prometheus families in
+  :mod:`bytewax_tpu._metrics` so ``GET /metrics`` exposes them;
+- a bounded buffer of recent **epoch-close durations** for p50/p99
+  reporting (``bench.py`` and the ``/status`` plane);
+- the latest **cluster summaries** collected by the gsync piggyback at
+  epoch close (see ``engine/driver.py``), so process 0's ``/status``
+  shows every process.
+
+XLA compiles are observed via ``jax.monitoring`` duration events
+(:func:`ensure_compile_listener`), so every jit in the engine —
+segment folds, window scans, the sharded exchange — is counted without
+per-call-site plumbing.
+
+Thread-safety note: counters are GIL-atomic dict updates read racily
+by the API server thread; they are observability data, not an epoch
+protocol, and a torn read is harmless.
+"""
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "RECORDER",
+    "FlightRecorder",
+    "enabled",
+    "ensure_compile_listener",
+    "note_barrier",
+    "note_comm",
+    "note_gsync",
+    "note_transfer",
+]
+
+_RING_LEN = int(os.environ.get("BYTEWAX_FLIGHT_RING", 512))
+#: Epoch-close durations kept for percentile reporting.
+_CLOSE_BUF = 1024
+#: Ring events returned in a /status snapshot.
+_TAIL = 64
+
+
+def _truthy(name: str) -> bool:
+    """Repo convention (matches ``BYTEWAX_TPU_ACCEL``): unset, empty,
+    and ``0`` mean off; anything else means on."""
+    return os.environ.get(name, "0") not in ("", "0")
+
+
+def enabled() -> bool:
+    """Whether ring recording should be on for this process
+    (``BYTEWAX_FLIGHT_RECORDER`` or the dataflow API server being
+    enabled).  In clustered runs the driver exchanges this value at
+    startup and turns the epoch-close summary sync on only when every
+    process agrees."""
+    return _truthy("BYTEWAX_FLIGHT_RECORDER") or _truthy(
+        "BYTEWAX_DATAFLOW_API_ENABLED"
+    )
+
+
+class FlightRecorder:
+    """Bounded ring of engine events + always-on counters."""
+
+    def __init__(self, ring_len: int = _RING_LEN):
+        self._ring: deque = deque(maxlen=max(ring_len, 16))
+        self.counters: Dict[str, float] = {}
+        self._close_s: deque = deque(maxlen=_CLOSE_BUF)
+        self.active = False
+        #: proc_id -> latest piggybacked summary (clustered runs).
+        self.cluster: Dict[int, Any] = {}
+
+    def activate(self, on: bool) -> None:
+        self.active = bool(on)
+
+    # -- hot-path writers --------------------------------------------------
+
+    def count(self, name: str, n: float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def record(self, kind: str, **attrs: Any) -> None:
+        """Append one structured event to the ring (no-op unless the
+        recorder is active — the gate keeps the hot path
+        allocation-free by default)."""
+        if not self.active:
+            return
+        self._ring.append((time.time(), kind, attrs))
+
+    def note_epoch_close(self, epoch: int, seconds: float) -> None:
+        self.count("epoch_close_count")
+        self.count("epoch_close_seconds", seconds)
+        # The percentile buffer is always on (one float into a
+        # bounded deque) so readers like bench.py get close latency
+        # percentiles without turning on ring recording — which would
+        # perturb the very hot loops being measured.
+        self._close_s.append(seconds)
+        self.record(
+            "epoch_close", epoch=epoch, seconds=round(seconds, 6)
+        )
+
+    # -- readers -----------------------------------------------------------
+    #
+    # Readers run on the API-server thread while the driver thread
+    # appends; copies retry on the (rare) mutated-during-iteration
+    # race instead of locking the hot-path writers.
+
+    @staticmethod
+    def _copied(fn, default):
+        for _ in range(4):
+            try:
+                return fn()
+            except RuntimeError:
+                continue
+        return default
+
+    def epoch_close_percentiles(
+        self,
+    ) -> Optional[Tuple[float, float, int]]:
+        """``(p50_seconds, p99_seconds, n)`` over the recent closes, or
+        None before the first recorded close."""
+        xs = sorted(self._copied(lambda: list(self._close_s), []))
+        if not xs:
+            return None
+        n = len(xs)
+        return xs[n // 2], xs[min(n - 1, int(n * 0.99))], n
+
+    def tail(self, n: int = _TAIL) -> list:
+        events = self._copied(lambda: list(self._ring), [])
+        return [
+            {"t": round(t, 6), "kind": kind, **attrs}
+            for t, kind, attrs in events[-n:]
+        ]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Full local view for ``GET /status``."""
+        out: Dict[str, Any] = {
+            "enabled": self.active,
+            "counters": self._copied(lambda: dict(self.counters), {}),
+            "tail": self.tail(),
+        }
+        pct = self.epoch_close_percentiles()
+        if pct is not None:
+            p50, p99, n = pct
+            out["epoch_close_ms"] = {
+                "p50": round(p50 * 1e3, 3),
+                "p99": round(p99 * 1e3, 3),
+                "count": n,
+            }
+        return out
+
+    def summary(self, epoch: int) -> Dict[str, Any]:
+        """Compact per-process summary for the epoch-close gsync
+        piggyback — counters and close percentiles only (control-plane
+        sized: no ring events)."""
+        out: Dict[str, Any] = {
+            "epoch": epoch,
+            "counters": self._copied(lambda: dict(self.counters), {}),
+        }
+        pct = self.epoch_close_percentiles()
+        if pct is not None:
+            p50, p99, n = pct
+            out["epoch_close_ms"] = {
+                "p50": round(p50 * 1e3, 3),
+                "p99": round(p99 * 1e3, 3),
+                "count": n,
+            }
+        return out
+
+
+RECORDER = FlightRecorder()
+
+# Cached Prometheus label children (one labels() resolution per
+# distinct label set, not per event).
+_transfer_children: Dict[str, Any] = {}
+_comm_children: Dict[Tuple[str, str, int], Any] = {}
+_lock = threading.Lock()
+
+
+def note_transfer(direction: str, nbytes: int) -> None:
+    """One host↔device transfer of ``nbytes`` (direction ``h2d`` or
+    ``d2h``)."""
+    child = _transfer_children.get(direction)
+    if child is None:
+        from bytewax_tpu._metrics import device_transfer_bytes
+
+        with _lock:
+            child = _transfer_children.setdefault(
+                direction, device_transfer_bytes.labels(direction)
+            )
+    child.inc(nbytes)
+    RECORDER.count(f"device_transfer_bytes_{direction}", nbytes)
+    RECORDER.record("transfer", direction=direction, bytes=int(nbytes))
+
+
+def note_comm(direction: str, peer: int, nbytes: int) -> None:
+    """One cluster-mesh frame to/from ``peer`` (direction ``tx`` or
+    ``rx``); counters only — frames are too hot for ring events."""
+    key = ("frames", direction, peer)
+    frames = _comm_children.get(key)
+    if frames is None:
+        from bytewax_tpu._metrics import comm_bytes, comm_frames
+
+        with _lock:
+            frames = _comm_children.setdefault(
+                key, comm_frames.labels(str(peer), direction)
+            )
+            _comm_children.setdefault(
+                ("bytes", direction, peer),
+                comm_bytes.labels(str(peer), direction),
+            )
+    frames.inc()
+    _comm_children[("bytes", direction, peer)].inc(nbytes)
+    RECORDER.count(f"comm_frames_{direction}")
+    RECORDER.count(f"comm_bytes_{direction}", nbytes)
+
+
+def note_gsync(tag: Any, seconds: float) -> None:
+    """One completed global_sync round (blocked ``seconds``)."""
+    from bytewax_tpu._metrics import gsync_round_count
+
+    gsync_round_count.inc()
+    RECORDER.count("gsync_round_count")
+    RECORDER.count("gsync_wait_seconds", seconds)
+    RECORDER.record(
+        "gsync", tag=str(tag), seconds=round(seconds, 6)
+    )
+
+
+def note_barrier(seconds: float) -> None:
+    """Epoch barrier resolved: time from entering the hold to the
+    close broadcast taking effect."""
+    from bytewax_tpu._metrics import barrier_wait_seconds
+
+    barrier_wait_seconds.observe(seconds)
+    RECORDER.count("barrier_count")
+    RECORDER.count("barrier_wait_seconds", seconds)
+    RECORDER.record("barrier_exit", seconds=round(seconds, 6))
+
+
+_compile_listener_on = False
+
+
+def ensure_compile_listener() -> None:
+    """Register a ``jax.monitoring`` listener (once per process) that
+    counts backend compiles and their seconds.  Safe to call before
+    any backend is up — ``jax.monitoring`` imports without
+    initializing devices — and a jax without the monitoring API just
+    leaves the compile families at zero."""
+    global _compile_listener_on
+    if _compile_listener_on:
+        return
+    try:
+        from jax import monitoring
+    except ImportError:  # pragma: no cover - jax is a hard dep here
+        return
+
+    from bytewax_tpu._metrics import xla_compile_count, xla_compile_seconds
+
+    def _on_duration(name: str, secs: float, **_kw: Any) -> None:
+        if not name.endswith("backend_compile_duration"):
+            return
+        xla_compile_count.inc()
+        xla_compile_seconds.inc(secs)
+        RECORDER.count("xla_compile_count")
+        RECORDER.count("xla_compile_seconds", secs)
+        RECORDER.record("xla_compile", seconds=round(secs, 6))
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _compile_listener_on = True

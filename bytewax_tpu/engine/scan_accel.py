@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from bytewax_tpu.engine import flight as _flight
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
 from bytewax_tpu.engine.xla import NonNumericValues
 from bytewax_tpu.ops.scan import ScanKind
@@ -260,21 +261,28 @@ class DeviceScanState(ScanUpdates):
         vals_p = np.zeros(padded, dtype=np.float32)
         vals_p[:n] = values
         self._ensure_fields()
+        _flight.note_transfer("h2d", slots_p.nbytes + vals_p.nbytes)
         outs, self._fields = self.kind.run(
             self._fields,
             jax.device_put(slots_p),
             jax.device_put(vals_p),
         )
-        return self.kind.post(tuple(np.asarray(o)[:n] for o in outs))
+        host_outs = tuple(np.asarray(o) for o in outs)
+        _flight.note_transfer("d2h", sum(o.nbytes for o in host_outs))
+        return self.kind.post(tuple(o[:n] for o in host_outs))
 
     _dispatch = scan_rows
 
     # -- recovery ----------------------------------------------------------
 
     def _fetch(self) -> Dict[str, np.ndarray]:
-        return {
+        host = {
             name: np.asarray(arr) for name, arr in self._fields.items()
         }
+        _flight.note_transfer(
+            "d2h", sum(a.nbytes for a in host.values())
+        )
+        return host
 
     def load(self, key: str, state: Any) -> None:
         self.load_many([(key, state)])

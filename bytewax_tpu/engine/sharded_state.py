@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from bytewax_tpu.engine import flight as _flight
 from bytewax_tpu.engine.arrays import ArrayBatch, KeyEncoder, VocabMap
 from bytewax_tpu.engine.scan_accel import ScanUpdates
 from bytewax_tpu.engine.xla import (
@@ -114,8 +115,12 @@ def make_agg_state(kind: str, driver=None):
         try:
             import jax
 
+            from bytewax_tpu.parallel.mesh import (
+                distributed_is_initialized,
+            )
+
             eligible = (
-                jax.distributed.is_initialized()
+                distributed_is_initialized()
                 and jax.process_count() == driver.proc_count
                 and jax.process_count() > 1
             )
@@ -436,6 +441,9 @@ class ShardedAggState(_ShardedSlots):
         )
         capacity = _pow2(int(pair_counts.max()), 4)
 
+        _flight.note_transfer(
+            "h2d", kids_p.nbytes + vals_p.nbytes + valid_p.nbytes
+        )
         step = self._step_for(total, capacity)
         self._fields = step(
             self._fields,
@@ -635,6 +643,7 @@ class ShardedAggState(_ShardedSlots):
         stacked = np.asarray(
             jnp.stack([self._fields[name] for name in names])
         )
+        _flight.note_transfer("d2h", stacked.nbytes)
         return {name: stacked[i] for i, name in enumerate(names)}
 
     def snapshots_for(self, keys: List[str]) -> List[Tuple[str, Any]]:
@@ -1216,6 +1225,15 @@ class GlobalAggState:
         )
         capacity = _pow2(max(cap_replies.values()), 4)
 
+        _flight.note_transfer(
+            "h2d", kids_p.nbytes + vals_p.nbytes + valid_p.nbytes
+        )
+        _flight.RECORDER.record(
+            "global_flush",
+            rows=n_local,
+            total_rows=total_rows,
+            steps=n_steps,
+        )
         step = self._step_for(chunk_pd, capacity)
         global_rows = chunk_pd * self.n_shards
 

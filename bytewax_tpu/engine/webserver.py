@@ -4,27 +4,43 @@ Reference parity (``/root/reference/src/webserver/mod.rs``): when
 ``BYTEWAX_DATAFLOW_API_ENABLED`` is set, the engine serves
 
 - ``GET /dataflow`` — the graph rendered as JSON (also dumped to
-  ``dataflow.json`` on startup, like the reference), and
+  ``dataflow.json`` on startup, like the reference),
 - ``GET /metrics`` — Prometheus text exposition (engine + user
   metrics share one Python registry here, so no merge step is
-  needed).
+  needed), and
+- ``GET /status`` — a live JSON snapshot of the engine (current
+  epoch, per-step queue depths, the flight-recorder tail, and — in
+  clustered runs — the per-process summaries collected by the
+  epoch-close gsync piggyback, so any process's ``/status`` shows the
+  whole cluster).
 
-Port comes from ``BYTEWAX_DATAFLOW_API_PORT`` (default 3030).
+Bind host comes from ``BYTEWAX_DATAFLOW_API_HOST`` (default
+``127.0.0.1`` — the status plane is operational introspection, not a
+public surface; opt into ``0.0.0.0`` explicitly).  Port comes from
+``BYTEWAX_DATAFLOW_API_PORT`` (default 3030), offset by the process's
+rank among cluster processes sharing its host, so co-located
+processes (localhost testing) don't collide while one-process-per-
+host deployments keep the configured port on every pod.
 """
 
 import json
+import logging
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 
 __all__ = ["maybe_start_server"]
 
+logger = logging.getLogger("bytewax_tpu")
+
 _DEFAULT_PORT = 3030
+_DEFAULT_HOST = "127.0.0.1"
 
 
 class _Handler(BaseHTTPRequestHandler):
     flow_json: str = "{}"
+    status_fn: Optional[Callable[[], dict]] = None
 
     def do_GET(self) -> None:  # noqa: N802
         if self.path == "/dataflow":
@@ -35,6 +51,14 @@ class _Handler(BaseHTTPRequestHandler):
 
             body = generate_python_metrics().encode()
             ctype = "text/plain; version=0.0.4"
+        elif self.path == "/status":
+            fn = type(self).status_fn
+            try:
+                status = fn() if fn is not None else {}
+            except Exception as ex:  # noqa: BLE001 - never 500 the plane
+                status = {"error": str(ex)}
+            body = json.dumps(status).encode()
+            ctype = "application/json"
         else:
             self.send_response(404)
             self.end_headers()
@@ -60,25 +84,67 @@ class _ApiServer:
         self._server.server_close()
 
 
-def maybe_start_server(flow) -> Optional[_ApiServer]:
+def maybe_start_server(
+    flow,
+    status_fn: Optional[Callable[[], dict]] = None,
+    port_offset: int = 0,
+) -> Optional[_ApiServer]:
     """Start the API server if ``BYTEWAX_DATAFLOW_API_ENABLED`` is
-    set; returns a handle to shut it down, else ``None``."""
-    if not os.environ.get("BYTEWAX_DATAFLOW_API_ENABLED"):
+    set (to anything but ``0``); returns a handle to shut it down,
+    else ``None``.
+
+    ``status_fn`` is a zero-arg callable (supplied by the engine
+    driver) returning the live ``/status`` document; ``port_offset``
+    is this process's rank among co-located cluster processes."""
+    from bytewax_tpu.engine.flight import _truthy
+
+    if not _truthy("BYTEWAX_DATAFLOW_API_ENABLED"):
         return None
     from bytewax_tpu.visualize import to_json
 
     flow_json = to_json(flow)
     # Reference also dumps the graph to disk at startup
-    # (src/run.rs:36-57).
+    # (src/run.rs:36-57).  Dump failures must be visible: a read-only
+    # CWD silently losing the graph is a debugging dead end.
+    dump_path = os.path.abspath("dataflow.json")
     try:
-        with open("dataflow.json", "w") as f:
+        with open(dump_path, "w") as f:
             f.write(flow_json)
-    except OSError:
-        pass
+    except OSError as ex:
+        logger.warning(
+            "could not dump dataflow graph to %s (errno %s: %s); "
+            "GET /dataflow still serves it",
+            dump_path,
+            ex.errno,
+            ex.strerror or ex,
+        )
 
-    port = int(os.environ.get("BYTEWAX_DATAFLOW_API_PORT", _DEFAULT_PORT))
-    handler = type("_BoundHandler", (_Handler,), {"flow_json": flow_json})
-    server = ThreadingHTTPServer(("0.0.0.0", port), handler)
+    host = os.environ.get("BYTEWAX_DATAFLOW_API_HOST", _DEFAULT_HOST)
+    port = (
+        int(os.environ.get("BYTEWAX_DATAFLOW_API_PORT", _DEFAULT_PORT))
+        + port_offset
+    )
+    handler = type(
+        "_BoundHandler",
+        (_Handler,),
+        {"flow_json": flow_json, "status_fn": staticmethod(status_fn)},
+    )
+    try:
+        server = ThreadingHTTPServer((host, port), handler)
+    except OSError as ex:
+        # An observability server must never take down the data
+        # plane: a taken port (another process, co-located ranks with
+        # mixed host spellings in the address list) degrades to
+        # metrics-less running, loudly.
+        logger.warning(
+            "could not bind dataflow API server on %s:%d (errno %s: "
+            "%s); continuing without /dataflow, /metrics, /status",
+            host,
+            port,
+            ex.errno,
+            ex.strerror or ex,
+        )
+        return None
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return _ApiServer(server, thread)

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from bytewax_tpu.engine import flight as _flight
 from bytewax_tpu.engine.arrays import KeyEncoder, VocabMap
 
 __all__ = ["DeviceWindowAggState", "WindowAccelSpec"]
@@ -505,6 +506,10 @@ class DeviceWindowAggState:
                 )
                 self._open_cache = None
         if len(comp):
+            _flight.RECORDER.count("window_rows_ingested", len(val_rep))
+            _flight.RECORDER.record(
+                "device_dispatch", tier="window", rows=len(val_rep)
+            )
             self.agg.update_ids(slot_of_uniq[inverse], val_rep)
 
     def _open_arrays(self):
@@ -843,6 +848,10 @@ class DeviceSessionAggState(DeviceWindowAggState):
                 self._slot_seq += 1
                 slots.append(slot_key)
             slot_of_run[r] = self.agg.alloc(slot_key)
+        _flight.RECORDER.count("window_rows_ingested", len(v))
+        _flight.RECORDER.record(
+            "device_dispatch", tier="session", rows=len(v)
+        )
         self.agg.update_ids(slot_of_run[run_of_row], v)
 
     def _combine(self, snaps: List[Any]) -> Any:

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bytewax_tpu.engine import flight as _flight
 from bytewax_tpu.engine.arrays import ArrayBatch, KeyEncoder, VocabMap
 from bytewax_tpu.ops.segment import (
     AGG_KINDS,
@@ -354,6 +355,7 @@ class DeviceAggState:
         slots_p[:n] = slot_ids
         vals_p = np.zeros(padded, dtype=np.dtype(self.dtype))
         vals_p[:n] = values
+        _flight.note_transfer("h2d", slots_p.nbytes + vals_p.nbytes)
         from bytewax_tpu.ops.pallas_fold import maybe_update_fields
 
         self._fields = maybe_update_fields(
@@ -370,6 +372,7 @@ class DeviceAggState:
         stacked = np.asarray(
             jnp.stack([self._fields[name] for name in names])
         )
+        _flight.note_transfer("d2h", stacked.nbytes)
         return {name: stacked[i] for i, name in enumerate(names)}
 
     def _sync_vocab(self, ids: np.ndarray, vocab: np.ndarray) -> np.ndarray:
@@ -391,6 +394,7 @@ class DeviceAggState:
             table = np.where(table < 0, self.capacity - 1, table).astype(
                 np.int32
             )
+            _flight.note_transfer("h2d", table.nbytes)
             self._dev_map = jax.device_put(table)
         return uniq
 
@@ -426,6 +430,7 @@ class DeviceAggState:
                 packed[0, :n] = ids
                 packed[1, :n] = values
                 packed[1, n:] = 0
+                _flight.note_transfer("h2d", packed.nbytes)
                 self._fields = update_fields_packed(
                     self.kind,
                     self._fields,
@@ -439,6 +444,7 @@ class DeviceAggState:
                 ids_p[:n] = ids
                 vals_p = np.zeros(padded, dtype=np.dtype(self.dtype))
                 vals_p[:n] = values
+                _flight.note_transfer("h2d", ids_p.nbytes + vals_p.nbytes)
                 self._fields = update_fields_vocab(
                     self.kind,
                     self._fields,
@@ -527,6 +533,10 @@ class DeviceAggState:
                 cols[name][i] = fv[name]
         self._grow_to(len(self.slot_keys) + 1)
         self._ensure_fields()
+        _flight.note_transfer(
+            "h2d",
+            slots.nbytes + sum(c.nbytes for c in cols.values()),
+        )
         dev_slots = jax.device_put(slots)
         for name in names:
             self._fields[name] = (

@@ -70,8 +70,18 @@ def note_context(
     caller pass 2.
     """
     add_note = getattr(ex, "add_note", None)
-    if add_note is None:  # pragma: no cover - pre-3.11
-        return
+    if add_note is None:
+        # Pre-3.11: emulate PEP 678.  ``__notes__`` is just a list of
+        # str the 3.11+ traceback printer reads; maintaining it by
+        # hand keeps the context inspectable (and our tests passing)
+        # on older interpreters, even if 3.10's printer won't render
+        # it in tracebacks.
+        def add_note(note: str, _ex: BaseException = ex) -> None:
+            notes = getattr(_ex, "__notes__", None)
+            if notes is None:
+                notes = []
+                _ex.__notes__ = notes
+            notes.append(note)
     try:
         frame = sys._getframe(_depth)
         loc = f" (engine at {frame.f_code.co_filename}:{frame.f_lineno})"

@@ -20,7 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bytewax_tpu.ops.segment import AGG_KINDS, AggKind, identity_for
 from bytewax_tpu.parallel.exchange import bucket_by_shard
-from bytewax_tpu.parallel.mesh import SHARD_AXIS
+from bytewax_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
 __all__ = [
     "init_sharded_fields",
@@ -138,7 +138,7 @@ def make_sharded_step(
         return out
 
     field_specs = {name: P(SHARD_AXIS) for name in kind.fields}
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(field_specs, P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
@@ -274,7 +274,7 @@ def make_sharded_scan_step(
         return tuple(outs_local), new_fields
 
     field_specs = {name: P(SHARD_AXIS) for name in scan_kind.fields}
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(field_specs, P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from bytewax_tpu.parallel.mesh import SHARD_AXIS
+from bytewax_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
 __all__ = ["bucket_by_shard", "keyed_all_to_all"]
 
@@ -122,7 +122,7 @@ def keyed_all_to_all(
             dropped_total,
         )
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),

@@ -1,0 +1,78 @@
+"""Static enforcement of the cluster comm contract (CLAUDE.md): all
+data sends ride ``ship_deliver``/``ship_route`` and all control-plane
+sync rides ``global_sync`` — no module outside ``engine/comm.py`` and
+``engine/driver.py`` may touch the raw send primitives, or the epoch
+barrier's count-matched quiescence check silently breaks."""
+
+import re
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "bytewax_tpu"
+
+#: Files allowed to use each primitive.  ``Comm`` construction and the
+#: raw ``send``/``broadcast`` calls belong to the driver/comm pair
+#: only; the driver's routed surfaces (``ship_deliver``/``ship_route``)
+#: are likewise driver-internal; ``global_sync``/``next_gsync_tag`` is
+#: the one sanctioned control-plane surface for collective tiers
+#: (today: the global-mesh exchange in ``engine/sharded_state.py``).
+_ALLOWED = {
+    "comm_construct": {"engine/comm.py", "engine/driver.py"},
+    "raw_send": {"engine/comm.py", "engine/driver.py"},
+    "ship": {"engine/driver.py"},
+    "gsync": {"engine/driver.py", "engine/sharded_state.py"},
+}
+
+_PATTERNS = {
+    "comm_construct": re.compile(r"\bComm\s*\("),
+    "raw_send": re.compile(r"\.\s*(?:comm\.)?(?:send|broadcast)\s*\("),
+    "ship": re.compile(r"\bship_(?:deliver|route)\s*\("),
+    "gsync": re.compile(r"\b(?:global_sync|next_gsync_tag)\s*\("),
+}
+
+#: Raw-send shapes that are not the cluster mesh: sockets and HTTP
+#: servers have their own ``send``-ish methods.  Only flag calls that
+#: mention ``comm`` on the receiver or a bare broadcast.
+_RAW_SEND_STRICT = re.compile(
+    r"(?:\bcomm\s*\.\s*(?:send|broadcast)\s*\()"
+    r"|(?:self\s*\.\s*comm\s*\.\s*(?:send|broadcast)\s*\()"
+)
+
+
+def _strip_comments(text: str) -> str:
+    return "\n".join(
+        line.split("#", 1)[0] for line in text.splitlines()
+    )
+
+
+def test_no_raw_sends_outside_comm_and_driver():
+    violations = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        text = _strip_comments(path.read_text())
+        for lineno, line in enumerate(text.splitlines(), 1):
+            for kind, pat in _PATTERNS.items():
+                matcher = (
+                    _RAW_SEND_STRICT if kind == "raw_send" else pat
+                )
+                if not matcher.search(line):
+                    continue
+                if rel not in _ALLOWED[kind]:
+                    violations.append(
+                        f"{rel}:{lineno}: {kind} ({line.strip()[:80]!r})"
+                    )
+    assert not violations, (
+        "raw cluster-send primitives used outside the sanctioned "
+        "modules (route data through ship_deliver/ship_route and "
+        "control metadata through driver.global_sync):\n"
+        + "\n".join(violations)
+    )
+
+
+def test_allowlist_is_not_stale():
+    # The contract check above is only meaningful while its allowed
+    # call sites actually exist; fail loudly if a refactor moves them.
+    driver = (PKG / "engine" / "driver.py").read_text()
+    assert "def ship_deliver" in driver and "def ship_route" in driver
+    assert "def global_sync" in driver
+    sharded = (PKG / "engine" / "sharded_state.py").read_text()
+    assert "global_sync(" in sharded

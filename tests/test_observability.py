@@ -1,6 +1,11 @@
-"""Metrics, webserver, tracing tests (model: SURVEY.md §5.5)."""
+"""Metrics, webserver, tracing, flight-recorder tests (model:
+SURVEY.md §5.5)."""
 
 import json
+import os
+import subprocess
+import sys
+import time
 import urllib.request
 
 import bytewax_tpu.operators as op
@@ -63,6 +68,352 @@ def test_dataflow_api_server(monkeypatch, tmp_path):
     assert "bytewax_item_inp_count" in captured["metrics"]
     # Graph also dumped to disk at startup.
     assert (tmp_path / "dataflow.json").exists()
+
+
+def _windowed_accel_flow(n_rows=200):
+    """A columnar event-time count_window flow that exercises the
+    accelerated window step (device scatter-combine + transfers)."""
+    from datetime import datetime, timedelta, timezone
+
+    import numpy as np
+
+    import bytewax_tpu.operators.windowing as w
+    from bytewax_tpu.engine.arrays import ArrayBatch
+    from bytewax_tpu.models.brc import ArrayBatchSource
+    from bytewax_tpu.operators.windowing import EventClock, TumblingWindower
+
+    align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    base = np.datetime64(align.replace(tzinfo=None), "us")
+    batches = [
+        ArrayBatch(
+            {
+                "key_id": (np.arange(n_rows) % 2).astype(np.int32),
+                "ts": base + (np.arange(n_rows) // 10).astype(
+                    "timedelta64[s]"
+                ),
+            },
+            key_vocab=np.array(["0", "1"]),
+        )
+    ]
+    clock = EventClock(
+        ts_getter=lambda x: x, wait_for_system_duration=timedelta(0)
+    )
+    windower = TumblingWindower(
+        align_to=align, length=timedelta(seconds=10)
+    )
+    out = []
+    flow = Dataflow("flight_df")
+    s = op.input("in", flow, ArrayBatchSource(batches))
+    wo = w.count_window("count", s, clock, windower, key=lambda x: x)
+    op.output("out", wo.down, TestingSink(out))
+    return flow, out
+
+
+def test_flight_recorder_metric_families(monkeypatch):
+    # The six new engine families appear in /metrics exposition, and
+    # the ones a single-process accelerated-window run can exercise
+    # have nonzero samples (gsync/barrier/comm need a cluster; their
+    # families must still be present).
+    from datetime import timedelta
+
+    from prometheus_client import REGISTRY
+
+    from bytewax_tpu._metrics import generate_python_metrics
+    from bytewax_tpu.engine import flight
+
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
+    flow, out = _windowed_accel_flow()
+    run_main(flow, epoch_interval=timedelta(0))
+    assert out  # windows closed on device
+
+    text = generate_python_metrics()
+    for family in (
+        "bytewax_epoch_close_duration_seconds",
+        "bytewax_barrier_wait_seconds",
+        "bytewax_gsync_round_count",
+        "bytewax_xla_compile_count",
+        "bytewax_xla_compile_seconds",
+        "bytewax_device_transfer_bytes",
+        "bytewax_comm_frames",
+    ):
+        assert family in text, f"{family} missing from exposition"
+
+    assert (
+        REGISTRY.get_sample_value("bytewax_epoch_close_duration_seconds_count")
+        >= 1
+    )
+    assert (
+        REGISTRY.get_sample_value(
+            "bytewax_device_transfer_bytes_total", {"direction": "h2d"}
+        )
+        > 0
+    )
+    assert (
+        REGISTRY.get_sample_value(
+            "bytewax_device_transfer_bytes_total", {"direction": "d2h"}
+        )
+        > 0
+    )
+    # The jax.monitoring listener counts compiles process-wide; at
+    # least the device window fold compiled at some point.
+    assert (
+        REGISTRY.get_sample_value("bytewax_xla_compile_count_total") >= 1
+    )
+    # Ring + percentile buffer recorded (enabled via env).
+    rec = flight.RECORDER
+    assert rec.counters.get("epoch_close_count", 0) >= 1
+    assert rec.epoch_close_percentiles() is not None
+    kinds = {e["kind"] for e in rec.tail()}
+    assert "epoch_close" in kinds
+    assert "device_dispatch" in kinds
+
+
+def test_status_endpoint(entry_point, monkeypatch, tmp_path):
+    # GET /status returns a valid JSON engine snapshot under all 3
+    # entry points.
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_ENABLED", "1")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_PORT", "13033")
+    monkeypatch.chdir(tmp_path)
+
+    captured = {}
+
+    class _ProbeSinkPartition:
+        def write_batch(self, items):
+            if "status" not in captured:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:13033/status", timeout=5
+                ) as resp:
+                    captured["status"] = json.loads(resp.read())
+
+        def close(self):
+            pass
+
+    from bytewax_tpu.outputs import DynamicSink
+
+    class _ProbeSink(DynamicSink):
+        def build(self, step_id, worker_index, worker_count):
+            return _ProbeSinkPartition()
+
+    flow = Dataflow("status_df")
+    s = op.input("inp", flow, TestingSource([1, 2, 3]))
+    op.output("out", s, _ProbeSink())
+    entry_point(flow)
+
+    status = captured["status"]
+    assert status["flow_id"] == "status_df"
+    assert status["proc_id"] == 0
+    assert isinstance(status["epoch"], int)
+    assert "status_df.out" in status["queue_depths"]
+    assert status["recorder"]["enabled"] is True
+    assert isinstance(status["recorder"]["counters"], dict)
+    assert isinstance(status["cluster"], dict)
+
+
+def test_status_cluster_gsync_piggyback(tmp_path):
+    # In a real 2-process cluster, each process's compact telemetry
+    # summary rides a gsync round at epoch close; process 0's /status
+    # then shows both processes.
+    flow_py = tmp_path / "status_flow.py"
+    flow_py.write_text(
+        """
+import time
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+from bytewax_tpu.outputs import DynamicSink, StatelessSinkPartition
+
+
+class _Tick(StatelessSourcePartition):
+    def __init__(self):
+        self._i = 0
+
+    def next_batch(self):
+        if self._i >= 40:
+            raise StopIteration()
+        self._i += 1
+        time.sleep(0.1)
+        return [("k", 1)]
+
+
+class TickSource(DynamicSource):
+    def build(self, step_id, worker_index, worker_count):
+        return _Tick()
+
+
+class _Null(StatelessSinkPartition):
+    def write_batch(self, items):
+        pass
+
+
+class NullSink(DynamicSink):
+    def build(self, step_id, worker_index, worker_count):
+        return _Null()
+
+
+flow = Dataflow("status_cluster_df")
+s = op.input("inp", flow, TickSource())
+op.output("out", s, NullSink())
+"""
+    )
+    import socket
+
+    # Allocate two mesh ports up front (bind-then-close; the window
+    # is tiny in an isolated test and avoids the SO_REUSEPORT holder
+    # machinery of `python -m bytewax_tpu.testing`).
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    addresses = ";".join(f"127.0.0.1:{p}" for p in ports)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["BYTEWAX_TPU_PLATFORM"] = "cpu"
+    env["BYTEWAX_TPU_ACCEL"] = "0"
+    env["BYTEWAX_DATAFLOW_API_ENABLED"] = "1"
+    env["BYTEWAX_DATAFLOW_API_PORT"] = "13045"
+    env["BYTEWAX_ADDRESSES"] = addresses
+    # A loaded CI box can take >30s just to start both interpreters;
+    # don't let the mesh handshake give up before they're up.
+    env["BYTEWAX_TPU_DIAL_TIMEOUT_S"] = "120"
+    procs = []
+    for proc_id in range(2):
+        penv = dict(env)
+        penv["BYTEWAX_PROCESS_ID"] = str(proc_id)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "bytewax_tpu.run",
+                    f"{flow_py}:flow",
+                    "-s",
+                    "0.3",
+                ],
+                env=penv,
+                cwd=tmp_path,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    status = None
+    try:
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:13045/status", timeout=2
+                ) as resp:
+                    got = json.loads(resp.read())
+            except OSError:
+                time.sleep(0.2)
+                continue
+            cluster = got.get("cluster", {})
+            # The summary is snapshotted before its own sync round
+            # completes, so wait for a close where every process has
+            # already finished at least one earlier gsync round.
+            if len(cluster) == 2 and all(
+                s["counters"].get("gsync_round_count", 0) >= 1
+                for s in cluster.values()
+            ):
+                status = got
+                break
+            time.sleep(0.2)
+    finally:
+        errs = []
+        for proc in procs:
+            try:
+                _out, err = proc.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                _out, err = proc.communicate()
+            errs.append(err)
+    for proc, err in zip(procs, errs):
+        assert proc.returncode == 0, err[-2000:].decode(errors="replace")
+    assert status is not None, "cluster summary never reached proc 0"
+    assert set(status["cluster"]) == {"0", "1"}
+    for pid in ("0", "1"):
+        summary = status["cluster"][pid]
+        assert isinstance(summary["epoch"], int)
+        # The piggyback itself runs over gsync: every process must
+        # have completed at least one round.
+        assert summary["counters"]["gsync_round_count"] >= 1
+    # Mesh traffic was metered per peer on proc 0.
+    assert status["recorder"]["counters"]["comm_frames_tx"] >= 1
+    assert status["recorder"]["counters"]["comm_frames_rx"] >= 1
+
+
+def test_status_cluster_divergent_env_does_not_hang(tmp_path):
+    # Only process 0 enables the API server: the startup agreement
+    # round must disable the telemetry piggyback cluster-wide (not
+    # leave proc 0 blocking in a sync round its peer never enters).
+    import socket
+
+    flow_py = tmp_path / "div_flow.py"
+    flow_py.write_text(
+        """
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.testing import TestingSink, TestingSource
+
+flow = Dataflow("div_df")
+s = op.input("inp", flow, TestingSource(list(range(20))))
+op.output("out", s, TestingSink([]))
+"""
+    )
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    base = dict(os.environ)
+    base["PYTHONPATH"] = "/root/repo" + os.pathsep + base.get("PYTHONPATH", "")
+    base["BYTEWAX_TPU_PLATFORM"] = "cpu"
+    base["BYTEWAX_TPU_ACCEL"] = "0"
+    base["BYTEWAX_ADDRESSES"] = ";".join(
+        f"127.0.0.1:{p}" for p in ports
+    )
+    base["BYTEWAX_TPU_DIAL_TIMEOUT_S"] = "120"
+    base.pop("BYTEWAX_DATAFLOW_API_ENABLED", None)
+    base.pop("BYTEWAX_FLIGHT_RECORDER", None)
+    procs = []
+    for proc_id in range(2):
+        penv = dict(base)
+        penv["BYTEWAX_PROCESS_ID"] = str(proc_id)
+        if proc_id == 0:
+            penv["BYTEWAX_DATAFLOW_API_ENABLED"] = "1"
+            penv["BYTEWAX_DATAFLOW_API_PORT"] = "13047"
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "bytewax_tpu.run",
+                    f"{flow_py}:flow",
+                    "-s",
+                    "0.2",
+                ],
+                env=penv,
+                cwd=tmp_path,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    for proc in procs:
+        try:
+            _out, err = proc.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            _out, err = proc.communicate()
+            raise AssertionError(
+                "cluster hung with divergent telemetry env: "
+                + err[-2000:].decode(errors="replace")
+            )
+        assert proc.returncode == 0, err[-2000:].decode(errors="replace")
 
 
 def test_setup_tracing_local():
