@@ -614,6 +614,84 @@ def _device_step_ms(n_rows: int = 1 << 20, reps: int = 5):
     return single_ms, sharded_ms
 
 
+# -- supervised restart recovery latency -------------------------------------
+
+
+def _run_restart_recovery():
+    """Kill-to-first-epoch-close after resume, in seconds.
+
+    A supervised single-process flow takes an injected crash at the
+    snapshot-commit point (the torn-epoch window) mid-run; the
+    supervisor restarts it from the last committed epoch.  Reported is
+    the wall time from the crash to the first epoch close of the
+    resumed execution — the end-to-end recovery latency a production
+    fault would pay (driver teardown + resume math + state reload +
+    first close), tracked round over round like ``epoch_close_p99``.
+    """
+    import tempfile
+    from datetime import timedelta
+
+    import bytewax_tpu.operators as op
+    from bytewax_tpu import xla
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine import faults, flight
+    from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+    from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+    env_keys = (
+        "BYTEWAX_TPU_FAULTS",
+        "BYTEWAX_TPU_MAX_RESTARTS",
+        "BYTEWAX_TPU_RESTART_BACKOFF_S",
+        "BYTEWAX_FLIGHT_RECORDER",
+    )
+    saved = {k: os.environ.get(k) for k in env_keys}
+    os.environ["BYTEWAX_TPU_FAULTS"] = "snapshot.commit:crash:40:x1"
+    os.environ["BYTEWAX_TPU_MAX_RESTARTS"] = "1"
+    os.environ["BYTEWAX_TPU_RESTART_BACKOFF_S"] = "0"
+    # The driver re-activates the ring from the env at run start; the
+    # measurement needs the restart + epoch-close events.
+    os.environ["BYTEWAX_FLIGHT_RECORDER"] = "1"
+    # A private, larger ring so the whole run's event stream (one
+    # epoch per loop at interval 0) survives for the measurement and
+    # the main recorder's close-percentile buffer stays untouched.
+    main_rec = flight.RECORDER
+    flight.RECORDER = flight.FlightRecorder(1 << 15)
+    flight.RECORDER.activate(True)
+    faults.reset()
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            init_db_dir(td, 1)
+            inp = [(f"k{i % 8}", float(i)) for i in range(2000)]
+            out = []
+            flow = Dataflow("restart_bench_df")
+            s = op.input("inp", flow, TestingSource(inp, batch_size=16))
+            r = op.reduce_final("sum", s, xla.SUM)
+            op.output("out", r, TestingSink(out))
+            run_main(
+                flow,
+                epoch_interval=timedelta(0),
+                recovery_config=RecoveryConfig(td),
+            )
+        events = flight.RECORDER.tail(1 << 15)
+        restart_t = next(
+            e["t"] for e in events if e["kind"] == "restart"
+        )
+        first_close_t = next(
+            e["t"]
+            for e in events
+            if e["kind"] == "epoch_close" and e["t"] >= restart_t
+        )
+        return first_close_t - restart_t
+    finally:
+        flight.RECORDER = main_rec
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        faults.reset()
+
+
 def _note_regressions(extra: dict, headline: float) -> None:
     """Compare throughput metrics against the newest committed
     ``BENCH_r*.json`` and record any that dropped >10% — a
@@ -785,6 +863,12 @@ def main() -> None:
         extra["epoch_close_p50_ms"] = round(p50_s * 1e3, 3)
         extra["epoch_close_p99_ms"] = round(p99_s_close * 1e3, 3)
         extra["epoch_closes_recorded"] = n_closes_rec
+
+    try:
+        extra["restart_recovery_s"] = round(_run_restart_recovery(), 3)
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["restart_recovery_s"] = None
+        extra["restart_recovery_error"] = str(ex)[:200]
 
     extra["backend"] = backend
     _note_regressions(extra, xla_rate)

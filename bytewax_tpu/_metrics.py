@@ -19,13 +19,17 @@ __all__ = [
     "DURATION_HISTOGRAMS",
     "barrier_wait_seconds",
     "comm_bytes",
+    "comm_fenced_frames",
     "comm_frames",
     "device_transfer_bytes",
     "epoch_close_duration_seconds",
+    "fault_injected_count",
     "generate_python_metrics",
     "gsync_round_count",
     "item_inp_count",
     "item_out_count",
+    "step_demotion_count",
+    "worker_restart_count",
     "xla_compile_count",
     "xla_compile_seconds",
 ]
@@ -160,6 +164,38 @@ comm_bytes = Counter(
     "bytewax_comm_bytes",
     "Cluster-mesh bytes shipped per peer (framed, pickled)",
     ["peer", "direction"],
+)
+
+
+# -- robustness / chaos families ----------------------------------------
+#
+# Fed by the fault injector (``engine/faults.py``), the comm
+# generation fence, the supervisor restart loop, and device-tier
+# demotion (``engine/driver.py``).
+
+fault_injected_count = Counter(
+    "bytewax_fault_injected_count",
+    "Faults fired by the chaos injector, per site and kind",
+    ["site", "kind"],
+)
+
+comm_fenced_frames = Counter(
+    "bytewax_comm_fenced_frames",
+    "Cluster-mesh frames discarded because they were tagged with a "
+    "dead restart generation",
+)
+
+worker_restart_count = Counter(
+    "bytewax_worker_restart_count",
+    "Supervised worker restarts after a restartable fault "
+    "(peer death, epoch stall, injected crash)",
+)
+
+step_demotion_count = Counter(
+    "bytewax_step_demotion_count",
+    "Stateful steps demoted from the device tier to the host tier "
+    "after consecutive device faults",
+    ["step_id"],
 )
 
 

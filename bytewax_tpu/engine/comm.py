@@ -20,11 +20,15 @@ import struct
 import time
 from typing import Any, List, Optional, Tuple
 
+from bytewax_tpu.engine import faults as _faults
 from bytewax_tpu.engine import flight as _flight
+from bytewax_tpu.errors import ClusterPeerDead
 
 __all__ = ["Comm"]
 
 _LEN = struct.Struct("<Q")
+#: Per-frame generation tag (see :class:`Comm` ``generation``).
+_GEN = struct.Struct("<I")
 #: Default handshake budget: how long to keep dialing/accepting peers
 #: at startup.  ``BYTEWAX_TPU_DIAL_TIMEOUT_S`` overrides (read per
 #: connection, like the other comm knobs) because a loaded host can
@@ -61,11 +65,27 @@ class Comm:
     peers bulk-sending to each other must not deadlock) but parses
     complete frames out of over-cap buffers instead of growing raw
     bytes — in-flight data per epoch is bounded by the epoch barrier.
+
+    ``generation`` is the supervised-restart generation of this
+    process (the supervisor bumps it per restart).  Every frame is
+    tagged with the sender's generation and the handshake pins each
+    peer's announced generation; a frame tagged with anything else is
+    from a dead generation and is discarded (fenced) instead of
+    delivered — belt-and-braces on top of TCP's per-connection
+    ordering, so a late frame from before a restart can never leak
+    into the resumed execution's epoch accounting.
     """
 
-    def __init__(self, addresses: List[str], proc_id: int):
+    def __init__(
+        self, addresses: List[str], proc_id: int, generation: int = 0
+    ):
         self.proc_id = proc_id
         self.proc_count = len(addresses)
+        self.generation = generation
+        #: Peer -> the generation it announced at handshake.
+        self._peer_gen: dict = {}
+        #: Frames discarded by generation fencing (observability).
+        self.fenced_frames = 0
         self._socks: dict = {}
         self._rx_buf: dict = {}
         self._paused: set = set()
@@ -85,6 +105,15 @@ class Comm:
         self._hb = float(
             os.environ.get("BYTEWAX_TPU_HEARTBEAT_S", _HB_DEFAULT_S)
         )
+        #: Liveness limit (s): a peer silent longer than this is dead.
+        #: Defaults to ``_HB_MISS`` heartbeat intervals;
+        #: ``BYTEWAX_TPU_HB_S`` overrides it directly — raise it when
+        #: long XLA compiles keep a process away from ``recv_ready``
+        #: (heartbeats are only pumped from there) so a busy-but-alive
+        #: peer is not falsely declared dead.
+        self._hb_limit = float(
+            os.environ.get("BYTEWAX_TPU_HB_S", "0") or 0.0
+        ) or self._hb * _HB_MISS
         #: Per-peer last-send instants: liveness is judged per peer,
         #: so idleness must be tracked (and heartbeats sent) per peer
         #: — chatting with one peer must not starve the others.
@@ -132,16 +161,55 @@ class Comm:
                         msg = f"could not dial cluster peer {addresses[peer]!r}"
                         raise ConnectionError(msg) from None
                     time.sleep(0.05)
-            sock.sendall(_LEN.pack(proc_id))
+            # Introduce (proc id, restart generation); the acceptor
+            # answers with its own generation, pinning what each side
+            # expects on every subsequent frame.
+            sock.sendall(_LEN.pack(proc_id) + _GEN.pack(self.generation))
+            sock.settimeout(self._handshake_budget(deadline))
+            try:
+                self._peer_gen[peer] = _GEN.unpack(
+                    self._read_exact(sock, _GEN.size)
+                )[0]
+            except (socket.timeout, TimeoutError):
+                # socket.timeout is only an alias of TimeoutError on
+                # 3.10+; catch both for 3.9.
+                raise self._handshake_timeout() from None
+            sock.settimeout(None)
             self._register(peer, sock)
         while expect_accepts > 0:
-            listener.settimeout(max(0.0, deadline - time.monotonic()))
-            sock, _addr = listener.accept()
-            raw = self._read_exact(sock, _LEN.size)
-            peer = _LEN.unpack(raw)[0]
+            listener.settimeout(self._handshake_budget(deadline))
+            try:
+                sock, _addr = listener.accept()
+                raw = self._read_exact(sock, _LEN.size + _GEN.size)
+            except (socket.timeout, TimeoutError):
+                raise self._handshake_timeout() from None
+            peer = _LEN.unpack(raw[: _LEN.size])[0]
+            self._peer_gen[peer] = _GEN.unpack(raw[_LEN.size :])[0]
+            sock.sendall(_GEN.pack(self.generation))
             self._register(peer, sock)
             expect_accepts -= 1
         listener.close()
+
+    @staticmethod
+    def _handshake_budget(deadline: float) -> float:
+        """Remaining handshake time as a socket timeout; an already
+        expired deadline raises rather than degrading to 0.0 (which
+        would mean *non-blocking* and surface as a confusing
+        BlockingIOError)."""
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise Comm._handshake_timeout()
+        return left
+
+    @staticmethod
+    def _handshake_timeout() -> ConnectionError:
+        # A ConnectionError (not a bare socket.timeout) so a staggered
+        # supervised-restart re-formation — peers re-entering the
+        # handshake at different times — stays restartable.
+        return ConnectionError(
+            "cluster handshake timed out waiting for peers "
+            "(BYTEWAX_TPU_DIAL_TIMEOUT_S)"
+        )
 
     @staticmethod
     def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -168,8 +236,12 @@ class Comm:
         """Framed send that drains incoming bytes while its own send
         buffer is full — two peers shipping large batches to each
         other must not deadlock in blocking sends."""
+        if _faults.fire("comm.send", peer=dest) == "drop":
+            return
         payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        data = memoryview(_LEN.pack(len(payload)) + payload)
+        data = memoryview(
+            _LEN.pack(len(payload)) + _GEN.pack(self.generation) + payload
+        )
         sock = self._socks[dest]
         self._last_tx[dest] = time.monotonic()
         _flight.note_comm("tx", dest, len(data))
@@ -216,13 +288,22 @@ class Comm:
 
     def _parse_frames(self, peer: int, out: List[Tuple[int, Any]]) -> None:
         buf = self._rx_buf[peer]
-        while len(buf) >= _LEN.size:
+        head = _LEN.size + _GEN.size
+        while len(buf) >= head:
             (length,) = _LEN.unpack(buf[: _LEN.size])
-            if len(buf) < _LEN.size + length:
+            if len(buf) < head + length:
                 break
-            frame = bytes(buf[_LEN.size : _LEN.size + length])
-            del buf[: _LEN.size + length]
-            _flight.note_comm("rx", peer, _LEN.size + length)
+            (gen,) = _GEN.unpack(buf[_LEN.size : head])
+            frame = bytes(buf[head : head + length])
+            del buf[: head + length]
+            _flight.note_comm("rx", peer, head + length)
+            if gen != self._peer_gen.get(peer):
+                # Dead-generation frame: fence it out instead of
+                # letting pre-restart traffic corrupt the resumed
+                # execution's epoch accounting.
+                self.fenced_frames += 1
+                _flight.note_fenced(peer, gen)
+                continue
             msg = pickle.loads(frame)
             if msg == _HB:
                 continue  # liveness only; never delivered
@@ -281,6 +362,7 @@ class Comm:
         bounded detection of frozen/half-open peers that never send a
         TCP close (``BYTEWAX_TPU_HEARTBEAT_S``; 0 disables).
         """
+        _faults.fire("comm.recv")
         self._drain_into_buffers(timeout)
         if self._hb > 0:
             # After the drain, so buffered-but-unread bytes can never
@@ -292,7 +374,7 @@ class Comm:
                     and now - self._last_tx[peer] >= self._hb
                 ):
                     self.send(peer, _HB)
-            limit = self._hb * _HB_MISS
+            limit = self._hb_limit
             for peer, last in self._last_rx.items():
                 if peer in self._closed or peer in self._paused:
                     continue
@@ -309,7 +391,9 @@ class Comm:
                         f"(> {limit:.1f}s heartbeat limit); assuming "
                         "it is dead or frozen"
                     )
-                    raise ConnectionError(msg)
+                    raise ClusterPeerDead(
+                        msg, peer=peer, silence_s=now - last
+                    )
         out: List[Tuple[int, Any]]
         if self._pending:
             out, self._pending = self._pending, []
@@ -321,7 +405,9 @@ class Comm:
             # A peer died mid-run with nothing left to deliver (a
             # normal shutdown never pumps after its final close).
             peer = next(iter(self._closed))
-            raise ConnectionError(f"cluster peer {peer} closed connection")
+            raise ClusterPeerDead(
+                f"cluster peer {peer} closed connection", peer=peer
+            )
         return out
 
     def close(self) -> None:
@@ -329,6 +415,19 @@ class Comm:
             try:
                 self._sel.unregister(sock)
             except (KeyError, ValueError):
+                pass
+            try:
+                # Orderly FIN before close: without the shutdown,
+                # peers of a cleanly-exiting worker can see an abrupt
+                # RST (unread bytes in our kernel rx buffer turn
+                # close() into a reset) instead of end-of-stream.
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                # Best-effort on the way out: the peer may already be
+                # gone (ENOTCONN et al.), and close() runs in the
+                # driver's finally during restartable unwinds — an
+                # errno here must never replace the fault being
+                # handled.
                 pass
             sock.close()
         self._sel.close()

@@ -26,9 +26,10 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from bytewax_tpu.dataflow import Dataflow, Operator
+from bytewax_tpu.engine import faults as _faults
 from bytewax_tpu.engine import flight as _flight
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
-from bytewax_tpu.errors import note_context
+from bytewax_tpu.errors import DeviceFault, EpochStalled, note_context
 from bytewax_tpu.engine.flatten import Plan, flatten
 from bytewax_tpu.engine.recovery_store import RecoveryStore, ResumeFrom
 from bytewax_tpu.engine.xla import AccelSpec, DeviceAggState, NonNumericValues
@@ -109,6 +110,84 @@ def _extract_kv(item: Any, step_id: str) -> Tuple[str, Any]:
 
 class _Abort(Exception):
     """Internal: a source requested hard abort."""
+
+
+#: Faults the supervisor may heal by restarting the worker from the
+#: last committed epoch: peer death / torn mesh (ClusterPeerDead is a
+#: ConnectionError), a wedged epoch protocol, injected chaos faults,
+#: and device faults that escaped demotion (the collective global-
+#: exchange tier cannot demote per-process).
+_RESTARTABLE = (
+    ConnectionError,
+    EpochStalled,
+    _faults.InjectedFault,
+    DeviceFault,
+)
+
+
+def _max_restarts() -> int:
+    return int(os.environ.get("BYTEWAX_TPU_MAX_RESTARTS", "0") or 0)
+
+
+def _supervised(make: Callable[[int], "_Driver"]) -> None:
+    """Run a driver under the restart supervisor.
+
+    ``make(generation)`` builds a fresh driver (re-opening the
+    recovery store recomputes ``resume_from()``, so each generation
+    resumes from the last committed epoch).  Restartable faults are
+    retried up to ``BYTEWAX_TPU_MAX_RESTARTS`` times *per failure
+    burst* (default 0 — supervision off, faults propagate exactly as
+    before) with capped exponential backoff starting at
+    ``BYTEWAX_TPU_RESTART_BACKOFF_S``.
+
+    The budget and backoff are burst-scoped (the Erlang/k8s
+    crash-loop intensity model): an execution that stays healthy for
+    ``BYTEWAX_TPU_RESTART_RESET_S`` (default 300s) before failing
+    resets both, so sporadic faults over a long-running flow never
+    escalate to the backoff cap or exhaust the budget — only a rapid
+    crash loop does.
+
+    Restarts re-enter at run startup — a globally-ordered point (mesh
+    handshake + the unconditional "fcfg" sync round), so the restarted
+    cluster performs the same sequence of sync rounds from scratch and
+    the gsync/barrier contract holds across generations.
+    """
+    max_restarts = _max_restarts()
+    reset_s = float(
+        os.environ.get("BYTEWAX_TPU_RESTART_RESET_S", "300") or 300
+    )
+    attempt = 0
+    generation = 0
+    while True:
+        started = time.monotonic()
+        try:
+            make(generation).run()
+            return
+        except _RESTARTABLE as ex:
+            if time.monotonic() - started >= reset_s:
+                attempt = 0  # healthy run: new failure burst
+            if attempt >= max_restarts:
+                raise
+            attempt += 1
+            generation += 1
+            base = float(
+                os.environ.get("BYTEWAX_TPU_RESTART_BACKOFF_S", "0.5")
+                or 0.5
+            )
+            delay = min(base * (2 ** (attempt - 1)), 30.0)
+            _flight.note_restart(attempt, type(ex).__name__, delay)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "worker fault (%s: %s); supervised restart %d/%d "
+                "in %.2fs",
+                type(ex).__name__,
+                ex,
+                attempt,
+                max_restarts,
+                delay,
+            )
+            time.sleep(delay)
 
 
 class _StepError(RuntimeError):
@@ -498,6 +577,12 @@ class _StatefulBatchRt(_OpRt):
         self.agg: Optional[DeviceAggState] = None
         self.wagg = None
         self.sagg = None
+        #: Consecutive device-dispatch faults on this step; at
+        #: ``driver.demote_after`` the step is demoted to the host
+        #: tier (state migrated) for the rest of the execution.
+        self._dev_faults = 0
+        #: Demotion reason once demoted (also surfaced in /status).
+        self.demoted: Optional[str] = None
         spec = op.conf.get("_accel")
         if driver.accel:
             from bytewax_tpu.engine.scan_accel import ScanAccelSpec
@@ -790,20 +875,11 @@ class _StatefulBatchRt(_OpRt):
             or self.agg is not None
             or self.sagg is not None
         ):
-            # Device-tier dispatch: visible as its own span (nested
-            # under the per-activation "operator" span) so OTLP traces
-            # show where the device tier starts, and as a ring event.
-            _flight.RECORDER.record(
-                "device_dispatch",
-                step=self.op.step_id,
-                entries=len(entries),
-            )
-            if self.driver.trace_ops:
-                with _span("device_dispatch", step_id=self.op.step_id):
-                    self._process_device(entries)
-            else:
-                self._process_device(entries)
-            return
+            if self._dispatch_device(entries):
+                return
+            # Demoted mid-delivery: fall through — the host loop
+            # below now owns the migrated state and must still take
+            # this (already split) delivery.
         out: Dict[int, List[Any]] = {}
         for _w, items in entries:
             if isinstance(items, ArrayBatch):
@@ -838,6 +914,80 @@ class _StatefulBatchRt(_OpRt):
                     _reraise(self.op.step_id, "`on_batch`", ex)
                 self._handle(key, emits, discard, out)
         self._flush(out)
+
+    def _dispatch_device(self, entries: List[Entry]) -> bool:
+        """Run one delivery through the device tier, healing flaky
+        dispatches: a :class:`DeviceFault` (raised before any device
+        state mutates — the injector's contract) is retried in place,
+        and ``driver.demote_after`` consecutive faults demote this
+        step to the host tier for the rest of the execution.  Returns
+        True when the device tier handled the delivery; False after a
+        demotion (the caller's host path takes the delivery)."""
+        while True:
+            # Device-tier dispatch: visible as its own span (nested
+            # under the per-activation "operator" span) so OTLP traces
+            # show where the device tier starts, and as a ring event.
+            _flight.RECORDER.record(
+                "device_dispatch",
+                step=self.op.step_id,
+                entries=len(entries),
+            )
+            try:
+                _faults.fire("device_dispatch", step=self.op.step_id)
+                if self.driver.trace_ops:
+                    with _span(
+                        "device_dispatch", step_id=self.op.step_id
+                    ):
+                        self._process_device(entries)
+                else:
+                    self._process_device(entries)
+            except DeviceFault as ex:
+                self._dev_faults += 1
+                if self._dev_faults < self.driver.demote_after:
+                    continue  # transient: retry the same delivery
+                if self.agg is not None and getattr(
+                    self.agg, "global_exchange", False
+                ):
+                    # The global tier's flush is COLLECTIVE: demoting
+                    # one process would leave its peers blocking in
+                    # the exchange forever.  Unwind instead — the
+                    # supervisor restarts the whole cluster (or run
+                    # with BYTEWAX_TPU_GLOBAL_EXCHANGE=0).
+                    _reraise(
+                        self.op.step_id, "the device aggregation", ex
+                    )
+                self._demote(str(ex))
+                return False
+            else:
+                self._dev_faults = 0
+                return True
+
+    def _demote(self, reason: str) -> None:
+        """Migrate this step's device-tier state into host logics and
+        run on the host tier from here on.  Snapshot formats are
+        cross-tier interchangeable, so each device snapshot rebuilds
+        a host logic exactly as a recovery resume would."""
+        if self.wagg is not None:
+            state = self.wagg
+            # Keys the device tier touched since the last close must
+            # stay snapshot-tracked by the host tier.
+            self.awoken.update(state.touched)
+        elif self.agg is not None:
+            state = self.agg
+        else:
+            state = self.sagg
+        pairs = state.demotion_snapshots()
+        self.wagg = self.agg = self.sagg = None
+        migrated = 0
+        for key, snap in pairs:
+            if snap is None:
+                continue  # empty state: host tier builds on demand
+            logic = self._build(snap)
+            self.logics[key] = logic
+            self._resched(key, logic)
+            migrated += 1
+        self.demoted = reason
+        _flight.note_demotion(self.op.step_id, reason, migrated)
 
     def _process_device(self, entries: List[Entry]) -> None:
         """Route a delivery to whichever device-tier state this step
@@ -1276,8 +1426,12 @@ class _Driver:
         recovery_config: Optional[Any],
         addresses: Optional[List[str]] = None,
         proc_id: int = 0,
+        generation: int = 0,
     ):
         self.plan: Plan = flatten(flow)
+        #: Supervised-restart generation; tags every cluster frame so
+        #: traffic from a dead generation is fenced (see engine/comm).
+        self.generation = generation
         # ``worker_count`` is per process; lanes are globally
         # numbered so keyed routing is identical on every process.
         self.wpp = worker_count
@@ -1305,11 +1459,14 @@ class _Driver:
                 for a in addresses[:proc_id]
                 if a.rpartition(":")[0] == host
             )
+        # Arm the chaos injector for this process before any site can
+        # fire (the mesh handshake below is the first hot path).
+        _faults.configure(proc_id)
         self.comm = None
         if self.proc_count > 1:
             from bytewax_tpu.engine.comm import Comm
 
-            self.comm = Comm(addresses, proc_id)
+            self.comm = Comm(addresses, proc_id, generation=generation)
         self.sent = [0] * self.proc_count
         self.rcvd = [0] * self.proc_count
         #: gsync frames from peers ahead of this process's sync round.
@@ -1432,6 +1589,22 @@ class _Driver:
                 self._commit_delay = None
         self.resume = resume
         self.epoch = resume.resume_epoch
+        _faults.set_epoch(self.epoch)
+
+        #: Demote a device-tier step to the host tier after this many
+        #: consecutive device faults on one step (retried in place:
+        #: DeviceFault guarantees no device state was mutated).
+        self.demote_after = max(
+            1, int(os.environ.get("BYTEWAX_TPU_DEMOTE_AFTER", "3") or 3)
+        )
+        #: Epoch-progress watchdog (s beyond the epoch interval with
+        #: no epoch close in a clustered run); 0 disables.  Heals
+        #: wedged barriers (e.g. an injected frame drop broke the
+        #: count-matched quiescence check) by unwinding into the
+        #: supervisor instead of hanging forever.
+        self.stall_s = float(
+            os.environ.get("BYTEWAX_TPU_EPOCH_STALL_S", "0") or 0.0
+        )
 
         self.rts: List[_OpRt] = []
 
@@ -1563,6 +1736,7 @@ class _Driver:
             )
             _flight.RECORDER.cluster = dict(sorted(replies.items()))
         self.epoch += 1
+        _faults.set_epoch(self.epoch)
         _flight.RECORDER.record("epoch_open", epoch=self.epoch)
 
     def _pump(self, timeout: float = 0.0) -> None:
@@ -1596,6 +1770,7 @@ class _Driver:
         elif kind == "hold":
             if not self._holding:
                 self._hold_t0 = time.monotonic()
+                _faults.fire("barrier")
                 _flight.RECORDER.record(
                     "barrier_enter", epoch=self.epoch, gen=msg[1]
                 )
@@ -1727,6 +1902,7 @@ class _Driver:
                 self.comm.broadcast(("hold", self._gen))
                 self._holding = True
                 self._hold_t0 = time.monotonic()
+                _faults.fire("barrier")
                 _flight.RECORDER.record(
                     "barrier_enter", epoch=self.epoch, gen=self._gen
                 )
@@ -1767,6 +1943,12 @@ class _Driver:
             "flow_id": self.plan.flow.flow_id,
             "proc_id": self.proc_id,
             "proc_count": self.proc_count,
+            "generation": self.generation,
+            "demoted_steps": {
+                rt.op.step_id: rt.demoted
+                for rt in rts
+                if getattr(rt, "demoted", None)
+            },
             "worker_count": self.worker_count,
             "workers": [self.local_lo, self.local_hi],
             "epoch": self.epoch,
@@ -1805,6 +1987,9 @@ class _Driver:
         clustered = self.comm is not None
         self._holding = False
         self._hold_t0: Optional[float] = None
+        #: Stall-watchdog clock: when this process started wanting an
+        #: epoch close (or holding) without one arriving.
+        self._stall_t0: Optional[float] = None
         self._pending_close: Optional[tuple] = None
         self._eof_k = 0
         self._gen = 0
@@ -1864,6 +2049,7 @@ class _Driver:
                         self._hold_t0 = None
                     self._close_epoch(workers=local_workers)
                     self._holding = False
+                    self._stall_t0 = None
                     epoch_started = time.monotonic()
                     self._reports = {}
                     self._last_report = None
@@ -1911,6 +2097,34 @@ class _Driver:
                     want_close = elapsed >= interval_s and (
                         interval_s > 0 or self._progressed or self._holding
                     )
+                    if self.stall_s > 0:
+                        # Watchdog clock: time spent WANTING an epoch
+                        # close (or holding the barrier) without one
+                        # arriving — a wedge signature (lost report,
+                        # dropped data frame breaking the count-
+                        # matched check, a peer stuck in a
+                        # collective).  An idle-but-healthy flow
+                        # (interval 0, no progress, nothing held)
+                        # never arms it.
+                        if not (want_close or self._holding):
+                            self._stall_t0 = None
+                        elif self._stall_t0 is None:
+                            self._stall_t0 = time.monotonic()
+                        elif (
+                            time.monotonic() - self._stall_t0
+                            > self.stall_s
+                        ):
+                            stalled = time.monotonic() - self._stall_t0
+                            msg = (
+                                f"epoch {self.epoch} wanted to close "
+                                f"for {stalled:.1f}s with no close "
+                                f"broadcast (> {self.stall_s:.0f}s "
+                                "BYTEWAX_TPU_EPOCH_STALL_S watchdog); "
+                                "the cluster barrier looks wedged"
+                            )
+                            raise EpochStalled(
+                                msg, epoch=self.epoch, stalled_s=stalled
+                            )
                     report = self._local_report(want_close)
                     if self.proc_id == 0:
                         self._reports[0] = report
@@ -1967,12 +2181,24 @@ class _Driver:
                     self.comm.broadcast(("abort",))
                 except Exception:  # noqa: BLE001
                     pass
-        except BaseException:
+        except BaseException as ex:
             if clustered:
-                try:
-                    self.comm.broadcast(("abort",))
-                except Exception:  # noqa: BLE001
-                    pass
+                supervised_fault = _max_restarts() > 0 and isinstance(
+                    ex, _RESTARTABLE
+                )
+                if not supervised_fault:
+                    try:
+                        self.comm.broadcast(("abort",))
+                    except Exception:  # noqa: BLE001
+                        pass
+                # Under supervision a restartable fault unwinds
+                # ABRUPTLY: no abort broadcast (which would make the
+                # peers exit cleanly instead of restarting).  The
+                # finally below closes the mesh, so peers observe a
+                # socket close — exactly like a real crash — raise
+                # ClusterPeerDead, and restart under their own
+                # supervisors; the restarted cluster re-forms at the
+                # handshake and resumes from the last committed epoch.
             raise
         finally:
             if self._gc_managed:
@@ -2005,13 +2231,22 @@ def run_main(
         interval).  Defaults to 10 seconds.
     :arg recovery_config: State recovery config.  Defaults to no
         recovery.
+
+    With ``BYTEWAX_TPU_MAX_RESTARTS`` set, runs under the restart
+    supervisor: restartable faults (injected chaos, snapshot
+    hiccups) rebuild the driver — which recomputes ``resume_from()``
+    — and resume from the last committed epoch with exponential
+    backoff.
     """
-    _Driver(
-        flow,
-        worker_count=1,
-        epoch_interval=epoch_interval,
-        recovery_config=recovery_config,
-    ).run()
+    _supervised(
+        lambda gen: _Driver(
+            flow,
+            worker_count=1,
+            epoch_interval=epoch_interval,
+            recovery_config=recovery_config,
+            generation=gen,
+        )
+    )
 
 
 def cluster_main(
@@ -2033,12 +2268,24 @@ def cluster_main(
     the processes form a TCP mesh for keyed exchange and epoch/EOF
     coordination (see :mod:`bytewax_tpu.engine.comm`); launch every
     process with the same flow and its own ``proc_id``.
+
+    With ``BYTEWAX_TPU_MAX_RESTARTS`` set, each process runs under its
+    own restart supervisor: peer death (:class:`ClusterPeerDead`), a
+    wedged epoch barrier (:class:`EpochStalled`), and injected chaos
+    faults tear the mesh down, the restarted processes re-form it with
+    a new fenced generation, and execution resumes from the last
+    committed epoch.
     """
-    _Driver(
-        flow,
-        worker_count=worker_count_per_proc,
-        epoch_interval=epoch_interval,
-        recovery_config=recovery_config,
-        addresses=addresses if addresses and len(addresses) > 1 else None,
-        proc_id=proc_id,
-    ).run()
+    _supervised(
+        lambda gen: _Driver(
+            flow,
+            worker_count=worker_count_per_proc,
+            epoch_interval=epoch_interval,
+            recovery_config=recovery_config,
+            addresses=addresses
+            if addresses and len(addresses) > 1
+            else None,
+            proc_id=proc_id,
+            generation=gen,
+        )
+    )

@@ -42,7 +42,11 @@ __all__ = [
     "ensure_compile_listener",
     "note_barrier",
     "note_comm",
+    "note_demotion",
+    "note_fault",
+    "note_fenced",
     "note_gsync",
+    "note_restart",
     "note_transfer",
 ]
 
@@ -234,6 +238,53 @@ def note_gsync(tag: Any, seconds: float) -> None:
     RECORDER.count("gsync_wait_seconds", seconds)
     RECORDER.record(
         "gsync", tag=str(tag), seconds=round(seconds, 6)
+    )
+
+
+def note_fault(site: str, kind: str, **ctx: Any) -> None:
+    """One injected fault fired at a named site (see
+    :mod:`bytewax_tpu.engine.faults`)."""
+    from bytewax_tpu._metrics import fault_injected_count
+
+    fault_injected_count.labels(site, kind).inc()
+    RECORDER.count("fault_injected_count")
+    # ``kind`` is the ring event's own field name; the fault kind
+    # rides as ``fault``.
+    RECORDER.record("fault_injected", site=site, fault=kind, **ctx)
+
+
+def note_fenced(peer: int, gen: int) -> None:
+    """One dead-generation frame discarded by the comm fence."""
+    from bytewax_tpu._metrics import comm_fenced_frames
+
+    comm_fenced_frames.inc()
+    RECORDER.count("comm_fenced_frames")
+    RECORDER.record("frame_fenced", peer=peer, gen=gen)
+
+
+def note_restart(attempt: int, cause: str, backoff_s: float) -> None:
+    """The supervisor is restarting this worker after a restartable
+    fault; also stamps ``restart_at`` so ``bench.py`` can measure
+    kill-to-first-epoch-close recovery latency."""
+    from bytewax_tpu._metrics import worker_restart_count
+
+    worker_restart_count.inc()
+    RECORDER.count("worker_restart_count")
+    RECORDER.counters["last_restart_at"] = time.time()
+    RECORDER.record(
+        "restart", attempt=attempt, cause=cause, backoff_s=backoff_s
+    )
+
+
+def note_demotion(step_id: str, reason: str, keys: int) -> None:
+    """A stateful step was demoted from the device tier to the host
+    tier (``keys`` states migrated)."""
+    from bytewax_tpu._metrics import step_demotion_count
+
+    step_demotion_count.labels(step_id).inc()
+    RECORDER.count("demotion_count")
+    RECORDER.record(
+        "demotion", step=step_id, reason=reason, keys=keys
     )
 
 

@@ -25,6 +25,8 @@ import zlib
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple, Union
 
+from bytewax_tpu.engine import faults as _faults
+
 __all__ = [
     "InconsistentPartitionsError",
     "MissingPartitionsError",
@@ -357,6 +359,10 @@ class RecoveryStore:
         for _idx, con in sorted(self._cons.items()):
             con.execute("BEGIN IMMEDIATE")
         try:
+            # Chaos site: a fault here (error/crash) lands inside the
+            # multi-partition transaction, so the except-arm's ROLLBACK
+            # proves snapshot writes are all-or-nothing.
+            _faults.fire("snapshot.write")
             for step_id, state_key, ser_change in snaps:
                 con = self._part_for_key(step_id, state_key)
                 con.execute(
@@ -405,6 +411,11 @@ class RecoveryStore:
                         ")",
                         (commit_epoch,),
                     )
+            # Chaos site at the commit point: everything is written
+            # but nothing durable yet — a crash here is the classic
+            # torn-epoch window, and resume must land on the previous
+            # close.
+            _faults.fire("snapshot.commit")
         except BaseException:
             for con in self._cons.values():
                 con.execute("ROLLBACK")

@@ -334,6 +334,11 @@ class DeviceScanState(ScanUpdates):
                 )
         return out
 
+    def demotion_snapshots(self) -> List[Tuple[str, Any]]:
+        """Full-state drain for device→host demotion (see
+        ``DeviceAggState.demotion_snapshots``)."""
+        return self.snapshots_for(self.keys())
+
     def discard(self, key: str) -> None:
         slot = self.key_to_slot.pop(key, None)
         if slot is not None:

@@ -283,6 +283,12 @@ class _ShardedSlots:
     def keys(self) -> List[str]:
         return list(self.key_to_kid)
 
+    def demotion_snapshots(self) -> List[Tuple[str, Any]]:
+        """Full-state drain for device→host demotion (subclasses
+        supply ``snapshots_for``); see
+        ``xla.DeviceAggState.demotion_snapshots``."""
+        return self.snapshots_for(self.keys())
+
 
 class ShardedAggState(_ShardedSlots):
     """Slot-table aggregation state sharded over a device mesh.

@@ -661,6 +661,14 @@ class DeviceWindowAggState:
             )
         return out
 
+    def demotion_snapshots(self):
+        """Full-state drain for device→host demotion: host-format
+        window snapshots for every key this windower has ever seen
+        (keys with no open windows drain as None — the host tier
+        rebuilds them on demand, matching its own discard of empty
+        window logics)."""
+        return self.snapshots_for(sorted(self.key_ids))
+
     def _load_clock(self, kid: int, snap: Any) -> None:
         cs = snap.clock_state
         if cs is not None:

@@ -585,3 +585,10 @@ class DeviceAggState:
 
     def keys(self) -> List[str]:
         return [k for k in self.slot_keys if k is not None]
+
+    def demotion_snapshots(self) -> List[Tuple[str, Any]]:
+        """Every live key's host-format snapshot — the full-state
+        drain the driver uses to demote this step to the host tier
+        after repeated device faults (host logics rebuild from these
+        exactly as a recovery resume would)."""
+        return self.snapshots_for(self.keys())

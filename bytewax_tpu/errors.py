@@ -16,6 +16,9 @@ from typing import Callable, Optional
 
 __all__ = [
     "BytewaxRuntimeError",
+    "ClusterPeerDead",
+    "DeviceFault",
+    "EpochStalled",
     "callable_location",
     "note_context",
 ]
@@ -23,6 +26,55 @@ __all__ = [
 
 class BytewaxRuntimeError(RuntimeError):
     """Raised when the engine encounters a runtime error."""
+
+
+class ClusterPeerDead(ConnectionError):
+    """A cluster peer stopped responding (heartbeat silence) or closed
+    its connection mid-run.
+
+    Subclasses :class:`ConnectionError` so existing handlers keep
+    working; carries the peer id and how long it was silent so the
+    supervisor can log a useful restart reason.  Restartable: the
+    supervisor (``BYTEWAX_TPU_MAX_RESTARTS``) tears the mesh down and
+    resumes from the last committed epoch.
+    """
+
+    # Defaults keep the error picklable: BaseException's reduce
+    # replays only self.args (the message); peer/silence_s ride along
+    # in __dict__ state.
+    def __init__(
+        self, msg: str, *, peer: int = -1, silence_s: Optional[float] = None
+    ):
+        super().__init__(msg)
+        self.peer = peer
+        self.silence_s = silence_s
+
+
+class EpochStalled(BytewaxRuntimeError):
+    """The clustered epoch protocol made no progress for longer than
+    the ``BYTEWAX_TPU_EPOCH_STALL_S`` watchdog limit (e.g. a dropped
+    data frame wedged the count-matched barrier).  Restartable."""
+
+    # Defaults for pickle round-trips (see ClusterPeerDead).
+    def __init__(
+        self, msg: str, *, epoch: int = -1, stalled_s: float = 0.0
+    ):
+        super().__init__(msg)
+        self.epoch = epoch
+        self.stalled_s = stalled_s
+
+
+class DeviceFault(BytewaxRuntimeError):
+    """A device-tier dispatch failed before mutating device state (a
+    flaky accelerator, or the fault injector's ``device_dispatch``
+    site).  The driver retries the dispatch and, after K consecutive
+    faults on a step, demotes that step to the host tier for the rest
+    of the execution (``BYTEWAX_TPU_DEMOTE_AFTER``).
+
+    Raisers must guarantee no device state was mutated: the driver
+    retries the same delivery, so a partially-applied update would
+    double-count.
+    """
 
 
 def callable_location(f: Callable) -> Optional[str]:

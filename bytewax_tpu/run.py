@@ -195,6 +195,30 @@ def _create_arg_parser() -> argparse.ArgumentParser:
         action=_EnvDefault,
         envvar="BYTEWAX_RECOVERY_BACKUP_INTERVAL",
     )
+    supervision = parser.add_argument_group(
+        "Supervision",
+        "Restart this worker in place after restartable faults "
+        "(peer death, epoch stalls, snapshot hiccups), resuming from "
+        "the last committed epoch; see docs/recovery.md",
+    )
+    supervision.add_argument(
+        "--max-restarts",
+        type=int,
+        default=None,
+        help="Supervised restarts before giving up (0 disables "
+        "supervision)",
+        action=_EnvDefault,
+        envvar="BYTEWAX_TPU_MAX_RESTARTS",
+    )
+    supervision.add_argument(
+        "--restart-backoff",
+        type=float,
+        default=None,
+        help="Initial restart backoff in seconds (doubles per "
+        "attempt, capped at 30s)",
+        action=_EnvDefault,
+        envvar="BYTEWAX_TPU_RESTART_BACKOFF_S",
+    )
     return parser
 
 
@@ -261,6 +285,14 @@ def cli_main(
 
 def _main() -> None:
     args = _parse_args()
+    # The supervisor reads these from the environment (it lives below
+    # the entry-point signatures); the flags just provide CLI parity.
+    if args.max_restarts is not None:
+        os.environ["BYTEWAX_TPU_MAX_RESTARTS"] = str(args.max_restarts)
+    if args.restart_backoff is not None:
+        os.environ["BYTEWAX_TPU_RESTART_BACKOFF_S"] = str(
+            args.restart_backoff
+        )
     module_str, dataflow_name = _prepare_import(args.import_str)
     flow = _locate_dataflow(module_str, dataflow_name)
     recovery_config = None

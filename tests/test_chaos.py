@@ -1,0 +1,520 @@
+"""Chaos tests: fault injection, the restart supervisor, and
+device-tier demotion (tentpole of the robustness PR).
+
+Faults are injected ONLY through the engine's own injector
+(``BYTEWAX_TPU_FAULTS`` — no monkeypatching of engine internals), so
+these tests exercise exactly the sites a production chaos run would.
+"""
+
+import os
+import subprocess
+import sys
+from datetime import timedelta
+from pathlib import Path
+
+import pytest
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.engine import faults, flight
+from bytewax_tpu.errors import DeviceFault, EpochStalled
+from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+ZERO_TD = timedelta(seconds=0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fault_plan():
+    """Each test re-arms the injector from its own env (fire-counts
+    are process-global by design, so supervised restarts within one
+    run don't re-fire one-shot faults — but tests must not inherit a
+    previous test's spent counters)."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _supervision_env(monkeypatch, spec, restarts=2, backoff="0.05"):
+    monkeypatch.setenv("BYTEWAX_TPU_FAULTS", spec)
+    monkeypatch.setenv("BYTEWAX_TPU_MAX_RESTARTS", str(restarts))
+    monkeypatch.setenv("BYTEWAX_TPU_RESTART_BACKOFF_S", backoff)
+
+
+# -- supervised restart: exactly-once across a snapshot-commit crash ----
+
+
+def _file_flow(inp, out_path):
+    from bytewax_tpu.connectors.files import FileSink
+
+    flow = Dataflow("chaos_df")
+    s = op.input("inp", flow, TestingSource(inp))
+    s = op.stateful_map(
+        "sum", s, lambda st, v: ((st or 0) + v, (st or 0) + v)
+    )
+    s = op.map("fmt", s, lambda kv: (kv[0], f"{kv[0]}={kv[1]}"))
+    op.output("out", s, FileSink(out_path))
+    return flow
+
+
+def test_supervised_restart_snapshot_crash_exactly_once(
+    entry_point, tmp_path, monkeypatch
+):
+    # An injected crash at the snapshot-commit point (the torn-epoch
+    # window: snapshots written, nothing durable) unwinds the worker;
+    # the supervisor restarts it from the last committed epoch and the
+    # final output is identical to a fault-free run — the sink
+    # truncates to its snapshotted offset, so the replayed epoch is
+    # not duplicated.
+    inp = [(f"k{i % 3}", i) for i in range(12)]
+    out_path = tmp_path / "out.txt"
+    db = tmp_path / "db"
+    db.mkdir()
+    init_db_dir(db, 1)
+    _supervision_env(monkeypatch, "snapshot.commit:crash:3:x1")
+
+    restarts_before = flight.RECORDER.counters.get(
+        "worker_restart_count", 0
+    )
+    entry_point(
+        _file_flow(inp, str(out_path)),
+        epoch_interval=ZERO_TD,
+        recovery_config=RecoveryConfig(str(db)),
+    )
+    assert (
+        flight.RECORDER.counters.get("worker_restart_count", 0)
+        == restarts_before + 1
+    )
+
+    # Oracle: running sums per key, each item exactly once (the
+    # cross-key interleave may differ across restarts, so compare the
+    # multiset — every sum string is unique for this input).
+    sums, want = {}, []
+    for k, v in inp:
+        sums[k] = sums.get(k, 0) + v
+        want.append(f"{k}={sums[k]}")
+    assert sorted(out_path.read_text().split()) == sorted(want)
+
+
+def test_unsupervised_injected_crash_propagates(tmp_path, monkeypatch):
+    # Default (BYTEWAX_TPU_MAX_RESTARTS unset): injected faults
+    # propagate exactly like any crash — no silent retry loops.
+    monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "snapshot.write:crash:1:x1")
+    monkeypatch.delenv("BYTEWAX_TPU_MAX_RESTARTS", raising=False)
+    init_db_dir(tmp_path, 1)
+    out = []
+    flow = Dataflow("chaos_df")
+    s = op.input("inp", flow, TestingSource([1, 2, 3]))
+    op.output("out", s, TestingSink(out))
+    with pytest.raises(faults.InjectedCrash):
+        run_main(
+            flow,
+            epoch_interval=ZERO_TD,
+            recovery_config=RecoveryConfig(str(tmp_path)),
+        )
+    # The transaction rolled back: a fault-free continuation replays
+    # everything (nothing durable was committed).
+    out.clear()
+    monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "")
+    faults.reset()
+    run_main(
+        flow,
+        epoch_interval=ZERO_TD,
+        recovery_config=RecoveryConfig(str(tmp_path)),
+    )
+    assert out == [1, 2, 3]
+
+
+# -- device-tier demotion ----------------------------------------------
+
+
+def _demotion_events():
+    return [e for e in flight.RECORDER.tail() if e["kind"] == "demotion"]
+
+
+def test_device_demotion_after_k_faults(monkeypatch):
+    # Epoch 1 builds device-tier aggregation state; from epoch 2 every
+    # device dispatch faults.  After K consecutive faults the step
+    # demotes to the host tier WITH its state (sums must include the
+    # epoch-1 device contributions) and a `demotion` flight event +
+    # metric land.
+    from bytewax_tpu import xla
+
+    monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "device_dispatch:error:2+")
+    monkeypatch.setenv("BYTEWAX_TPU_DEMOTE_AFTER", "3")
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+
+    n = 40
+    inp = [(f"k{i % 4}", 1.0) for i in range(n)]
+    out = []
+    flow = Dataflow("demote_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=4))
+    r = op.reduce_final("sum", s, xla.SUM)
+    op.output("out", r, TestingSink(out))
+
+    faults_before = flight.RECORDER.counters.get("fault_injected_count", 0)
+    run_main(flow, epoch_interval=ZERO_TD)
+
+    assert dict(out) == {f"k{i}": n / 4 for i in range(4)}
+    events = _demotion_events()
+    assert events and events[-1]["step"].startswith("demote_df.sum")
+    # K consecutive faults were recorded before the demotion.
+    assert (
+        flight.RECORDER.counters.get("fault_injected_count", 0)
+        >= faults_before + 3
+    )
+    assert flight.RECORDER.counters.get("demotion_count", 0) >= 1
+    from bytewax_tpu._metrics import generate_python_metrics
+
+    assert "bytewax_step_demotion_count" in generate_python_metrics()
+
+
+def test_device_demotion_windowed_state_continuity(monkeypatch):
+    # Same demotion path for the device windower: open windows built
+    # on device in epoch 1 must close with correct counts on the host
+    # tier after the step demotes mid-stream.
+    from datetime import datetime, timezone
+
+    import bytewax_tpu.operators.windowing as w
+    from bytewax_tpu.operators.windowing import (
+        EventClock,
+        TumblingWindower,
+    )
+
+    monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "device_dispatch:error:2+")
+    monkeypatch.setenv("BYTEWAX_TPU_DEMOTE_AFTER", "2")
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+
+    align = datetime(2022, 1, 1, tzinfo=timezone.utc)
+    n = 240
+    inp = [
+        (align + timedelta(seconds=i), f"key{i % 2}") for i in range(n)
+    ]
+    out = []
+    flow = Dataflow("demote_win_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=16))
+    clock = EventClock(
+        ts_getter=lambda item: item[0],
+        wait_for_system_duration=timedelta(seconds=5),
+    )
+    windower = TumblingWindower(
+        length=timedelta(minutes=1), align_to=align
+    )
+    wo = w.count_window(
+        "count", s, clock, windower, key=lambda item: item[1]
+    )
+    op.output("out", wo.down, TestingSink(out))
+
+    run_main(flow, epoch_interval=ZERO_TD)
+    events = _demotion_events()
+    assert events and events[-1]["step"].startswith("demote_win_df.count")
+    # Exactly-once across the tier switch: every event is counted in
+    # exactly one (key, window) — the totals cover all n rows and no
+    # (key, window) closes twice.
+    seen = set()
+    for key, (wid, _count) in out:
+        assert (key, wid) not in seen, "duplicate (key, window) close"
+        seen.add((key, wid))
+    assert sum(c for _k, (_w, c) in out) == n
+
+
+def test_device_demotion_scan_state_continuity(monkeypatch):
+    # Third device tier: the per-row-emitting scan (stateful_map
+    # lowering).  Device state from epoch 1 must carry into the host
+    # logics after demotion — outputs identical to a pure host run.
+    from bytewax_tpu import xla
+
+    def build(out):
+        inp = [(f"k{i % 3}", float(i % 7)) for i in range(60)]
+        flow = Dataflow("demote_scan_df")
+        s = op.input("inp", flow, TestingSource(inp, batch_size=8))
+        scored = op.stateful_map("ema", s, xla.ema(0.3))
+        op.output("out", scored, TestingSink(out))
+        return flow
+
+    monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "device_dispatch:error:2+")
+    monkeypatch.setenv("BYTEWAX_TPU_DEMOTE_AFTER", "2")
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    demoted = []
+    run_main(build(demoted), epoch_interval=ZERO_TD)
+    events = _demotion_events()
+    assert events and events[-1]["step"].startswith("demote_scan_df.ema")
+
+    monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "")
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "0")
+    faults.reset()
+    host = []
+    run_main(build(host), epoch_interval=ZERO_TD)
+
+    def canon(rows):
+        # Scan rows are (key, (orig_value, ema)); round the floats so
+        # device f32 vs host f64 arithmetic compares stably.
+        return sorted(
+            (k, tuple(round(float(x), 3) for x in v)) for k, v in rows
+        )
+
+    assert canon(demoted) == canon(host)
+
+
+def test_transient_device_fault_retries_without_demotion(monkeypatch):
+    # A single injected fault (under the K threshold) is retried in
+    # place: no demotion, identical output.
+    from bytewax_tpu import xla
+
+    monkeypatch.setenv(
+        "BYTEWAX_TPU_FAULTS", "device_dispatch:error:*:x1"
+    )
+    monkeypatch.setenv("BYTEWAX_TPU_DEMOTE_AFTER", "3")
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+
+    inp = [(f"k{i % 2}", 1.0) for i in range(10)]
+    out = []
+    flow = Dataflow("transient_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=5))
+    r = op.reduce_final("sum", s, xla.SUM)
+    op.output("out", r, TestingSink(out))
+
+    demotions_before = flight.RECORDER.counters.get("demotion_count", 0)
+    run_main(flow, epoch_interval=ZERO_TD)
+    assert dict(out) == {"k0": 5.0, "k1": 5.0}
+    assert (
+        flight.RECORDER.counters.get("demotion_count", 0)
+        == demotions_before
+    )
+
+
+def test_global_exchange_device_fault_is_not_demoted(monkeypatch):
+    # The collective global-mesh tier must never demote per-process
+    # (peers would block in the exchange forever): the fault
+    # propagates as a step-qualified DeviceFault instead.
+    monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "device_dispatch:error:*")
+    monkeypatch.setenv("BYTEWAX_TPU_DEMOTE_AFTER", "2")
+
+    from bytewax_tpu.engine.driver import _StatefulBatchRt
+
+    class _FakeGlobalAgg:
+        global_exchange = True
+
+    class _FakeDriver:
+        demote_after = 2
+        trace_ops = False
+
+    rt = _StatefulBatchRt.__new__(_StatefulBatchRt)
+    rt.driver = _FakeDriver()
+    rt.agg = _FakeGlobalAgg()
+    rt.wagg = rt.sagg = None
+    rt._dev_faults = 0
+    rt.demoted = None
+
+    class _Op:
+        step_id = "gx.step"
+
+    rt.op = _Op()
+    faults.configure(0)
+    faults.set_epoch(1)
+    with pytest.raises(DeviceFault):
+        rt._dispatch_device([(0, [("k", 1.0)])])
+    assert rt.demoted is None
+    assert rt.agg is not None
+
+
+# -- 2-process cluster: injector-driven worker death -------------------
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["BYTEWAX_TPU_PLATFORM"] = "cpu"
+    env["BYTEWAX_TPU_ACCEL"] = "0"  # keep subprocess startup light
+    env.pop("BYTEWAX_TPU_FAULTS", None)
+    env.pop("BYTEWAX_TPU_MAX_RESTARTS", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+_SEQ_FLOW = '''
+import os
+import time
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.inputs import FixedPartitionedSource, StatefulSourcePartition
+
+
+class _Part(StatefulSourcePartition):
+    def __init__(self, name, resume):
+        self._name = name
+        self._i = resume or 0
+
+    def next_batch(self):
+        if self._i >= int(os.environ["CHAOS_CAP"]):
+            raise StopIteration()
+        self._i += 1
+        pace = float(os.environ.get("CHAOS_PACE_S", "0"))
+        if pace:
+            time.sleep(pace)
+        return [(f"{{self._name}}-{{self._i % 4}}", self._i)]
+
+    def snapshot(self):
+        return self._i
+
+
+class SeqSource(FixedPartitionedSource):
+    def list_parts(self):
+        return ["p0", "p1"]
+
+    def build_part(self, step_id, name, resume):
+        return _Part(name, resume)
+
+
+flow = Dataflow("chaos_df")
+s = op.input("inp", flow, SeqSource())
+s = op.stateful_map("sum", s, lambda st, v: ((st or 0) + v, (st or 0) + v))
+s = op.map("fmt", s, lambda kv: (kv[0], f"{{kv[0]}}={{kv[1]}}"))
+op.output("out", s, FileSink({out_path!r}))
+'''
+
+
+def _run_seq_cluster(tmp_path, name, cap, extra_env, timeout=150):
+    flow_py = tmp_path / f"{name}.py"
+    out_path = str(tmp_path / f"{name}_out.txt")
+    flow_py.write_text(_SEQ_FLOW.format(out_path=out_path))
+    db = tmp_path / f"{name}_db"
+    db.mkdir()
+    subprocess.run(
+        [sys.executable, "-m", "bytewax_tpu.recovery", str(db), "2"],
+        env=_env(),
+        check=True,
+        timeout=60,
+    )
+    env = _env(extra_env)
+    env["CHAOS_CAP"] = str(cap)
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.testing",
+            f"{flow_py}:flow",
+            "-p",
+            "2",
+            "-r",
+            str(db),
+            "-s",
+            "0",
+            "-b",
+            "0",
+        ],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    return res, Path(out_path)
+
+
+def _seq_oracle(cap):
+    want = []
+    for part in ("p0", "p1"):
+        sums = {}
+        for i in range(1, cap + 1):
+            key = f"{part}-{i % 4}"
+            sums[key] = sums.get(key, 0) + i
+            want.append(f"{key}={sums[key]}")
+    return sorted(want)
+
+
+def test_cluster_injected_worker_crash_supervised_exactly_once(tmp_path):
+    # The injector kills worker 1 mid-epoch (simulated sudden death:
+    # no abort broadcast, sockets just close).  Worker 0's supervisor
+    # sees ClusterPeerDead, both restart, the mesh re-forms with a new
+    # fenced generation, and the run completes with output IDENTICAL
+    # to a fault-free run — exactly-once across the restart.
+    cap = 30
+    res, out = _run_seq_cluster(
+        tmp_path,
+        "crash",
+        cap,
+        {
+            "BYTEWAX_TPU_FAULTS": "comm.send:crash:4:1:x1",
+            "BYTEWAX_TPU_MAX_RESTARTS": "3",
+            "BYTEWAX_TPU_RESTART_BACKOFF_S": "0.1",
+        },
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "supervised restart" in res.stderr, res.stderr[-3000:]
+    assert sorted(out.read_text().split()) == _seq_oracle(cap)
+
+
+def test_cluster_injected_stall_heals_via_watchdog(tmp_path):
+    # A dropped data frame breaks the barrier's count-matched
+    # quiescence check: without the watchdog the cluster would hang
+    # forever.  BYTEWAX_TPU_EPOCH_STALL_S turns the wedge into
+    # EpochStalled, the supervisor restarts both workers, and output
+    # is still exactly-once.
+    cap = 30
+    res, out = _run_seq_cluster(
+        tmp_path,
+        "stall",
+        cap,
+        {
+            # Drop one data-plane frame on worker 1 (epoch 4); x1 so
+            # the restarted generation runs clean.
+            "BYTEWAX_TPU_FAULTS": "comm.send:drop:4:1:x1",
+            "BYTEWAX_TPU_MAX_RESTARTS": "3",
+            "BYTEWAX_TPU_RESTART_BACKOFF_S": "0.1",
+            "BYTEWAX_TPU_EPOCH_STALL_S": "3",
+        },
+        timeout=180,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert sorted(out.read_text().split()) == _seq_oracle(cap)
+
+
+def test_epoch_stalled_error_carries_context():
+    err = EpochStalled("stalled", epoch=7, stalled_s=12.5)
+    assert err.epoch == 7 and err.stalled_s == 12.5
+
+
+@pytest.mark.slow
+def test_cluster_chaos_soak_random_faults(tmp_path):
+    # Soak: seeded random delays + crashes on both workers for the
+    # whole run (target ~60s wall), with the stall watchdog armed.
+    # Asserts no deadlock (the subprocess finishes inside the
+    # timeout), that chaos actually happened (restarts in stderr), and
+    # exactly-once output despite an unknown number of restarts.
+    cap = 800
+    res, out = _run_seq_cluster(
+        tmp_path,
+        "soak",
+        cap,
+        {
+            "CHAOS_PACE_S": "0.03",
+            "BYTEWAX_TPU_FAULTS": "random",
+            "BYTEWAX_TPU_FAULTS_SEED": "1711",
+            "BYTEWAX_TPU_FAULTS_RATE": "0.05",
+            # Wall-clock chaos pacing: roughly a fault every ~6s per
+            # process, crashes about half of them.
+            "BYTEWAX_TPU_FAULTS_MIN_GAP_S": "6",
+            "BYTEWAX_TPU_FAULTS_KINDS": "delay,crash",
+            "BYTEWAX_TPU_FAULT_DELAY_S": "0.02",
+            "BYTEWAX_TPU_MAX_RESTARTS": "10",
+            "BYTEWAX_TPU_RESTART_BACKOFF_S": "0.1",
+            # Burst-scoped budget: a few seconds of healthy running
+            # resets it, so steady paced chaos never exhausts the
+            # supervisor over the whole soak.
+            "BYTEWAX_TPU_RESTART_RESET_S": "4",
+            "BYTEWAX_TPU_EPOCH_STALL_S": "10",
+            "BYTEWAX_TPU_HB_S": "20",
+            # Bound the tail where one process is mid-restart while
+            # its peer is still unwinding: fail a dial fast and let
+            # the supervisor pair the processes back up.
+            "BYTEWAX_TPU_DIAL_TIMEOUT_S": "10",
+        },
+        timeout=280,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert res.stderr.count("supervised restart") >= 2, res.stderr[-3000:]
+    assert sorted(out.read_text().split()) == _seq_oracle(cap)

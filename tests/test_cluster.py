@@ -496,8 +496,23 @@ op.output("out", s, FileSink({out_path!r}))
         stderr=subprocess.DEVNULL,
         start_new_session=True,
     )
-    time.sleep(10)  # cluster forms, snapshots accumulate
-    assert proc.poll() is None, "cluster exited prematurely"
+    # Wait for REAL progress, not wall clock: the replay-bound
+    # assertion below needs every partition's snapshot past the
+    # restart cap (40), so let the cluster write well beyond 2 x 44
+    # rows before killing — a fixed sleep flakes when startup is slow
+    # under suite load.
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, "cluster exited prematurely"
+        try:
+            if len(Path(out_path).read_text().split()) >= 120:
+                break
+        except OSError:
+            pass
+        time.sleep(0.5)
+    else:
+        os.killpg(proc.pid, signal.SIGKILL)
+        raise AssertionError("cluster made no progress before the kill")
     # SIGKILL one WORKER (a child of the spawner), not the spawner.
     children = subprocess.run(
         ["pgrep", "-P", str(proc.pid)], capture_output=True, text=True

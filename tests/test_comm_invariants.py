@@ -68,6 +68,64 @@ def test_no_raw_sends_outside_comm_and_driver():
     )
 
 
+#: Every control-frame kind the clustered driver may put on the mesh.
+#: Adding a frame kind REQUIRES updating this list *and* the contract
+#: note in CLAUDE.md: data frames must stay counted
+#: (``deliver``/``route``) and everything else must be legal at the
+#: protocol point it arrives at, or the count-matched epoch barrier /
+#: gsync ordering silently breaks.  (The robustness PR deliberately
+#: added no frame kinds: supervised-restart signaling rides socket
+#: closes plus per-frame generation fencing in engine/comm.py.)
+_CONTROL_FRAMES = {
+    "deliver",
+    "route",
+    "report_msg",
+    "hold",
+    "eof_step",
+    "close_epoch",
+    "gsync",
+    "abort",
+}
+
+
+def test_control_frame_inventory_is_pinned():
+    driver = _strip_comments((PKG / "engine" / "driver.py").read_text())
+    # Only the dispatcher's own kind checks (window specs etc. also
+    # compare a `kind`); scope to the _handle_ctrl body.
+    body = re.search(
+        r"def _handle_ctrl\b.*?(?=\n    def )", driver, re.S
+    ).group(0)
+    handled = set(re.findall(r'kind == "([a-z_]+)"', body))
+    assert handled == _CONTROL_FRAMES, (
+        "the driver's _handle_ctrl frame inventory changed; update "
+        "_CONTROL_FRAMES and re-check the barrier/gsync contract "
+        f"(new: {sorted(handled - _CONTROL_FRAMES)}, "
+        f"gone: {sorted(_CONTROL_FRAMES - handled)})"
+    )
+    # Every broadcast/send in the driver ships one of the pinned
+    # kinds (or a gsync tuple built in global_sync).
+    sent_kinds = set(
+        re.findall(
+            r'(?:broadcast|send)\s*\(\s*(?:\d+\s*,\s*)?\(\s*"([a-z_]+)"',
+            driver,
+        )
+    )
+    assert sent_kinds <= _CONTROL_FRAMES, sorted(
+        sent_kinds - _CONTROL_FRAMES
+    )
+
+
+def test_fault_injector_cannot_send():
+    # The chaos injector may drop/delay/raise at comm sites but must
+    # never originate traffic: a fault that *sends* would bypass the
+    # counted surfaces and corrupt the barrier under test.
+    faults = _strip_comments(
+        (PKG / "engine" / "faults.py").read_text()
+    )
+    assert not re.search(r"\.\s*(?:send|broadcast)\s*\(", faults)
+    assert "Comm(" not in faults
+
+
 def test_allowlist_is_not_stale():
     # The contract check above is only meaningful while its allowed
     # call sites actually exist; fail loudly if a refactor moves them.

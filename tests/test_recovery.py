@@ -286,6 +286,97 @@ def test_fold_final_resume_mid_stream_keeps_state(recovery_config):
     assert out == [("a", 3)]
 
 
+def test_resume_from_inconsistent_commit_watermark(tmp_path):
+    # Store-level coverage of the resume_from() inconsistency check:
+    # a partition whose GC watermark reached (or passed) the computed
+    # resume epoch came from a newer backup than its siblings — resume
+    # must refuse with a message naming the partition, the watermark,
+    # and the resume epoch.
+    import sqlite3
+
+    from bytewax_tpu.engine.recovery_store import RecoveryStore
+
+    init_db_dir(tmp_path, 2)
+    store = RecoveryStore(tmp_path)
+    store.write_ex_started(0, 1, 1)
+    store.write_epoch(0, 1, 1, [], None)
+    store.write_epoch(0, 1, 2, [], None)
+    assert store.resume_from().resume_epoch == 3
+
+    # Poison partition 1 with a commit watermark at the resume epoch
+    # (simulating siblings restored from older backups).
+    con = sqlite3.connect(tmp_path / "part-1.sqlite3")
+    con.execute("INSERT OR REPLACE INTO commits (epoch) VALUES (3)")
+    con.commit()
+    con.close()
+    with pytest.raises(
+        InconsistentPartitionsError,
+        match=(
+            r"partition 1 already garbage-collected state up to "
+            r"epoch 3.*resume epoch is 3.*inconsistent backups"
+        ),
+    ):
+        store.resume_from()
+    store.close()
+
+
+def test_resume_from_commit_watermark_boundary_ok(tmp_path):
+    # The boundary case must NOT raise: a watermark strictly below the
+    # resume epoch is the normal delayed-GC state.
+    import sqlite3
+
+    from bytewax_tpu.engine.recovery_store import RecoveryStore
+
+    init_db_dir(tmp_path, 2)
+    store = RecoveryStore(tmp_path)
+    store.write_ex_started(0, 1, 1)
+    store.write_epoch(0, 1, 1, [], None)
+    store.write_epoch(0, 1, 2, [], None)
+    con = sqlite3.connect(tmp_path / "part-0.sqlite3")
+    con.execute("INSERT OR REPLACE INTO commits (epoch) VALUES (2)")
+    con.commit()
+    con.close()
+    resume = store.resume_from()
+    assert (resume.ex_num, resume.resume_epoch) == (1, 3)
+    store.close()
+
+
+def test_resume_from_lost_exs_row_does_not_constrain(tmp_path):
+    # A worker of the last execution whose exs row was lost (stale
+    # partition restored from backup) must not drag the resume epoch
+    # down to its start epoch; only surviving exs rows constrain the
+    # minimum, and the commit check still guards real inconsistency.
+    import sqlite3
+
+    from bytewax_tpu.engine.recovery_store import RecoveryStore
+
+    init_db_dir(tmp_path, 2)
+    store = RecoveryStore(tmp_path)
+    store.write_ex_started(0, 2, 1)  # workers 0 and 1
+    store.write_epoch(0, 2, 1, [], None)
+    store.write_epoch(0, 2, 5, [], None)
+    assert store.resume_from().resume_epoch == 6
+
+    # Drop worker 1's exs row (it lives in partition 1 % 2).
+    con = sqlite3.connect(tmp_path / "part-1.sqlite3")
+    con.execute("DELETE FROM exs WHERE worker_index = 1")
+    con.commit()
+    con.close()
+    resume = store.resume_from()
+    # Worker 0's frontier still decides; worker 1's orphaned front
+    # row is ignored rather than treated as a brand-new worker at the
+    # start epoch.
+    assert (resume.ex_num, resume.resume_epoch) == (1, 6)
+    store.close()
+
+
+def test_inconsistent_parts_error_wording():
+    # The class docstring is user-facing guidance (it names the
+    # backup_interval knob); pin the wording the engine relies on.
+    assert issubclass(InconsistentPartitionsError, ValueError)
+    assert "backup_interval" in (InconsistentPartitionsError.__doc__ or "")
+
+
 def test_iter_snaps_paginates_latest_per_key(tmp_path):
     # Keyset-paginated snapshot reads: latest epoch wins, discard
     # markers drop the key, step filter applies — identical results
