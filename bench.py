@@ -870,6 +870,19 @@ def main() -> None:
         extra["restart_recovery_s"] = None
         extra["restart_recovery_error"] = str(ex)[:200]
 
+    # Static contract enforcement status: rule count + clean/dirty,
+    # so the trajectory records enforcement growth round over round
+    # (pure AST — never touches jax; see docs/contracts.md).
+    try:
+        from bytewax_tpu.analysis import ALL_RULES, analyze_tree
+
+        diags, _suppressed, _project = analyze_tree()
+        extra["contract_rules"] = len(ALL_RULES)
+        extra["contract_findings"] = len(diags)
+        extra["contracts_clean"] = not diags
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["contracts_error"] = str(ex)[:200]
+
     extra["backend"] = backend
     _note_regressions(extra, xla_rate)
     print(

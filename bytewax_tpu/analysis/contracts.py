@@ -1,0 +1,280 @@
+"""Pinned engine-contract inventories, consumed by the analysis rules.
+
+These tables are the single written-down home of the invariants
+CLAUDE.md and ``docs/contracts.md`` describe: which modules may touch
+the raw cluster-send primitives, which control-frame kinds may ride
+the mesh, which fault sites exist, which driver methods are the
+globally-ordered protocol points, and which methods are the per-batch
+hot path that must never reach a cluster collective.
+
+``tests/test_comm_invariants.py`` pins the values below (so editing
+this file alone cannot silently relax a contract), and the rules in
+:mod:`bytewax_tpu.analysis.rules` enforce them against the real AST.
+Extending an inventory is a deliberate act: update the table here,
+update the pinning test, and re-check the contract note in CLAUDE.md.
+"""
+
+from typing import Dict, FrozenSet, Tuple
+
+# ---------------------------------------------------------------------------
+# BTX-SEND — the cluster send surface
+# ---------------------------------------------------------------------------
+
+#: Fully-qualified name of the cluster mesh class; constructing it is
+#: itself a restricted act (a second mesh would bypass the epoch
+#: barrier's counting entirely).
+COMM_CLASS = "bytewax_tpu.engine.comm.Comm"
+
+#: Modules allowed to use each send primitive.  ``Comm`` construction
+#: and the raw ``send``/``broadcast`` calls belong to the driver/comm
+#: pair only; the routed surfaces (``ship_deliver``/``ship_route``)
+#: are driver-internal.
+SEND_ALLOWED: Dict[str, FrozenSet[str]] = {
+    "comm_construct": frozenset(
+        {"bytewax_tpu.engine.comm", "bytewax_tpu.engine.driver"}
+    ),
+    "raw_send": frozenset(
+        {"bytewax_tpu.engine.comm", "bytewax_tpu.engine.driver"}
+    ),
+    "ship": frozenset({"bytewax_tpu.engine.driver"}),
+}
+
+#: Raw-send method names on a Comm-typed receiver.
+RAW_SEND_METHODS = frozenset({"send", "broadcast"})
+
+#: The driver's routed send surfaces.
+SHIP_METHODS = frozenset({"ship_deliver", "ship_route"})
+
+# ---------------------------------------------------------------------------
+# BTX-FRAMES — the control-frame kind inventory
+# ---------------------------------------------------------------------------
+
+#: Every control-frame kind the clustered driver may put on the mesh.
+#: Data frames must stay counted (``deliver``/``route``) and
+#: everything else must be legal at the protocol point it arrives at,
+#: or the count-matched epoch barrier / gsync ordering silently
+#: breaks.  (The comm layer's heartbeat frame ``_HB`` is swallowed
+#: before delivery and never reaches ``_handle_ctrl``; it is not a
+#: control frame.)
+CONTROL_FRAMES = frozenset(
+    {
+        "deliver",
+        "route",
+        "report_msg",
+        "hold",
+        "eof_step",
+        "close_epoch",
+        "gsync",
+        "abort",
+    }
+)
+
+#: The frame dispatcher whose AST defines the handled-kind inventory.
+FRAME_DISPATCHER = "_handle_ctrl"
+
+# ---------------------------------------------------------------------------
+# BTX-GSYNC — collectives only at globally-ordered points
+# ---------------------------------------------------------------------------
+
+#: The control-plane sync primitives (methods of the driver).  A call
+#: to either — through any alias — is a cluster-collective seed.
+GSYNC_PRIMITIVES = frozenset({"global_sync", "next_gsync_tag"})
+
+#: Modules sanctioned to call the gsync primitives directly (today:
+#: the driver's own protocol points and the global-mesh exchange
+#: tier).  A new collective tier must be added here explicitly after
+#: re-checking the ordering contract.
+GSYNC_CALLER_MODULES = frozenset(
+    {"bytewax_tpu.engine.driver", "bytewax_tpu.engine.sharded_state"}
+)
+
+#: jax cross-device collective primitives (dotted-path suffixes).  A
+#: direct use outside LOCAL_COLLECTIVE_MODULES seeds the reachability
+#: check exactly like a gsync call.
+JAX_COLLECTIVES = frozenset(
+    {
+        "jax.lax.psum",
+        "jax.lax.pmean",
+        "jax.lax.pmax",
+        "jax.lax.pmin",
+        "jax.lax.all_gather",
+        "jax.lax.all_to_all",
+        "jax.lax.ppermute",
+        "jax.lax.psum_scatter",
+        "lax.psum",
+        "lax.pmean",
+        "lax.all_gather",
+        "lax.all_to_all",
+        "lax.ppermute",
+    }
+)
+
+#: Call names that wrap a function for collective execution.
+COLLECTIVE_WRAPPERS = frozenset({"shard_map"})
+
+#: Modules whose collectives run over a mesh of THIS process's local
+#: devices only (single-controller programs): they cannot deadlock
+#: cluster peers, so the per-process sharded tier may run them on
+#: per-batch paths.  The cluster-spanning (global-mesh) tier is NOT
+#: exempt — its entry points are gsync-seeded and caught by
+#: reachability regardless of where the kernels live.
+LOCAL_COLLECTIVE_MODULES = frozenset(
+    {
+        "bytewax_tpu.ops.sharded",
+        "bytewax_tpu.parallel.exchange",
+        "bytewax_tpu.parallel.mesh",
+    }
+)
+
+#: Globally-ordered protocol points in the driver (module, qualname):
+#: run startup (mesh handshake + the unconditional "fcfg" round),
+#: epoch close, and the EOF ladder.  The reachability walk does not
+#: descend into these — collectives under them are sanctioned.
+ORDERED_ENTRY_POINTS: FrozenSet[Tuple[str, str]] = frozenset(
+    {
+        ("bytewax_tpu.engine.driver", "_Driver.run"),
+        ("bytewax_tpu.engine.driver", "_Driver._close_epoch"),
+        ("bytewax_tpu.engine.driver", "_Driver._close_epoch_inner"),
+        ("bytewax_tpu.engine.driver", "_Driver._apply_eof_step"),
+        ("bytewax_tpu.engine.driver", "_Driver.global_sync"),
+    }
+)
+
+#: Operator hooks invoked ONLY from the ordered points above (the
+#: close_epoch broadcast / EOF ladder serialize them): any method
+#: with one of these names is treated as an ordered point too.
+ORDERED_METHOD_NAMES = frozenset({"pre_close", "on_upstream_eof"})
+
+#: Per-batch / per-key hot-path surfaces: any function DEFINITION
+#: with one of these names is a root the reachability walk starts
+#: from.  A cluster collective reachable from one of these deadlocks
+#: the mesh (peers not in the same delivery never enter it).
+PER_BATCH_METHOD_NAMES = frozenset(
+    {
+        "process",
+        "drain",
+        "advance",
+        "poll",
+        "emit",
+        "route",
+        "ship_deliver",
+        "ship_route",
+        "_pump",
+        "_handle_ctrl",
+        "_split_remote",
+        "_split_remote_columnar",
+        "_dispatch_device",
+        "_process_device",
+        "on_batch",
+        "on_batch_columnar",
+        "on_batch_items",
+        "on_notify",
+        "update",
+        "update_batch",
+        "update_items",
+        "update_grouped",
+        "next_batch",
+        "write_batch",
+        "recv_ready",
+        "send",
+        "broadcast",
+    }
+)
+
+# ---------------------------------------------------------------------------
+# BTX-FAULT — the chaos-injection site inventory
+# ---------------------------------------------------------------------------
+
+#: Fully-qualified name of the injector's one entry point.
+FAULT_FIRE = "bytewax_tpu.engine.faults.fire"
+
+#: The injector module itself (may originate no traffic).
+FAULTS_MODULE = "bytewax_tpu.engine.faults"
+
+#: Every site the engine threads a ``fire()`` call through.  Must
+#: equal ``faults.SITES`` (the rule cross-checks the module's AST).
+FAULT_SITES = (
+    "comm.send",
+    "comm.recv",
+    "device_dispatch",
+    "snapshot.write",
+    "snapshot.commit",
+    "barrier",
+)
+
+#: Calls that mutate device-tier state on the dispatch path.  In any
+#: function that fires the ``device_dispatch`` site, the fire must
+#: precede the first of these — a :class:`DeviceFault` is only
+#: retryable because no device state has mutated yet.
+DEVICE_MUTATORS = frozenset(
+    {
+        "_process_device",
+        "_process_accel",
+        "_process_window_accel",
+        "_process_scan_accel",
+        "update",
+        "update_batch",
+        "update_items",
+        "update_grouped",
+        "on_batch",
+        "on_batch_columnar",
+        "on_batch_items",
+        "load",
+        "load_many",
+    }
+)
+
+# ---------------------------------------------------------------------------
+# BTX-SNAPSHOT — cross-tier snapshot interchange
+# ---------------------------------------------------------------------------
+
+#: Factory functions whose returned classes form the device-tier
+#: dispatch table (what ``_StatefulBatchRt.__init__`` installs).
+#: Every class they can return must implement
+#: ``demotion_snapshots()`` so device→host demotion stays closed
+#: under new tiers — except classes marked ``global_exchange = True``
+#: (the collective tier never demotes; it unwinds to the supervisor).
+DEVICE_STATE_FACTORY_NAMES = frozenset(
+    {"make_agg_state", "make_scan_state", "make_state"}
+)
+
+#: The method every demotable device-tier state class must provide.
+DEMOTION_METHOD = "demotion_snapshots"
+
+#: Class attribute marking the collective (never-demoting) tier.
+GLOBAL_EXCHANGE_ATTR = "global_exchange"
+
+# ---------------------------------------------------------------------------
+# BTX-BACKEND — standalone scripts must force a backend
+# ---------------------------------------------------------------------------
+
+#: Entry points that start the engine (and therefore initialize jax).
+RUN_ENTRY_POINTS = frozenset(
+    {
+        "bytewax_tpu.engine.driver.run_main",
+        "bytewax_tpu.engine.driver.cluster_main",
+        "bytewax_tpu.testing.run_main",
+        "bytewax_tpu.testing.cluster_main",
+        "bytewax_tpu.run.cli_main",
+    }
+)
+
+#: Bare call names treated as run entry points inside scripts.
+RUN_ENTRY_NAMES = frozenset({"run_main", "cluster_main", "cli_main"})
+
+#: Helpers that force a backend choice.
+FORCE_HELPERS = frozenset(
+    {
+        "bytewax_tpu.utils.force_platform",
+        "bytewax_tpu.utils.force_cpu_mesh",
+    }
+)
+FORCE_HELPER_NAMES = frozenset({"force_platform", "force_cpu_mesh"})
+
+#: Environment keys whose assignment forces a backend before jax
+#: initializes (the driver reads BYTEWAX_TPU_PLATFORM; jax reads
+#: JAX_PLATFORMS).
+FORCE_ENV_KEYS = frozenset({"BYTEWAX_TPU_PLATFORM", "JAX_PLATFORMS"})
+
+#: jax config flags whose update forces a backend.
+FORCE_JAX_FLAGS = frozenset({"jax_platforms", "jax_platform_name"})

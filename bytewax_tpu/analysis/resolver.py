@@ -1,0 +1,573 @@
+"""Module/attribute resolver and intra-package call graph.
+
+Pure-AST model of the package (no imports are executed, no jax is
+touched): every scanned file becomes a :class:`Module` with its
+import/alias bindings, class table, and function table; every call
+site is resolved through those bindings into either a project entity
+(function/class) or an external dotted path (``jax.lax.psum``).
+
+Resolution sees through the things a regex cannot:
+
+- ``from bytewax_tpu.engine.comm import Comm as C`` then ``C(...)``
+- ``from bytewax_tpu.engine import faults as _f`` then ``_f.fire(...)``
+- method receivers: ``self.agg.flush()`` binds to the classes a
+  factory assigned to ``self.agg`` (attribute-type map built from
+  ``self.X = Factory(...)`` assignments project-wide), and ``self``
+  binds through the enclosing class's MRO.
+
+Method calls with an unknown receiver fall back to *visible* name
+matching: every project method with that name whose defining module
+the caller imports (directly or via a member).  This deliberately
+over-approximates — a contract checker must fail loud on a possible
+edge, not stay quiet on a missed one.
+"""
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "MODULE_QUAL",
+    "body_walk",
+    "CallSite",
+    "ClassInfo",
+    "FunctionInfo",
+    "Module",
+    "Project",
+]
+
+
+#: Qualname of the synthetic function holding a module's top-level
+#: statements (scripts execute these; rules may inspect their calls).
+MODULE_QUAL = "<module>"
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def _walk_pruned(node: ast.AST):
+    """``ast.walk`` that does not descend into nested function/class
+    scopes — the module pseudo-function must only see module-level
+    statements."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, _SCOPE_NODES):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def body_walk(fn: "FunctionInfo"):
+    """Walk a function's body; for the module pseudo-function, prune
+    nested function/class scopes so their statements are not seen
+    twice (they have their own FunctionInfo)."""
+    if fn.qualname == MODULE_QUAL:
+        return _walk_pruned(fn.node)
+    return ast.walk(fn.node)
+
+
+def _dotted_of(node: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` expression -> ``["a", "b", "c"]``; None when the
+    chain is rooted in anything but a plain name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+class CallSite:
+    """One resolved call expression inside a function body."""
+
+    __slots__ = ("node", "lineno", "col", "name", "dotted", "targets")
+
+    def __init__(
+        self,
+        node: ast.Call,
+        name: str,
+        dotted: Optional[str],
+        targets: Set[str],
+    ):
+        self.node = node
+        self.lineno = node.lineno
+        self.col = node.col_offset
+        #: Final callee segment (``fire`` for ``_f.fire(...)``).
+        self.name = name
+        #: Fully resolved dotted path when the whole chain resolved
+        #: through module bindings (``bytewax_tpu.engine.faults.fire``
+        #: or an external path like ``jax.lax.psum``); None for
+        #: method calls on non-module receivers.
+        self.dotted = dotted
+        #: Project function ids (``module:qualname``) this call may
+        #: invoke.
+        self.targets = targets
+
+
+class FunctionInfo:
+    __slots__ = ("module", "qualname", "node", "cls", "calls")
+
+    def __init__(
+        self,
+        module: str,
+        qualname: str,
+        node: ast.AST,
+        cls: Optional[str],
+    ):
+        self.module = module
+        self.qualname = qualname  # "Class.method" or "func"
+        self.node = node
+        self.cls = cls  # owning class name or None
+        self.calls: List[CallSite] = []
+
+    @property
+    def id(self) -> str:
+        return f"{self.module}:{self.qualname}"
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+class ClassInfo:
+    __slots__ = ("module", "name", "node", "bases", "methods", "attrs")
+
+    def __init__(self, module: str, name: str, node: ast.ClassDef):
+        self.module = module
+        self.name = name
+        self.node = node
+        #: Raw base expressions, resolved lazily by Project.mro.
+        self.bases: List[ast.expr] = list(node.bases)
+        self.methods: Dict[str, FunctionInfo] = {}
+        #: Class-level ``name = <constant>`` assignments.
+        self.attrs: Dict[str, object] = {}
+
+    @property
+    def id(self) -> str:
+        return f"{self.module}:{self.name}"
+
+
+class Module:
+    __slots__ = (
+        "name",
+        "path",
+        "rel",
+        "tree",
+        "source",
+        "is_script",
+        "bindings",
+        "functions",
+        "classes",
+        "visible",
+    )
+
+    def __init__(
+        self, name: str, path: Path, source: str, is_script: bool
+    ):
+        self.name = name
+        self.path = path
+        #: Display path used in diagnostics (set by the loader).
+        self.rel = str(path)
+        self.source = source
+        self.tree = ast.parse(source, filename=str(path))
+        self.is_script = is_script
+        #: local name -> dotted target ("jax", "bytewax_tpu.engine.
+        #: comm.Comm", ...), collected from every import statement in
+        #: the file (function-local imports included).
+        self.bindings: Dict[str, str] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        #: Project modules this module imports (or imports members
+        #: of); used to scope name-based method-edge fallbacks.
+        self.visible: Set[str] = set()
+
+
+class Project:
+    """All scanned modules plus the resolved call graph."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, Module] = {}
+        #: ``module:qualname`` -> FunctionInfo
+        self.functions: Dict[str, FunctionInfo] = {}
+        #: ``module:ClassName`` -> ClassInfo
+        self.classes: Dict[str, ClassInfo] = {}
+        #: method name -> ids of every project function with it.
+        self._by_method: Dict[str, Set[str]] = {}
+        #: attribute name -> class ids assigned to ``self.<attr>``
+        #: anywhere in the project (via constructor or factory call).
+        self._attr_types: Dict[str, Set[str]] = {}
+        #: factory function id -> class ids it can return.
+        self._returns_cache: Dict[str, Set[str]] = {}
+
+    # -- loading -----------------------------------------------------------
+
+    @classmethod
+    def load(
+        cls,
+        files: Iterable[Tuple[str, Path, bool]],
+        rel_root: Optional[Path] = None,
+    ) -> "Project":
+        """Build a project from ``(module_name, path, is_script)``
+        triples.  Files that fail to parse raise SyntaxError — a
+        contract checker must not skip unparseable engine code."""
+        proj = cls()
+        for name, path, is_script in files:
+            source = Path(path).read_text()
+            mod = Module(name, Path(path), source, is_script)
+            if rel_root is not None:
+                try:
+                    mod.rel = str(
+                        Path(path).resolve().relative_to(
+                            Path(rel_root).resolve()
+                        )
+                    )
+                except ValueError:
+                    pass
+            proj.modules[name] = mod
+        for mod in proj.modules.values():
+            proj._index_module(mod)
+        for mod in proj.modules.values():
+            proj._compute_visible(mod)
+        proj._build_attr_types()
+        for mod in proj.modules.values():
+            for fn in mod.functions.values():
+                proj._resolve_calls(mod, fn)
+        return proj
+
+    # -- indexing ----------------------------------------------------------
+
+    def _index_module(self, mod: Module) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+                    mod.bindings[local] = target
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # Relative import: resolve against this module's
+                    # package path.
+                    pkg = mod.name.split(".")
+                    pkg = pkg[: len(pkg) - node.level]
+                    base = ".".join(pkg + ([node.module] if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    mod.bindings[local] = f"{base}.{alias.name}"
+
+        def index_fn(
+            node: ast.AST, qual: str, cls: Optional[ClassInfo]
+        ) -> None:
+            fn = FunctionInfo(
+                mod.name, qual, node, cls.name if cls else None
+            )
+            mod.functions[qual] = fn
+            self.functions[fn.id] = fn
+            self._by_method.setdefault(fn.name, set()).add(fn.id)
+            if cls is not None:
+                cls.methods[fn.name] = fn
+
+        # Module-level statements as a pseudo-function: scripts
+        # execute these, and rules need their call sites resolved.
+        index_fn(mod.tree, MODULE_QUAL, None)
+
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                index_fn(node, node.name, None)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(mod.name, node.name, node)
+                mod.classes[node.name] = ci
+                self.classes[ci.id] = ci
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        index_fn(sub, f"{node.name}.{sub.name}", ci)
+                    elif isinstance(sub, ast.Assign):
+                        for tgt in sub.targets:
+                            if isinstance(tgt, ast.Name) and isinstance(
+                                sub.value, ast.Constant
+                            ):
+                                ci.attrs[tgt.id] = sub.value.value
+
+    def _compute_visible(self, mod: Module) -> None:
+        mod.visible.add(mod.name)
+        for target in mod.bindings.values():
+            # Longest project-module prefix of the bound dotted path.
+            parts = target.split(".")
+            for i in range(len(parts), 0, -1):
+                prefix = ".".join(parts[:i])
+                if prefix in self.modules:
+                    mod.visible.add(prefix)
+                    break
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve_dotted(
+        self, mod: Module, node: ast.AST
+    ) -> Optional[str]:
+        """Resolve an ``a.b.c`` expression through the module's
+        bindings into a dotted path.  The result may name a project
+        entity or an external one (``jax.lax.psum``)."""
+        parts = _dotted_of(node)
+        if parts is None:
+            return None
+        head, rest = parts[0], parts[1:]
+        bound = mod.bindings.get(head)
+        if bound is not None:
+            return ".".join([bound] + rest)
+        if head in mod.classes or head in mod.functions:
+            return ".".join([mod.name, head] + rest)
+        # Unbound head (a local, ``self``, a builtin): not a dotted
+        # path — method-receiver analysis handles it instead.
+        return None
+
+    def lookup(self, dotted: str) -> Optional[Tuple[str, str]]:
+        """Map a dotted path to a project entity: ``("func", id)``,
+        ``("class", id)``, or ``("module", name)``."""
+        if dotted in self.modules:
+            return ("module", dotted)
+        if "." not in dotted:
+            return None
+        mod_name, _, attr = dotted.rpartition(".")
+        mod = self.modules.get(mod_name)
+        if mod is None:
+            return None
+        if attr in mod.classes:
+            return ("class", f"{mod_name}:{attr}")
+        if attr in mod.functions:
+            return ("func", f"{mod_name}:{attr}")
+        return None
+
+    def mro(self, class_id: str) -> List[ClassInfo]:
+        """Best-effort linearization: the class followed by its
+        resolved project bases, depth-first."""
+        out: List[ClassInfo] = []
+        seen: Set[str] = set()
+
+        def visit(cid: str) -> None:
+            if cid in seen:
+                return
+            seen.add(cid)
+            ci = self.classes.get(cid)
+            if ci is None:
+                return
+            out.append(ci)
+            mod = self.modules[ci.module]
+            for base in ci.bases:
+                dotted = self.resolve_dotted(mod, base)
+                if dotted is None:
+                    continue
+                ent = self.lookup(dotted)
+                if ent is not None and ent[0] == "class":
+                    visit(ent[1])
+
+        visit(class_id)
+        return out
+
+    def class_method(
+        self, class_id: str, name: str
+    ) -> Optional[FunctionInfo]:
+        for ci in self.mro(class_id):
+            fn = ci.methods.get(name)
+            if fn is not None:
+                return fn
+        return None
+
+    def class_attr(self, class_id: str, name: str) -> object:
+        for ci in self.mro(class_id):
+            if name in ci.attrs:
+                return ci.attrs[name]
+        return None
+
+    def returned_classes(
+        self, func_id: str, _depth: int = 0
+    ) -> Set[str]:
+        """Class ids a factory function can return (following
+        factory→factory calls two levels deep)."""
+        cached = self._returns_cache.get(func_id)
+        if cached is not None:
+            return cached
+        self._returns_cache[func_id] = set()  # cycle guard
+        out: Set[str] = set()
+        fn = self.functions.get(func_id)
+        if fn is None or _depth > 3:
+            return out
+        mod = self.modules[fn.module]
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            val = node.value
+            if not isinstance(val, ast.Call):
+                continue
+            dotted = self.resolve_dotted(mod, val.func)
+            if dotted is None:
+                continue
+            ent = self.lookup(dotted)
+            if ent is None:
+                continue
+            kind, ident = ent
+            if kind == "class":
+                out.add(ident)
+            elif kind == "func":
+                out |= self.returned_classes(ident, _depth + 1)
+        self._returns_cache[func_id] = out
+        return out
+
+    def _build_attr_types(self) -> None:
+        """``self.X = Ctor(...)`` / ``self.X = factory(...)`` across
+        the project -> attribute name X may hold those classes."""
+        for fn in self.functions.values():
+            mod = self.modules[fn.module]
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                dotted = self.resolve_dotted(mod, node.value.func)
+                if dotted is None:
+                    continue
+                ent = self.lookup(dotted)
+                if ent is None:
+                    continue
+                kind, ident = ent
+                classes: Set[str] = set()
+                if kind == "class":
+                    classes = {ident}
+                elif kind == "func":
+                    classes = self.returned_classes(ident)
+                if not classes:
+                    continue
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        self._attr_types.setdefault(
+                            tgt.attr, set()
+                        ).update(classes)
+
+    # -- call graph --------------------------------------------------------
+
+    def _local_var_types(
+        self, mod: Module, fn: FunctionInfo
+    ) -> Dict[str, Set[str]]:
+        """``x = Ctor(...)`` / ``x = factory(...)`` locals."""
+        out: Dict[str, Set[str]] = {}
+        for node in body_walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            dotted = self.resolve_dotted(mod, node.value.func)
+            if dotted is None:
+                continue
+            ent = self.lookup(dotted)
+            if ent is None:
+                continue
+            kind, ident = ent
+            classes: Set[str] = set()
+            if kind == "class":
+                classes = {ident}
+            elif kind == "func":
+                classes = self.returned_classes(ident)
+            if not classes:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.setdefault(tgt.id, set()).update(classes)
+        return out
+
+    def _resolve_calls(self, mod: Module, fn: FunctionInfo) -> None:
+        local_types = self._local_var_types(mod, fn)
+        for node in body_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            targets: Set[str] = set()
+            dotted = self.resolve_dotted(mod, callee)
+            name = (
+                callee.attr
+                if isinstance(callee, ast.Attribute)
+                else callee.id
+                if isinstance(callee, ast.Name)
+                else ""
+            )
+            if not name:
+                continue
+            if dotted is not None:
+                ent = self.lookup(dotted)
+                if ent is not None:
+                    kind, ident = ent
+                    if kind == "func":
+                        targets.add(ident)
+                    elif kind == "class":
+                        # Construction: edge into __init__ if defined.
+                        init = self.class_method(ident, "__init__")
+                        if init is not None:
+                            targets.add(init.id)
+            if not targets and isinstance(callee, ast.Attribute):
+                targets = self._method_targets(
+                    mod, fn, callee, local_types
+                )
+            fn.calls.append(CallSite(node, name, dotted, targets))
+
+    def _method_targets(
+        self,
+        mod: Module,
+        fn: FunctionInfo,
+        callee: ast.Attribute,
+        local_types: Dict[str, Set[str]],
+    ) -> Set[str]:
+        name = callee.attr
+        recv = callee.value
+        candidates: Set[str] = set()
+        # self.m() -> enclosing class MRO.
+        if isinstance(recv, ast.Name) and recv.id == "self" and fn.cls:
+            target = self.class_method(f"{fn.module}:{fn.cls}", name)
+            if target is not None:
+                return {target.id}
+        # typed local: x = Ctor(...); x.m()
+        if isinstance(recv, ast.Name) and recv.id in local_types:
+            for cid in local_types[recv.id]:
+                target = self.class_method(cid, name)
+                if target is not None:
+                    candidates.add(target.id)
+            if candidates:
+                return candidates
+        # typed attribute: self.agg.m() / driver.agg.m() via the
+        # project-wide attribute-type map.
+        if isinstance(recv, ast.Attribute):
+            for cid in self._attr_types.get(recv.attr, ()):
+                target = self.class_method(cid, name)
+                if target is not None:
+                    candidates.add(target.id)
+            if candidates:
+                return candidates
+        # Fallback: every visible project method with this name.
+        for fid in self._by_method.get(name, ()):  # pragma: no branch
+            target = self.functions[fid]
+            if target.cls is None:
+                continue  # bare functions resolve via dotted paths
+            if target.module in mod.visible:
+                candidates.add(fid)
+        return candidates
+
+    # -- convenience for rules --------------------------------------------
+
+    def functions_named(self, name: str) -> List[FunctionInfo]:
+        return [
+            self.functions[fid]
+            for fid in sorted(self._by_method.get(name, ()))
+        ]
+
+    def iter_functions(self) -> Sequence[FunctionInfo]:
+        return list(self.functions.values())

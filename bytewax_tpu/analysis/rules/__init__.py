@@ -1,0 +1,46 @@
+"""Rule registry: one module per engine contract.
+
+Each rule module exposes ``RULE_ID`` and ``check(project) ->
+List[Diagnostic]``.  Register new rules here; catalog them in
+``docs/contracts.md``.
+"""
+
+from typing import Callable, Dict, List
+
+from bytewax_tpu.analysis.diagnostics import Diagnostic
+from bytewax_tpu.analysis.resolver import Project
+from bytewax_tpu.analysis.rules import (
+    backend,
+    fault,
+    frames,
+    gsync,
+    send,
+    snapshot,
+)
+
+__all__ = ["ALL_RULES", "run_rules"]
+
+ALL_RULES: Dict[str, Callable[[Project], List[Diagnostic]]] = {
+    send.RULE_ID: send.check,
+    gsync.RULE_ID: gsync.check,
+    frames.RULE_ID: frames.check,
+    fault.RULE_ID: fault.check,
+    snapshot.RULE_ID: snapshot.check,
+    backend.RULE_ID: backend.check,
+}
+
+
+def run_rules(
+    project: Project, rule_ids=None
+) -> List[Diagnostic]:
+    wanted = list(ALL_RULES) if rule_ids is None else list(rule_ids)
+    out: List[Diagnostic] = []
+    for rid in wanted:
+        try:
+            checker = ALL_RULES[rid]
+        except KeyError:
+            raise KeyError(
+                f"unknown rule {rid!r}; known: {sorted(ALL_RULES)}"
+            ) from None
+        out.extend(checker(project))
+    return sorted(out, key=Diagnostic.sort_key)

@@ -1,0 +1,191 @@
+"""Shared helpers for the contract rules."""
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from bytewax_tpu.analysis import contracts
+from bytewax_tpu.analysis.resolver import (
+    FunctionInfo,
+    Module,
+    Project,
+    body_walk,
+)
+
+__all__ = [
+    "comm_receiver_events",
+    "const_str_arg",
+    "local_aliases",
+]
+
+
+def const_str_arg(call: ast.Call, index: int = 0) -> Optional[str]:
+    """The call's positional arg at ``index`` when it is a string
+    literal."""
+    if len(call.args) > index and isinstance(
+        call.args[index], ast.Constant
+    ):
+        val = call.args[index].value
+        if isinstance(val, str):
+            return val
+    return None
+
+
+def local_aliases(
+    fn: FunctionInfo, is_source: "callable"
+) -> Set[str]:
+    """Names assigned (anywhere in ``fn``) from an expression the
+    predicate tags — e.g. ``c = self.comm`` with a predicate matching
+    ``*.comm``.  Chained re-aliasing (``d = c``) is followed until a
+    fixpoint, so a rename chain cannot smuggle the value past a
+    rule."""
+    tagged: Set[str] = set()
+    assigns: List[Tuple[str, ast.expr]] = []
+    for node in body_walk(fn):
+        if isinstance(node, ast.Assign):
+            # Every target of a (possibly chained) assignment:
+            # ``c = d = self.comm`` tags both names.
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    assigns.append((tgt.id, node.value))
+    changed = True
+    while changed:
+        changed = False
+        for name, value in assigns:
+            if name in tagged:
+                continue
+            if is_source(value) or (
+                isinstance(value, ast.Name) and value.id in tagged
+            ):
+                tagged.add(name)
+                changed = True
+    return tagged
+
+
+def _comm_attr_names(project: Project) -> Set[str]:
+    """Attribute names that hold the Comm object (``self.comm`` by
+    convention, plus anything assigned FROM a comm-denoting
+    expression anywhere in the project, to a fixpoint:
+    ``self.mesh = driver.comm`` makes ``.mesh`` comm-holding too).
+    Cached on the project object."""
+    cached = getattr(project, "_comm_attr_names_cache", None)
+    if cached is not None:
+        return cached
+    names: Set[str] = {"comm"}
+
+    def denotes_comm(expr: ast.expr, mod: Module) -> bool:
+        if isinstance(expr, ast.Attribute):
+            return expr.attr in names
+        if isinstance(expr, ast.Name):
+            return expr.id in names
+        if isinstance(expr, ast.Call):
+            return (
+                project.resolve_dotted(mod, expr.func)
+                == contracts.COMM_CLASS
+            )
+        return False
+
+    # Fixpoint over attribute names (value expressions can reference
+    # attributes tagged in a later pass).
+    changed = True
+    while changed:
+        changed = False
+        for mod in project.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not denotes_comm(node.value, mod):
+                    continue
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and tgt.attr not in names
+                    ):
+                        names.add(tgt.attr)
+                        changed = True
+    project._comm_attr_names_cache = names
+    return names
+
+
+def _is_comm_expr(
+    project: Project,
+    mod: Module,
+    fn: FunctionInfo,
+    node: ast.expr,
+    aliases: Set[str],
+) -> bool:
+    """Does this expression denote the cluster Comm object?
+
+    True for: a ``Comm(...)`` construction (resolved through
+    imports/aliases), any attribute whose name is comm-holding
+    project-wide (``.comm`` by convention, plus attributes assigned
+    from a comm expression — ``self.mesh = driver.comm``), a name
+    aliased to one of those, a parameter/variable literally named
+    ``comm``, and ``self`` inside :class:`Comm` (or a subclass)."""
+    if isinstance(node, ast.Call):
+        dotted = project.resolve_dotted(mod, node.func)
+        if dotted == contracts.COMM_CLASS:
+            return True
+        ent = project.lookup(dotted) if dotted else None
+        if ent is not None and ent[0] == "class":
+            mro = project.mro(ent[1])
+            return any(
+                f"{ci.module}.{ci.name}" == contracts.COMM_CLASS
+                for ci in mro
+            )
+        return False
+    if isinstance(node, ast.Attribute) and node.attr in _comm_attr_names(
+        project
+    ):
+        return True
+    if isinstance(node, ast.Name):
+        if node.id == "comm" or node.id in aliases:
+            return True
+        if node.id == "self" and fn.cls is not None:
+            mro = project.mro(f"{fn.module}:{fn.cls}")
+            return any(
+                f"{ci.module}.{ci.name}" == contracts.COMM_CLASS
+                for ci in mro
+            )
+    return False
+
+
+def comm_receiver_events(
+    project: Project, mod: Module, fn: FunctionInfo
+) -> Iterable[Tuple[str, ast.Call]]:
+    """Yield ``(kind, call)`` comm events in a function body:
+
+    - ``("comm_construct", call)`` — ``Comm(...)`` construction
+    - ``("raw_send", call)`` — ``send``/``broadcast`` on a
+      Comm-denoting receiver (through any local alias)
+    - ``("ship", call)`` — ``ship_deliver``/``ship_route``
+    """
+    aliases = local_aliases(
+        fn,
+        lambda expr: _is_comm_expr(project, mod, fn, expr, set()),
+    )
+    for node in body_walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        if isinstance(callee, ast.Name) or isinstance(
+            callee, ast.Attribute
+        ):
+            name = (
+                callee.id
+                if isinstance(callee, ast.Name)
+                else callee.attr
+            )
+        else:
+            continue
+        dotted = project.resolve_dotted(mod, callee)
+        if dotted == contracts.COMM_CLASS:
+            yield ("comm_construct", node)
+            continue
+        if name in contracts.SHIP_METHODS:
+            yield ("ship", node)
+            continue
+        if name in contracts.RAW_SEND_METHODS and isinstance(
+            callee, ast.Attribute
+        ):
+            if _is_comm_expr(project, mod, fn, callee.value, aliases):
+                yield ("raw_send", node)

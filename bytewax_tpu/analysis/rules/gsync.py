@@ -1,0 +1,190 @@
+"""BTX-GSYNC — collectives only at globally-ordered points.
+
+``global_sync``/``next_gsync_tag`` (the control-plane sync rounds)
+and cluster-spanning jax collectives are legal ONLY where every
+process performs the same sequence of rounds: run startup, epoch
+close, and the EOF ladder.  A collective reachable from a per-batch /
+per-key path deadlocks the mesh — peers that did not receive the
+same delivery never enter it (the DrJAX mis-placed-collective class
+of bug).
+
+Checks, on the resolved call graph:
+
+1. **Reachability** — starting from every per-batch root (any
+   function DEFINITION named in ``contracts.PER_BATCH_METHOD_NAMES``)
+   walk callees, never descending into the globally-ordered entry
+   points; reaching a collective seed is a finding, reported with a
+   witness chain.  Seeds are calls (through any alias) to the gsync
+   primitives, and direct jax collective / ``shard_map`` use outside
+   the sanctioned local-mesh kernel modules
+   (``contracts.LOCAL_COLLECTIVE_MODULES`` — collectives over a mesh
+   of only-local devices cannot deadlock cluster peers).
+
+2. **Caller allowlist** — direct gsync-primitive calls appear only in
+   ``contracts.GSYNC_CALLER_MODULES``; a new collective tier is added
+   there deliberately, after re-checking the ordering contract.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from bytewax_tpu.analysis import contracts
+from bytewax_tpu.analysis.diagnostics import Diagnostic
+from bytewax_tpu.analysis.resolver import (
+    MODULE_QUAL,
+    FunctionInfo,
+    Project,
+    body_walk,
+)
+from bytewax_tpu.analysis.rules._util import local_aliases
+
+RULE_ID = "BTX-GSYNC"
+
+
+def _is_gsync_source(expr: ast.expr) -> bool:
+    """``helper = self.driver.global_sync`` style alias sources."""
+    return (
+        isinstance(expr, ast.Attribute)
+        and expr.attr in contracts.GSYNC_PRIMITIVES
+    )
+
+
+def _seed_calls(
+    project: Project, fn: FunctionInfo
+) -> List[Tuple[int, str]]:
+    """(lineno, what) for every collective seed in this function."""
+    mod = project.modules[fn.module]
+    aliases = local_aliases(fn, _is_gsync_source)
+    seeds: List[Tuple[int, str]] = []
+    for node in body_walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        name = None
+        if isinstance(callee, ast.Attribute):
+            name = callee.attr
+        elif isinstance(callee, ast.Name):
+            name = callee.id
+        if name is None:
+            continue
+        if name in contracts.GSYNC_PRIMITIVES or (
+            isinstance(callee, ast.Name) and callee.id in aliases
+        ):
+            what = (
+                name
+                if name in contracts.GSYNC_PRIMITIVES
+                else f"{name} (alias of a gsync primitive)"
+            )
+            seeds.append((node.lineno, what))
+            continue
+        if fn.module in contracts.LOCAL_COLLECTIVE_MODULES:
+            continue
+        dotted = project.resolve_dotted(mod, callee) or ""
+        if dotted in contracts.JAX_COLLECTIVES or any(
+            dotted.endswith("." + c) or dotted == c
+            for c in contracts.JAX_COLLECTIVES
+        ):
+            seeds.append((node.lineno, dotted))
+        elif name in contracts.COLLECTIVE_WRAPPERS:
+            seeds.append((node.lineno, name))
+    return seeds
+
+
+def _is_ordered(fn: FunctionInfo) -> bool:
+    if (fn.module, fn.qualname) in contracts.ORDERED_ENTRY_POINTS:
+        return True
+    return fn.name in contracts.ORDERED_METHOD_NAMES
+
+
+def check(project: Project) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+
+    # Per-function seed table (and the caller-allowlist check).
+    seeds: Dict[str, List[Tuple[int, str]]] = {}
+    for fn in project.iter_functions():
+        found = _seed_calls(project, fn)
+        if found:
+            seeds[fn.id] = found
+        mod = project.modules[fn.module]
+        for lineno, what in found:
+            primitive = (
+                what in contracts.GSYNC_PRIMITIVES
+                or "gsync primitive" in what
+            )
+            if (
+                primitive
+                and fn.module not in contracts.GSYNC_CALLER_MODULES
+            ):
+                out.append(
+                    Diagnostic(
+                        RULE_ID,
+                        mod.rel,
+                        lineno,
+                        f"{what} called in {fn.qualname} outside the "
+                        "sanctioned modules "
+                        f"{sorted(contracts.GSYNC_CALLER_MODULES)}; a "
+                        "new collective tier must be added to "
+                        "contracts.GSYNC_CALLER_MODULES after "
+                        "re-checking the ordering contract",
+                    )
+                )
+
+    # Reachability from per-batch roots, never entering ordered
+    # points.  BFS with parent pointers for a witness chain.
+    roots = [
+        fn
+        for fn in project.iter_functions()
+        if fn.qualname != MODULE_QUAL
+        and fn.name in contracts.PER_BATCH_METHOD_NAMES
+        and not _is_ordered(fn)
+    ]
+    for root in roots:
+        witness = _reach_seed(project, root, seeds)
+        if witness is None:
+            continue
+        chain, (lineno, what) = witness
+        mod = project.modules[root.module]
+        via = " -> ".join(f.qualname for f in chain)
+        site = project.modules[chain[-1].module]
+        out.append(
+            Diagnostic(
+                RULE_ID,
+                mod.rel,
+                root.node.lineno,
+                f"per-batch path {root.qualname} reaches collective "
+                f"{what} ({site.rel}:{lineno}) via {via}; collectives "
+                "are legal only at globally-ordered points (run "
+                "startup, epoch close / the EOF ladder)",
+            )
+        )
+    return out
+
+
+def _reach_seed(
+    project: Project,
+    root: FunctionInfo,
+    seeds: Dict[str, List[Tuple[int, str]]],
+) -> Optional[Tuple[List[FunctionInfo], Tuple[int, str]]]:
+    """BFS from ``root``; returns (chain, seed) for the first seed
+    found, or None."""
+    parent: Dict[str, Optional[str]] = {root.id: None}
+    queue = [root.id]
+    while queue:
+        fid = queue.pop(0)
+        fn = project.functions[fid]
+        if fid != root.id and _is_ordered(fn):
+            continue  # sanctioned: do not look inside ordered points
+        if fid in seeds:
+            chain: List[FunctionInfo] = []
+            cur: Optional[str] = fid
+            while cur is not None:
+                chain.append(project.functions[cur])
+                cur = parent[cur]
+            chain.reverse()
+            return chain, seeds[fid][0]
+        for call in fn.calls:
+            for target in call.targets:
+                if target not in parent:
+                    parent[target] = fid
+                    queue.append(target)
+    return None

@@ -1,0 +1,60 @@
+"""BTX-SEND — all data sends ride the sanctioned surfaces.
+
+The epoch barrier's quiescence check counts frames per
+``ship_deliver``/``ship_route`` call; a raw ``Comm.send`` /
+``Comm.broadcast`` anywhere else puts uncounted traffic on the mesh
+and silently breaks the count-matched close.  This rule resolves
+receivers and aliases (``c = self.comm; c.send(...)`` is flagged —
+the regex scan it replaced provably missed that shape) and restricts:
+
+- ``Comm(...)`` construction to ``engine/comm.py`` + ``engine/driver.py``
+- ``send``/``broadcast`` on a Comm-denoting receiver to the same pair
+- ``ship_deliver``/``ship_route`` calls to ``engine/driver.py``
+"""
+
+from typing import List
+
+from bytewax_tpu.analysis import contracts
+from bytewax_tpu.analysis.diagnostics import Diagnostic
+from bytewax_tpu.analysis.resolver import Project
+from bytewax_tpu.analysis.rules._util import comm_receiver_events
+
+RULE_ID = "BTX-SEND"
+
+_WHAT = {
+    "comm_construct": (
+        "Comm construction (a second mesh bypasses the epoch "
+        "barrier's frame counting)"
+    ),
+    "raw_send": (
+        "raw cluster send (route data through ship_deliver/"
+        "ship_route and control metadata through driver.global_sync)"
+    ),
+    "ship": "routed-send surface call (driver-internal)",
+}
+
+_ALLOWED = {
+    "comm_construct": contracts.SEND_ALLOWED["comm_construct"],
+    "raw_send": contracts.SEND_ALLOWED["raw_send"],
+    "ship": contracts.SEND_ALLOWED["ship"],
+}
+
+
+def check(project: Project) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            for kind, call in comm_receiver_events(project, mod, fn):
+                if mod.name in _ALLOWED[kind]:
+                    continue
+                out.append(
+                    Diagnostic(
+                        RULE_ID,
+                        mod.rel,
+                        call.lineno,
+                        f"{_WHAT[kind]} in {fn.qualname}; allowed "
+                        f"modules: "
+                        f"{sorted(_ALLOWED[kind])}",
+                    )
+                )
+    return out

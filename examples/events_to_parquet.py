@@ -122,6 +122,12 @@ stream = op.input("input", flow, FakeWebEventsSource())
 op.output("out", stream, ParquetSink())
 
 if __name__ == "__main__":
+    # Standalone runs must pin a backend before the engine touches
+    # jax — a site hook may pre-register an accelerator whose tunnel
+    # can hang jax init.  The driver honors this env var; setdefault
+    # keeps an operator-chosen platform.
+    os.environ.setdefault("BYTEWAX_TPU_PLATFORM", "cpu")
+
     from bytewax_tpu.testing import run_main
 
     run_main(flow)

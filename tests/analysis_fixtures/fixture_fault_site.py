@@ -1,0 +1,19 @@
+"""BTX-FAULT positive fixture: an unknown fault site, and a device
+mutation ordered before the fire."""
+
+from bytewax_tpu.engine import faults as _faults
+
+
+class SloppyDispatch:
+    def _process_device(self, entries):
+        pass
+
+    def dispatch(self, entries):
+        # Unknown site: evades the pinned inventory.
+        _faults.fire("device_dispatchx", step="s")
+
+    def dispatch_late_fire(self, entries):
+        # Mutates device state BEFORE the fault site: a DeviceFault
+        # raised here would not be retryable.
+        self._process_device(entries)
+        _faults.fire("device_dispatch", step="s")

@@ -2,135 +2,157 @@
 data sends ride ``ship_deliver``/``ship_route`` and all control-plane
 sync rides ``global_sync`` — no module outside ``engine/comm.py`` and
 ``engine/driver.py`` may touch the raw send primitives, or the epoch
-barrier's count-matched quiescence check silently breaks."""
+barrier's count-matched quiescence check silently breaks.
 
-import re
-from pathlib import Path
+Since the analyzer PR this file no longer greps: the checks run on
+:mod:`bytewax_tpu.analysis` — an AST resolver + call graph that sees
+through aliases, ``from``-imports, and method receivers (the old
+regex scan missed ``c = self.comm; c.send(...)``, and its
+``_strip_comments`` helper truncated any line with a ``#`` inside a
+string literal, hiding real calls).  What stays here is the PINNING:
+the inventories live in ``bytewax_tpu/analysis/contracts.py`` as data
+tables the rules consume, and this test hardcodes their expected
+values so editing contracts.py alone cannot silently relax a
+contract.  Extending an inventory requires updating the table AND
+this test AND re-checking the contract note in CLAUDE.md +
+docs/contracts.md.
+"""
 
-PKG = Path(__file__).resolve().parent.parent / "bytewax_tpu"
+import functools
 
-#: Files allowed to use each primitive.  ``Comm`` construction and the
-#: raw ``send``/``broadcast`` calls belong to the driver/comm pair
-#: only; the driver's routed surfaces (``ship_deliver``/``ship_route``)
-#: are likewise driver-internal; ``global_sync``/``next_gsync_tag`` is
-#: the one sanctioned control-plane surface for collective tiers
-#: (today: the global-mesh exchange in ``engine/sharded_state.py``).
-_ALLOWED = {
-    "comm_construct": {"engine/comm.py", "engine/driver.py"},
-    "raw_send": {"engine/comm.py", "engine/driver.py"},
-    "ship": {"engine/driver.py"},
-    "gsync": {"engine/driver.py", "engine/sharded_state.py"},
-}
-
-_PATTERNS = {
-    "comm_construct": re.compile(r"\bComm\s*\("),
-    "raw_send": re.compile(r"\.\s*(?:comm\.)?(?:send|broadcast)\s*\("),
-    "ship": re.compile(r"\bship_(?:deliver|route)\s*\("),
-    "gsync": re.compile(r"\b(?:global_sync|next_gsync_tag)\s*\("),
-}
-
-#: Raw-send shapes that are not the cluster mesh: sockets and HTTP
-#: servers have their own ``send``-ish methods.  Only flag calls that
-#: mention ``comm`` on the receiver or a bare broadcast.
-_RAW_SEND_STRICT = re.compile(
-    r"(?:\bcomm\s*\.\s*(?:send|broadcast)\s*\()"
-    r"|(?:self\s*\.\s*comm\s*\.\s*(?:send|broadcast)\s*\()"
+from bytewax_tpu.analysis import contracts
+from bytewax_tpu.analysis.api import default_roots, discover_files
+from bytewax_tpu.analysis.diagnostics import (
+    Waivers,
+    apply_waivers,
+    format_diagnostics,
 )
+from bytewax_tpu.analysis.resolver import Project
+from bytewax_tpu.analysis.rules import run_rules
 
 
-def _strip_comments(text: str) -> str:
-    return "\n".join(
-        line.split("#", 1)[0] for line in text.splitlines()
+@functools.lru_cache(maxsize=1)
+def _project():
+    # The tree is immutable within a test run; build the call graph
+    # once for all tests in this file.
+    pkg_dir, examples = default_roots()
+    return Project.load(
+        discover_files(pkg_dir, examples), pkg_dir.parent
     )
+
+
+def _check(rule_ids):
+    """Run rules with the documented inline-waiver escape hatch
+    honored, so this file and `python -m bytewax_tpu.analysis` agree
+    on what the contract is."""
+    project = _project()
+    diags = run_rules(project, rule_ids)
+    waivers = {
+        mod.rel: Waivers.parse(mod.source)
+        for mod in project.modules.values()
+    }
+    return apply_waivers(diags, waivers)
 
 
 def test_no_raw_sends_outside_comm_and_driver():
-    violations = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(PKG).as_posix()
-        text = _strip_comments(path.read_text())
-        for lineno, line in enumerate(text.splitlines(), 1):
-            for kind, pat in _PATTERNS.items():
-                matcher = (
-                    _RAW_SEND_STRICT if kind == "raw_send" else pat
-                )
-                if not matcher.search(line):
-                    continue
-                if rel not in _ALLOWED[kind]:
-                    violations.append(
-                        f"{rel}:{lineno}: {kind} ({line.strip()[:80]!r})"
-                    )
-    assert not violations, (
+    diags = _check(["BTX-SEND"])
+    assert not diags, (
         "raw cluster-send primitives used outside the sanctioned "
         "modules (route data through ship_deliver/ship_route and "
         "control metadata through driver.global_sync):\n"
-        + "\n".join(violations)
+        + format_diagnostics(diags)
     )
 
 
-#: Every control-frame kind the clustered driver may put on the mesh.
-#: Adding a frame kind REQUIRES updating this list *and* the contract
-#: note in CLAUDE.md: data frames must stay counted
-#: (``deliver``/``route``) and everything else must be legal at the
-#: protocol point it arrives at, or the count-matched epoch barrier /
-#: gsync ordering silently breaks.  (The robustness PR deliberately
-#: added no frame kinds: supervised-restart signaling rides socket
-#: closes plus per-frame generation fencing in engine/comm.py.)
-_CONTROL_FRAMES = {
-    "deliver",
-    "route",
-    "report_msg",
-    "hold",
-    "eof_step",
-    "close_epoch",
-    "gsync",
-    "abort",
-}
+def test_collectives_only_at_ordered_points():
+    diags = _check(["BTX-GSYNC"])
+    assert not diags, (
+        "collective sync reachable outside the globally-ordered "
+        "points (run startup, epoch close / the EOF ladder):\n"
+        + format_diagnostics(diags)
+    )
 
 
 def test_control_frame_inventory_is_pinned():
-    driver = _strip_comments((PKG / "engine" / "driver.py").read_text())
-    # Only the dispatcher's own kind checks (window specs etc. also
-    # compare a `kind`); scope to the _handle_ctrl body.
-    body = re.search(
-        r"def _handle_ctrl\b.*?(?=\n    def )", driver, re.S
-    ).group(0)
-    handled = set(re.findall(r'kind == "([a-z_]+)"', body))
-    assert handled == _CONTROL_FRAMES, (
-        "the driver's _handle_ctrl frame inventory changed; update "
-        "_CONTROL_FRAMES and re-check the barrier/gsync contract "
-        f"(new: {sorted(handled - _CONTROL_FRAMES)}, "
-        f"gone: {sorted(_CONTROL_FRAMES - handled)})"
-    )
-    # Every broadcast/send in the driver ships one of the pinned
-    # kinds (or a gsync tuple built in global_sync).
-    sent_kinds = set(
-        re.findall(
-            r'(?:broadcast|send)\s*\(\s*(?:\d+\s*,\s*)?\(\s*"([a-z_]+)"',
-            driver,
-        )
-    )
-    assert sent_kinds <= _CONTROL_FRAMES, sorted(
-        sent_kinds - _CONTROL_FRAMES
-    )
+    # The contract values, hardcoded: a drive-by edit to the
+    # contracts tables cannot silently add a frame kind.  Adding one
+    # REQUIRES updating contracts.CONTROL_FRAMES, this set, and the
+    # contract note in CLAUDE.md: data frames must stay counted
+    # (``deliver``/``route``) and everything else must be legal at
+    # the protocol point it arrives at.  (The robustness PR
+    # deliberately added no frame kinds: supervised-restart signaling
+    # rides socket closes plus per-frame generation fencing.)
+    assert contracts.CONTROL_FRAMES == {
+        "deliver",
+        "route",
+        "report_msg",
+        "hold",
+        "eof_step",
+        "close_epoch",
+        "gsync",
+        "abort",
+    }
+    # And the driver's _handle_ctrl AST + every literal frame it
+    # sends agree with that inventory.
+    diags = _check(["BTX-FRAMES"])
+    assert not diags, format_diagnostics(diags)
 
 
-def test_fault_injector_cannot_send():
-    # The chaos injector may drop/delay/raise at comm sites but must
-    # never originate traffic: a fault that *sends* would bypass the
-    # counted surfaces and corrupt the barrier under test.
-    faults = _strip_comments(
-        (PKG / "engine" / "faults.py").read_text()
+def test_fault_site_inventory_is_pinned():
+    assert contracts.FAULT_SITES == (
+        "comm.send",
+        "comm.recv",
+        "device_dispatch",
+        "snapshot.write",
+        "snapshot.commit",
+        "barrier",
     )
-    assert not re.search(r"\.\s*(?:send|broadcast)\s*\(", faults)
-    assert "Comm(" not in faults
+    # Injector originates no traffic; every fire() site is pinned;
+    # device_dispatch fires before any device-state mutation.
+    diags = _check(["BTX-FAULT"])
+    assert not diags, format_diagnostics(diags)
+
+
+def test_send_surface_allowlist_is_pinned():
+    assert contracts.SEND_ALLOWED == {
+        "comm_construct": {
+            "bytewax_tpu.engine.comm",
+            "bytewax_tpu.engine.driver",
+        },
+        "raw_send": {
+            "bytewax_tpu.engine.comm",
+            "bytewax_tpu.engine.driver",
+        },
+        "ship": {"bytewax_tpu.engine.driver"},
+    }
+    assert contracts.GSYNC_CALLER_MODULES == {
+        "bytewax_tpu.engine.driver",
+        "bytewax_tpu.engine.sharded_state",
+    }
 
 
 def test_allowlist_is_not_stale():
-    # The contract check above is only meaningful while its allowed
-    # call sites actually exist; fail loudly if a refactor moves them.
-    driver = (PKG / "engine" / "driver.py").read_text()
-    assert "def ship_deliver" in driver and "def ship_route" in driver
-    assert "def global_sync" in driver
-    sharded = (PKG / "engine" / "sharded_state.py").read_text()
-    assert "global_sync(" in sharded
+    # The contract checks above are only meaningful while their
+    # allowed call sites actually exist; fail loudly if a refactor
+    # moves them.
+    project = _project()
+    driver = "bytewax_tpu.engine.driver"
+    for fn in ("ship_deliver", "ship_route", "global_sync"):
+        assert f"{driver}:_Driver.{fn}" in project.functions
+    sharded = project.modules["bytewax_tpu.engine.sharded_state"]
+    flush = project.functions[
+        "bytewax_tpu.engine.sharded_state:GlobalAggState.flush"
+    ]
+    assert any(
+        call.name in contracts.GSYNC_PRIMITIVES for call in flush.calls
+    ), f"GlobalAggState.flush in {sharded.rel} no longer syncs"
+    # And the resolver really binds the collective chain the GSYNC
+    # rule depends on: pre_close -> GlobalAggState.flush.
+    pre_close = project.functions[
+        f"{driver}:_StatefulBatchRt.pre_close"
+    ]
+    assert any(
+        "GlobalAggState.flush" in t
+        for call in pre_close.calls
+        for t in call.targets
+    ), "call graph lost the pre_close -> global flush edge"

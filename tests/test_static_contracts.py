@@ -1,0 +1,65 @@
+"""Tier-1 wrapper around the engine-contract analyzer: the shipped
+tree must be clean (golden test), via both the API and the CLI entry
+points (``python -m bytewax_tpu.analysis`` is what CI and operators
+run)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+from bytewax_tpu.analysis import analyze_tree
+from bytewax_tpu.analysis.diagnostics import format_diagnostics
+from bytewax_tpu.analysis.rules import ALL_RULES
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_tree_is_clean():
+    diags, suppressed, project = analyze_tree()
+    assert not diags, (
+        "the shipped tree violates an engine contract (see "
+        "docs/contracts.md):\n" + format_diagnostics(diags)
+    )
+    # The committed baseline is empty: nothing should be suppressed.
+    assert suppressed == 0
+    # Sanity: the scan actually covered the engine and the examples.
+    assert "bytewax_tpu.engine.driver" in project.modules
+    assert any(m.startswith("examples.") for m in project.modules)
+
+
+def test_rule_registry_is_complete():
+    assert set(ALL_RULES) == {
+        "BTX-SEND",
+        "BTX-GSYNC",
+        "BTX-FRAMES",
+        "BTX-FAULT",
+        "BTX-SNAPSHOT",
+        "BTX-BACKEND",
+    }
+
+
+def test_cli_exits_zero_on_shipped_tree():
+    res = subprocess.run(
+        [sys.executable, "-m", "bytewax_tpu.analysis"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "clean" in res.stderr
+
+
+def test_cli_exits_nonzero_on_positive_fixture():
+    fixture = (
+        REPO / "tests" / "analysis_fixtures" / "fixture_send_alias.py"
+    )
+    res = subprocess.run(
+        [sys.executable, "-m", "bytewax_tpu.analysis", str(fixture)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "BTX-SEND" in res.stdout
