@@ -11,7 +11,7 @@ API server exposes everything at ``GET /metrics``.
 
 from typing import Dict, Tuple
 
-from prometheus_client import REGISTRY, Counter, Histogram
+from prometheus_client import REGISTRY, Counter, Gauge, Histogram
 from prometheus_client.exposition import generate_latest
 
 __all__ = [
@@ -28,6 +28,8 @@ __all__ = [
     "gsync_round_count",
     "item_inp_count",
     "item_out_count",
+    "pipeline_depth",
+    "pipeline_flush_stall_seconds",
     "step_demotion_count",
     "worker_restart_count",
     "xla_compile_count",
@@ -152,6 +154,21 @@ device_transfer_bytes = Counter(
     "bytewax_device_transfer_bytes",
     "Host<->device bytes moved by the engine's device tier",
     ["direction"],  # h2d | d2h
+)
+
+pipeline_depth = Gauge(
+    "bytewax_pipeline_depth",
+    "Configured asynchronous device-dispatch pipeline depth per "
+    "device-tier step (1 = synchronous lock-step dispatch)",
+    ["step_id"],
+)
+
+pipeline_flush_stall_seconds = Counter(
+    "bytewax_pipeline_flush_stall_seconds",
+    "Seconds the host thread blocked at a pipeline drain point "
+    "(window close, epoch close, snapshot, EOF, demotion) waiting "
+    "for in-flight device work",
+    ["step_id"],
 )
 
 comm_frames = Counter(

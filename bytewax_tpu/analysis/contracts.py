@@ -205,7 +205,10 @@ FAULT_SITES = (
 #: Calls that mutate device-tier state on the dispatch path.  In any
 #: function that fires the ``device_dispatch`` site, the fire must
 #: precede the first of these — a :class:`DeviceFault` is only
-#: retryable because no device state has mutated yet.
+#: retryable because no device state has mutated yet.  The dispatch
+#: pipeline's entry points (``engine/pipeline.py``) count as mutators:
+#: entering the pipeline runs/finalizes device phases, so the fire
+#: must precede them too.
 DEVICE_MUTATORS = frozenset(
     {
         "_process_device",
@@ -221,8 +224,22 @@ DEVICE_MUTATORS = frozenset(
         "on_batch_items",
         "load",
         "load_many",
+        # engine/pipeline.py dispatch-pipeline entry points.
+        "make_room",
+        "push",
+        "submit",
     }
 )
+
+#: The dispatch-pipeline module; BTX-FAULT's reachability component
+#: walks the call graph through it, so fire-before-mutate is proven
+#: across the pipeline indirection, not just lexically.
+PIPELINE_MODULE = "bytewax_tpu.engine.pipeline"
+
+#: Bound on the fire-before-mutate call-graph walk (calls lexically
+#: before a ``device_dispatch`` fire may not REACH a mutator within
+#: this many edges; the engine's real chains are ≤3 deep).
+FAULT_REACH_DEPTH = 6
 
 # ---------------------------------------------------------------------------
 # BTX-SNAPSHOT — cross-tier snapshot interchange

@@ -14,7 +14,13 @@ Three checks grounded in docs/recovery.md:
   :class:`DeviceFault` is only retryable because no device state has
   mutated yet; in any function that fires the ``device_dispatch``
   site, the ``fire()`` call must precede the first device-state
-  mutator call (``contracts.DEVICE_MUTATORS``).
+  mutator call (``contracts.DEVICE_MUTATORS``) — and, since the
+  dispatch pipeline (``engine/pipeline.py``) indirects device phases
+  through ``make_room``/``push``, the check is also *reachability*:
+  a call lexically before the fire may not transitively reach a
+  mutator through the project call graph (bounded by
+  ``contracts.FAULT_REACH_DEPTH``), so routing a fold through a new
+  helper module cannot hide the ordering.
 """
 
 import ast
@@ -39,6 +45,36 @@ def _fire_calls(project, mod, fn):
         )
         if resolved:
             yield call, const_str_arg(call.node, 0)
+
+
+def _mutator_chain(project, call, depth: int) -> Optional[str]:
+    """If ``call`` may transitively invoke a device-state mutator,
+    return a witness chain (``a -> b -> mutator``); else None.  A
+    bounded breadth-first walk over the project call graph — this is
+    what lets the rule see through the dispatch pipeline's
+    indirection instead of trusting function names lexically."""
+    if call.name in contracts.DEVICE_MUTATORS:
+        return call.name
+    seen = set()
+    frontier = [(t, call.name) for t in call.targets]
+    for _ in range(depth):
+        nxt = []
+        for fid, path in frontier:
+            if fid in seen:
+                continue
+            seen.add(fid)
+            fn = project.functions.get(fid)
+            if fn is None:
+                continue
+            for sub in fn.calls:
+                if sub.name in contracts.DEVICE_MUTATORS:
+                    return f"{path} -> {fn.qualname} -> {sub.name}"
+                for t in sub.targets:
+                    nxt.append((t, f"{path} -> {fn.qualname}"))
+        frontier = nxt
+        if not frontier:
+            break
+    return None
 
 
 def _pinned_sites_of(mod) -> Optional[Tuple[str, ...]]:
@@ -139,7 +175,10 @@ def check(project: Project) -> List[Diagnostic]:
                             "FAULT_SITES and faults.SITES together)",
                         )
                     )
-            # Fire-before-mutate on the device-dispatch path.
+            # Fire-before-mutate on the device-dispatch path: no call
+            # lexically before the fire may be — or transitively
+            # reach, e.g. through engine/pipeline.py — a device-state
+            # mutator.
             dispatch_fires = [
                 call
                 for call, site in fires
@@ -151,16 +190,19 @@ def check(project: Project) -> List[Diagnostic]:
                 (c.lineno, c.col) for c in dispatch_fires
             )
             for call in fn.calls:
-                if call.name not in contracts.DEVICE_MUTATORS:
+                if (call.lineno, call.col) >= fire_pos:
                     continue
-                if (call.lineno, call.col) < fire_pos:
+                chain = _mutator_chain(
+                    project, call, contracts.FAULT_REACH_DEPTH
+                )
+                if chain is not None:
                     out.append(
                         Diagnostic(
                             RULE_ID,
                             mod.rel,
                             call.lineno,
-                            f"{fn.qualname} mutates device state "
-                            f"({call.name}) before firing the "
+                            f"{fn.qualname} may mutate device state "
+                            f"(via {chain}) before firing the "
                             "device_dispatch fault site; a "
                             "DeviceFault is only retryable/demotable "
                             "because no device state has mutated yet",

@@ -48,9 +48,12 @@ class VocabMap:
     the caller's ``alloc``.
 
     Grow a vocabulary by passing a NEW (longer) array or list each
-    time; an ndarray mutated in place keeps its identity and skips
-    full re-validation, but the identity fast path spot-checks a
-    sample of entries and raises on a detected in-place rewrite.
+    time.  Validation of the already-seen prefix is by cached length
+    plus a sampled-entry spot-check — O(probes + new suffix) per
+    batch, never a full re-scan of the vocabulary — so a detected
+    rewrite raises, while a rewrite that dodges every sampled entry
+    of a large vocabulary is undefined behavior (the contract was
+    always append-only).
     """
 
     __slots__ = ("vocab", "table", "_ref", "_ref_probe", "_dtype")
@@ -80,11 +83,19 @@ class VocabMap:
         """Install/extend ``vocab``, assign internal ids for new
         externals appearing in ``ids`` (``alloc_many([key_str, ...])
         -> id array``, one call per batch of new keys), and return
-        the unique external ids touched."""
+        the unique external ids touched.
+
+        Validation cost is O(new suffix + probes) per batch, not
+        O(vocabulary): the already-validated prefix is re-checked by
+        its cached length plus the sampled-entry fingerprint (the same
+        spot-check contract the identity fast path always had), so a
+        vocabulary grown by passing ever-longer arrays never pays a
+        full prefix re-scan per batch."""
         same = vocab is self._ref and (
             # Identity only short-circuits full validation for
             # ndarrays (spot-checked below) — a list mutated in place
-            # keeps its identity, so lists re-validate every batch.
+            # keeps its identity, so equal-length lists re-validate
+            # every batch (in-place growth revalidates by probe).
             isinstance(vocab, np.ndarray)
             or len(vocab) == len(self.table)
             and vocab == self.vocab.tolist()
@@ -101,26 +112,43 @@ class VocabMap:
             self.vocab = np.asarray(vocab)
             self.table = np.full(len(self.vocab), -1, dtype=self._dtype)
             self._ref = vocab
-            if isinstance(vocab, np.ndarray):
-                self._ref_probe = self._probe_of(vocab)
+            self._ref_probe = self._probe_of(self.vocab)
         elif not same:
-            arr = np.asarray(vocab)
             prev = len(self.table)
-            if len(arr) < prev or not np.array_equal(
-                arr[:prev], self.vocab[:prev]
-            ):
+            n = len(vocab)
+            ok = n >= prev
+            if ok and prev:
+                # Spot-check the already-validated prefix at sampled
+                # indices instead of re-scanning all of it: O(probes),
+                # not O(vocabulary), per batch.
+                idx = np.linspace(
+                    0, prev - 1, min(prev, self._PROBE_N)
+                ).astype(np.intp)
+                if isinstance(vocab, np.ndarray):
+                    ok = np.array_equal(vocab[idx], self.vocab[idx])
+                else:
+                    ok = all(
+                        vocab[i] == self.vocab[i] for i in idx.tolist()
+                    )
+            if not ok:
                 msg = (
                     "key_vocab must be an append-only extension of the "
                     "vocabulary used by earlier batches of this step"
                 )
                 raise TypeError(msg)
-            if len(arr) > prev:
-                pad = np.full(len(arr) - prev, -1, self._dtype)
-                self.vocab = arr
+            if n > prev:
+                if isinstance(vocab, np.ndarray):
+                    self.vocab = vocab
+                else:
+                    # Convert only the new suffix; the validated
+                    # prefix is already installed.
+                    self.vocab = np.concatenate(
+                        [self.vocab, np.asarray(vocab[prev:])]
+                    )
+                pad = np.full(n - prev, -1, self._dtype)
                 self.table = np.concatenate([self.table, pad])
             self._ref = vocab
-            if isinstance(vocab, np.ndarray):
-                self._ref_probe = self._probe_of(vocab)
+            self._ref_probe = self._probe_of(self.vocab)
         if len(ids):
             mx, mn = int(ids.max()), int(ids.min())
             if mx >= len(self.table) or mn < 0:
@@ -193,6 +221,13 @@ class KeyEncoder:
     #: O(rows × width) narrowing scan+copy per batch — the search is
     #: so shallow that wide compares are cheaper than narrowing.
     _WIDE_SEARCH_MAX_KEYS = 16
+
+    #: With at most this many seen keys, skip binary search entirely:
+    #: one vectorized equality pass per seen key (memcmp-style, no
+    #: insertion-point bookkeeping) beats two searchsorted calls —
+    #: string-keyed low-cardinality streams are the common windowing
+    #: shape, and this roughly halves their per-batch encode cost.
+    _EQ_SCAN_MAX_KEYS = 3
 
     def __init__(self):
         self._sorted: Optional[np.ndarray] = None  # seen keys, sorted
@@ -274,6 +309,24 @@ class KeyEncoder:
             # Never install from an empty batch: its dtype kind is
             # arbitrary and would poison the steady-state fast path.
             return np.empty(0, dtype=np.int64)
+        if (
+            self._sorted is not None
+            and keys.dtype.kind in "SU"
+            and keys.dtype.kind == self._sorted.dtype.kind
+            and len(self._sorted) <= self._EQ_SCAN_MAX_KEYS
+        ):
+            # Tiny seen set: one width-aware equality pass per key.
+            out = np.empty(len(keys), dtype=np.int64)
+            hit = np.zeros(len(keys), dtype=bool)
+            for i in range(len(self._sorted)):
+                m = keys == self._sorted[i]
+                out[m] = self._ids[i]
+                hit |= m
+            if hit.all():
+                return out
+            miss = ~hit
+            out[miss] = self._cold(keys[miss], alloc_many, install=True)
+            return out
         if (
             self._sorted is not None
             and keys.dtype.kind in "SU"

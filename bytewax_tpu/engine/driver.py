@@ -129,6 +129,26 @@ def _max_restarts() -> int:
     return int(os.environ.get("BYTEWAX_TPU_MAX_RESTARTS", "0") or 0)
 
 
+def _enable_compile_cache(cache_dir: str) -> None:
+    """Point jax's persistent compilation cache at ``cache_dir`` so
+    compiled programs survive process restarts: a cold start then
+    deserializes instead of recompiling (an order of magnitude
+    cheaper even on CPU).  Thresholds drop to zero — the engine's
+    kernels are small and fast to compile, exactly the kind the
+    default 1s floor would refuse to cache."""
+    import jax
+
+    for knob, value in (
+        ("jax_compilation_cache_dir", cache_dir),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # noqa: BLE001 — older jax without the knob
+            pass
+
+
 def _supervised(make: Callable[[int], "_Driver"]) -> None:
     """Run a driver under the restart supervisor.
 
@@ -310,6 +330,13 @@ class _OpRt:
         self.driver.route(stream.stream_id, entry)
 
     # -- epoch snapshot hooks ---------------------------------------------
+
+    def pipeline_flush(self) -> None:
+        """Drain this op's device-dispatch pipeline (no-op for ops
+        without one).  The driver calls it before every globally-
+        ordered point that reads state or syncs — epoch close, the
+        EOF ladder — so no snapshot or gsync round can observe a step
+        mid-pipeline."""
 
     def pre_close(self) -> None:
         """Runs at the start of every epoch close, before snapshots —
@@ -583,6 +610,13 @@ class _StatefulBatchRt(_OpRt):
         self._dev_faults = 0
         #: Demotion reason once demoted (also surfaced in /status).
         self.demoted: Optional[str] = None
+        #: Bounded asynchronous dispatch pipeline (device tiers only;
+        #: the collective global-exchange tier stays synchronous).
+        self._pipe = None
+        #: Latest window notify hint, computed by the deferred device
+        #: phase — ``notify_at`` reads worker-owned state, so while
+        #: the pipeline holds work the driver consults this instead.
+        self._wagg_hint: Optional[datetime] = None
         spec = op.conf.get("_accel")
         if driver.accel:
             from bytewax_tpu.engine.scan_accel import ScanAccelSpec
@@ -604,6 +638,23 @@ class _StatefulBatchRt(_OpRt):
                 # Per-row-emitting stateful_map lowering (segmented
                 # device scan over per-key numeric state).
                 self.sagg = spec.make_state()
+        if (
+            self.wagg is not None
+            or self.sagg is not None
+            or (
+                self.agg is not None
+                and not getattr(self.agg, "global_exchange", False)
+            )
+        ):
+            # Asynchronous double-buffered dispatch: batch N+1's
+            # routing/encode overlaps batch N's device phase (fold +
+            # readbacks), which runs on the pipeline's worker.  The
+            # global-exchange tier is excluded: its flush is a cluster
+            # collective and must stay on the globally-ordered path.
+            from bytewax_tpu.engine.pipeline import DevicePipeline
+
+            self._pipe = DevicePipeline(op.step_id)
+            _flight.note_pipeline_depth(op.step_id, self._pipe.depth)
         # Stream resumed states in store pages (never materialize the
         # whole keyed state as one dict — reference pages its resume
         # reads too, src/recovery.rs:817-882).  Device agg state
@@ -630,6 +681,44 @@ class _StatefulBatchRt(_OpRt):
                 self._resched(key, logic)
         if page:
             pager.load_many(page)
+
+    # -- dispatch pipeline -------------------------------------------------
+
+    def _pipe_pending(self) -> bool:
+        return self._pipe is not None and self._pipe.pending()
+
+    def pipeline_flush(self) -> None:
+        """Drain point: block until every in-flight device phase has
+        finalized (emissions routed, touched keys absorbed, notify
+        hints refreshed).  A fault surfacing here propagates exactly
+        like a synchronous device fault would have."""
+        if self._pipe is not None:
+            self._pipe.flush()
+
+    def _pipe_shutdown(self) -> None:
+        if self._pipe is not None:
+            self._pipe.drop_pending()
+            self._pipe.shutdown()
+            self._pipe = None
+
+    pipeline_shutdown = _pipe_shutdown
+
+    def queued(self) -> bool:
+        # In-flight pipeline work counts as queued: the epoch barrier
+        # and EOF ladder must not consider this step drained while a
+        # device phase (and its pending emissions) is outstanding.
+        return super().queued() or self._pipe_pending()
+
+    def drain(self) -> None:
+        if self._pipe is not None:
+            # Completed device phases finalize without blocking, so
+            # emissions keep streaming while the source idles and the
+            # pipeline self-drains within a loop iteration of the
+            # device going quiet.
+            self._pipe.finalize_ready()
+        super().drain()
+
+    # -- host logics -------------------------------------------------------
 
     def _build(self, state: Optional[Any]) -> Any:
         try:
@@ -687,7 +776,25 @@ class _StatefulBatchRt(_OpRt):
             # the hash cache must refresh when the length moves.
             if vocab is not self._vh_ref or len(vocab) != len(self._vh):
                 arr = np.asarray(vocab)
-                self._vh = _route_hashes_of(arr.tolist())
+                prev = len(self._vh) if self._vh is not None else 0
+                if (
+                    prev
+                    and len(arr) >= prev
+                    # Append-only growth (VocabMap enforces it): the
+                    # hashed prefix is reusable — spot-check one
+                    # entry, hash only the new suffix.
+                    and _route_hash(str(arr[prev - 1])) == self._vh[prev - 1]
+                    and _route_hash(str(arr[0])) == self._vh[0]
+                ):
+                    if len(arr) > prev:
+                        self._vh = np.concatenate(
+                            [
+                                self._vh,
+                                _route_hashes_of(arr[prev:].tolist()),
+                            ]
+                        )
+                else:
+                    self._vh = _route_hashes_of(arr.tolist())
                 self._vh_ref = vocab
             ids = batch.numpy("key_id")
             return (self._vh % w_count)[ids]
@@ -788,6 +895,37 @@ class _StatefulBatchRt(_OpRt):
             self.awoken.add(key)
         self._flush(out)
 
+    def _wagg_empty(self) -> bool:
+        """Whether the device windower holds no state — including
+        anything still in flight on the dispatch pipeline (pending
+        device phases imply state; the fold structures they own must
+        not be read from this thread while they run)."""
+        return not self._pipe_pending() and self.wagg.is_empty()
+
+    def _push_window_task(self, late_events, device_phase) -> None:
+        """Route one ingest's deferred device phase (fold + due scan
+        + event construction) through the pipeline; finalize emits the
+        late and close events downstream in submission order."""
+        step_id = self.op.step_id
+
+        def task():
+            try:
+                return device_phase()
+            except DeviceFault:
+                raise
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(step_id, "the device window fold", ex)
+
+        def finalize(res) -> None:
+            closes, hint = res
+            self._wagg_hint = hint
+            self._emit_window_events(late_events + closes)
+
+        if self._pipe is None:
+            finalize(task())
+        else:
+            self._pipe.push(task, finalize)
+
     def _process_window_accel(self, entries: List[Entry]) -> None:
         assert self.wagg is not None
         for i, (_w, items) in enumerate(entries):
@@ -801,12 +939,12 @@ class _StatefulBatchRt(_OpRt):
             ):
                 try:
                     with self._timer("stateful_batch_on_batch").time():
-                        events = self.wagg.on_batch_columnar(items)
+                        late, phase = self.wagg.on_batch_columnar(items)
                 except BaseException as ex:  # noqa: BLE001
                     _reraise(
                         self.op.step_id, "the device window fold", ex
                     )
-                self._emit_window_events(events)
+                self._push_window_task(late, phase)
                 continue
             if isinstance(items, ArrayBatch):
                 items = items.to_pylist()
@@ -819,29 +957,30 @@ class _StatefulBatchRt(_OpRt):
                 # (or, for numeric folds with no state yet, to the
                 # host tier, which re-runs the fold per item with its
                 # own step-qualified errors).
+                ingest = None
                 try:
                     with self._timer("stateful_batch_on_batch").time():
-                        events = self.wagg.on_batch_items(items)
+                        ingest = self.wagg.on_batch_items(items)
                 except NonNumericValues:
-                    events = None
                     if (
                         self.wagg.spec.kind != "count"
-                        and self.wagg.is_empty()
+                        and self._wagg_empty()
                         and not self.logics
                     ):
                         self.wagg = None
+                        self._pipe_shutdown()
                         self.process("up", entries[i:])
                         return
                 except BaseException as ex:  # noqa: BLE001
                     _reraise(
                         self.op.step_id, "the device window fold", ex
                     )
-                if events is not None:
-                    self._emit_window_events(events)
+                if ingest is not None:
+                    self._push_window_task(*ingest)
                     continue
             if (
                 self.wagg.spec.kind != "count"
-                and self.wagg.is_empty()
+                and self._wagg_empty()
                 and not self.logics
             ):
                 # Numeric windowed folds with no native toolchain
@@ -851,6 +990,7 @@ class _StatefulBatchRt(_OpRt):
                 # fall back to the host tier before any device state
                 # exists.
                 self.wagg = None
+                self._pipe_shutdown()
                 self.process("up", entries[i:])
                 return
             keys: List[str] = []
@@ -863,10 +1003,10 @@ class _StatefulBatchRt(_OpRt):
                 continue
             try:
                 with self._timer("stateful_batch_on_batch").time():
-                    events = self.wagg.on_batch(keys, values)
+                    ingest = self.wagg.on_batch(keys, values)
             except BaseException as ex:  # noqa: BLE001
                 _reraise(self.op.step_id, "the device window fold", ex)
-            self._emit_window_events(events)
+            self._push_window_task(*ingest)
 
     def process(self, port: str, entries: List[Entry]) -> None:
         entries = self._split_remote(entries)
@@ -922,7 +1062,13 @@ class _StatefulBatchRt(_OpRt):
         and ``driver.demote_after`` consecutive faults demote this
         step to the host tier for the rest of the execution.  Returns
         True when the device tier handled the delivery; False after a
-        demotion (the caller's host path takes the delivery)."""
+        demotion (the caller's host path takes the delivery).
+
+        With the dispatch pipeline armed, the fault site still fires
+        on this thread BEFORE the delivery enters the pipeline, and a
+        fault surfacing at the ``make_room`` drain point (an in-flight
+        device phase failed) lands in this same retry/demotion
+        handling."""
         while True:
             # Device-tier dispatch: visible as its own span (nested
             # under the per-activation "operator" span) so OTLP traces
@@ -934,6 +1080,18 @@ class _StatefulBatchRt(_OpRt):
             )
             try:
                 _faults.fire("device_dispatch", step=self.op.step_id)
+                if self._pipe is not None:
+                    # Drain point: over-depth device phases finalize
+                    # here, BEFORE this delivery is prepared, so a
+                    # finalizer that demotes the tier to the host path
+                    # (a parked fallback) is observed first.
+                    self._pipe.make_room()
+                    if (
+                        self.wagg is None
+                        and self.agg is None
+                        and self.sagg is None
+                    ):
+                        return False
                 if self.driver.trace_ops:
                     with _span(
                         "device_dispatch", step_id=self.op.step_id
@@ -967,6 +1125,14 @@ class _StatefulBatchRt(_OpRt):
         run on the host tier from here on.  Snapshot formats are
         cross-tier interchangeable, so each device snapshot rebuilds
         a host logic exactly as a recovery resume would."""
+        # Drain the pipeline first: in-flight device phases must fold
+        # and their emissions must route before the state is migrated
+        # (``demotion_snapshots()`` reads the very structures the
+        # worker owns mid-task).  A fault here unwinds to the
+        # supervisor — with the device tier failing repeatedly there
+        # is no safe local recovery beyond the restart path.
+        self.pipeline_flush()
+        self._pipe_shutdown()
         if self.wagg is not None:
             state = self.wagg
             # Keys the device tier touched since the last close must
@@ -976,6 +1142,13 @@ class _StatefulBatchRt(_OpRt):
             state = self.agg
         else:
             state = self.sagg
+        if state is None:
+            # A drained finalizer already fell this step back to the
+            # host tier (and migrated nothing — fallbacks only fire on
+            # empty state); the host path owns it now.
+            self.demoted = reason
+            _flight.note_demotion(self.op.step_id, reason, 0)
+            return
         pairs = state.demotion_snapshots()
         self.wagg = self.agg = self.sagg = None
         migrated = 0
@@ -1002,11 +1175,32 @@ class _StatefulBatchRt(_OpRt):
 
     def _process_accel(self, entries: List[Entry]) -> None:
         assert self.agg is not None
+        if self._pipe is None:
+            # The collective global-exchange tier never pipelines: it
+            # only buffers here (the exchange runs at the globally-
+            # ordered flush), so deferral buys nothing and ordering
+            # must stay exact.
+            self._accel_finalize(self._accel_fold(self.agg, entries))
+            return
+        agg = self.agg
+        self._pipe.push(
+            lambda: self._accel_fold(agg, entries),
+            self._accel_finalize,
+        )
+
+    def _accel_fold(self, agg, entries: List[Entry]):
+        """Device phase of one keyed-aggregation delivery (runs on
+        the pipeline worker when deferred): fold every entry into the
+        slot table.  Returns ``(touched_keys, fallback_rest,
+        parked_error)`` — errors park instead of raising so the
+        finalize step can run the exact host-fallback logic on the
+        main thread, in submission order."""
+        touched_all: List[str] = []
         for i, (_w, items) in enumerate(entries):
             try:
                 with self._timer("stateful_batch_on_batch").time():
                     if isinstance(items, ArrayBatch):
-                        touched = self.agg.update_batch(items)
+                        touched = agg.update_batch(items)
                     else:
                         if not items:
                             continue
@@ -1016,9 +1210,10 @@ class _StatefulBatchRt(_OpRt):
                             # (native kv_encode) — no per-item Python
                             # at the accel boundary.  NonNumericValues
                             # (malformed rows / non-numeric values)
-                            # propagates to the fallback handling
-                            # below; None means no native toolchain.
-                            touched = self.agg.update_items(items)
+                            # parks for the fallback handling in
+                            # _accel_finalize; None means no native
+                            # toolchain.
+                            touched = agg.update_items(items)
                         if touched is None:
                             keys = []
                             values = []
@@ -1026,71 +1221,140 @@ class _StatefulBatchRt(_OpRt):
                                 k, v = _extract_kv(item, self.op.step_id)
                                 keys.append(k)
                                 values.append(v)
-                            touched = self.agg.update(
+                            touched = agg.update(
                                 np.asarray(keys), np.asarray(values)
                             )
-            except NonNumericValues as ex:
-                if getattr(self.agg, "global_exchange", False):
-                    # The global tier's flush is COLLECTIVE: a local
-                    # fallback would leave the peers blocking in the
-                    # exchange forever.  Fail fast with direction
-                    # (the raising process's abort broadcast unblocks
-                    # any peer already waiting in a sync round).
-                    msg = (
-                        f"{ex} — the cluster-wide device exchange "
-                        "cannot fall back per-process; run this flow "
-                        "with BYTEWAX_TPU_GLOBAL_EXCHANGE=0"
-                    )
-                    _reraise(
-                        self.op.step_id,
-                        "the device aggregation",
-                        NonNumericValues(msg),
-                    )
-                if not self.agg.keys() and not self.logics:
-                    # Non-numeric values: permanently fall back to the
-                    # host tier before any device state exists.
-                    self.agg = None
-                    self.process("up", entries[i:])
-                    return
-                _reraise(self.op.step_id, "the device aggregation", ex)
-            except TypeError as ex:
-                _reraise(self.op.step_id, "the device aggregation", ex)
-            self.awoken.update(touched)
+            except (NonNumericValues, TypeError) as ex:
+                return touched_all, entries[i:], ex
+            touched_all.extend(touched)
+        return touched_all, None, None
+
+    def _accel_finalize(self, res) -> None:
+        """Finalize one keyed-aggregation delivery on the main
+        thread: absorb touched keys for snapshot bookkeeping and run
+        the fallback/error handling exactly as the synchronous engine
+        did."""
+        touched, rest, err = res
+        self.awoken.update(touched)
+        if err is None:
+            return
+        if isinstance(err, NonNumericValues):
+            if self.agg is None:
+                # The tier already fell back to the host path while
+                # this phase was in flight (only reachable at depth >
+                # 2); the unfolded remainder takes the host path too.
+                self.process("up", rest)
+                return
+            if getattr(self.agg, "global_exchange", False):
+                # The global tier's flush is COLLECTIVE: a local
+                # fallback would leave the peers blocking in the
+                # exchange forever.  Fail fast with direction
+                # (the raising process's abort broadcast unblocks
+                # any peer already waiting in a sync round).
+                msg = (
+                    f"{err} — the cluster-wide device exchange "
+                    "cannot fall back per-process; run this flow "
+                    "with BYTEWAX_TPU_GLOBAL_EXCHANGE=0"
+                )
+                _reraise(
+                    self.op.step_id,
+                    "the device aggregation",
+                    NonNumericValues(msg),
+                )
+            if (
+                not self._pipe_pending()
+                and not self.agg.keys()
+                and not self.logics
+            ):
+                # Non-numeric values: permanently fall back to the
+                # host tier before any device state exists.  The
+                # pending guard mirrors the scan/window tiers: at
+                # depth > 2 a newer delivery may already be in flight
+                # — its fold implies state, so the silent fallback
+                # becomes the step-qualified error below instead of
+                # dropping it.
+                self.agg = None
+                self._pipe_shutdown()
+                self.process("up", rest)
+                return
+        _reraise(self.op.step_id, "the device aggregation", err)
 
     def _process_scan_accel(self, entries: List[Entry]) -> None:
         assert self.sagg is not None
         for i, (_w, items) in enumerate(entries):
             try:
                 with self._timer("stateful_batch_on_batch").time():
-                    res = self._scan_batch(items)
+                    phase = self._scan_batch(items)
             except NonNumericValues as ex:
-                if not self.sagg.keys() and not self.logics:
+                if (
+                    not self._pipe_pending()
+                    and not self.sagg.keys()
+                    and not self.logics
+                ):
                     # Rows the device scan can't take (non-numeric
                     # values, malformed tuples): permanently fall
                     # back to the host tier before any device state
                     # exists — it re-runs the mapper per item and
                     # raises the step-qualified errors.
                     self.sagg = None
+                    self._pipe_shutdown()
                     self.process("up", entries[i:])
                     return
                 _reraise(self.op.step_id, "the device scan", ex)
             except TypeError as ex:
                 _reraise(self.op.step_id, "the device scan", ex)
-            if res is None:
+            if phase is None:
                 continue
+            self._push_scan_task(phase)
+
+    def _push_scan_task(self, phase) -> None:
+        """Route one delivery's scan phase (segmented device scan +
+        output materialization + emission construction) through the
+        pipeline; finalize emits the per-row outputs downstream."""
+        step_id = self.op.step_id
+
+        def task():
+            try:
+                return phase()
+            except DeviceFault:
+                raise
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(step_id, "the device scan", ex)
+
+        def finalize(res) -> None:
             touched, out_items, uniq, codes = res
             self.awoken.update(touched)
             self._emit_scan(out_items, uniq, codes)
 
+        if self._pipe is None:
+            finalize(task())
+        else:
+            self._pipe.push(task, finalize)
+
     def _scan_batch(self, items: Any):
-        """One delivery through the device scan; returns ``(touched,
-        out_items, uniq_keys, per-row group codes)`` or None for an
-        empty delivery.  Raises NonNumericValues when the rows can't
-        ride the device tier."""
+        """Host phase of one delivery through the device scan:
+        grouping/promotion plus every check that can reject the rows.
+        Returns None for an empty delivery, else a zero-arg device
+        phase producing ``(touched, out_items, uniq_keys, per-row
+        group codes)`` — safe to defer because all
+        :class:`NonNumericValues` conditions are decided HERE, on the
+        caller's thread, before any device state mutates."""
+        from bytewax_tpu.engine.scan_accel import (
+            _batch_keys,
+            _require_numeric,
+        )
+
         sagg = self.sagg
         if isinstance(items, ArrayBatch):
-            touched, emit = sagg.update_batch(items)
-            return touched, emit.items(), emit.uniq, emit.codes
+            keys = _batch_keys(items)
+            values = items._scaled_values()
+            _require_numeric(values)
+
+            def batch_phase():
+                touched, emit = sagg.update(keys, values)
+                return touched, emit.items(), emit.uniq, emit.codes
+
+            return batch_phase
         if not items:
             return None
         if type(items) is list:
@@ -1105,30 +1369,44 @@ class _StatefulBatchRt(_OpRt):
                 except TypeError as ex:
                     raise NonNumericValues(str(ex)) from ex
                 uniq = list(groups)
-                outs = sagg.update_grouped(uniq, lens, vals)
-                try:
-                    out_items = _native_scan_emit(
-                        groups,
-                        tuple(np.ascontiguousarray(o) for o in outs),
-                    )
-                except (TypeError, ValueError):
-                    # A kind emitted a column layout the native
-                    # emitter doesn't take (odd dtype, >8 columns):
-                    # the device state is already updated, so emit in
-                    # Python rather than fail the step — matching the
-                    # no-toolchain behavior for the same flow.
-                    out_items = _py_scan_emit(groups, outs)
-                codes = np.repeat(np.arange(len(lens)), lens)
-                return uniq, out_items, uniq, codes
+
+                def grouped_phase():
+                    outs = sagg.update_grouped(uniq, lens, vals)
+                    try:
+                        out_items = _native_scan_emit(
+                            groups,
+                            tuple(
+                                np.ascontiguousarray(o) for o in outs
+                            ),
+                        )
+                    except (TypeError, ValueError):
+                        # A kind emitted a column layout the native
+                        # emitter doesn't take (odd dtype, >8
+                        # columns): the device state is already
+                        # updated, so emit in Python rather than fail
+                        # the step — matching the no-toolchain
+                        # behavior for the same flow.
+                        out_items = _py_scan_emit(groups, outs)
+                    codes = np.repeat(np.arange(len(lens)), lens)
+                    return uniq, out_items, uniq, codes
+
+                return grouped_phase
         # No native toolchain: per-item promotion, Python emission.
-        keys: List[str] = []
-        values: List[Any] = []
+        keys = []
+        values = []
         for item in items:
             k, v = _extract_kv(item, self.op.step_id)
             keys.append(k)
             values.append(v)
-        touched, emit = sagg.update(np.asarray(keys), np.asarray(values))
-        return touched, emit.items(), emit.uniq, emit.codes
+        keys_arr = np.asarray(keys)
+        vals_arr = np.asarray(values)
+        _require_numeric(vals_arr)
+
+        def item_phase():
+            touched, emit = sagg.update(keys_arr, vals_arr)
+            return touched, emit.items(), emit.uniq, emit.codes
+
+        return item_phase
 
     def _emit_scan(
         self, out_items: List[Any], uniq: List[str], codes: np.ndarray
@@ -1144,9 +1422,20 @@ class _StatefulBatchRt(_OpRt):
             self.emit("down", (d, [out_items[j] for j in idx]))
 
     def advance(self, now: datetime) -> None:
+        if self._pipe is not None:
+            self._pipe.finalize_ready()
         if self.wagg is not None:
-            at = self.wagg.notify_at()
+            # While device phases are in flight, the windower's open
+            # set belongs to the worker — consult the notify hint the
+            # last finalized phase computed instead.
+            if self._pipe_pending():
+                at = self._wagg_hint
+            else:
+                at = self.wagg.notify_at()
             if at is not None and at <= now:
+                # Window close is a drain point: quiesce the pipeline,
+                # then scan/close synchronously as before.
+                self.pipeline_flush()
                 try:
                     with self._timer("stateful_batch_on_notify").time():
                         events = self.wagg.on_notify()
@@ -1176,6 +1465,11 @@ class _StatefulBatchRt(_OpRt):
         self._flush(out)
 
     def pre_close(self) -> None:
+        # Drain the dispatch pipeline before anything collective: no
+        # gsync round may run with this process still mid-pipeline
+        # (the driver also flushes every op before the pre_close pass;
+        # this keeps the step safe if called directly).
+        self.pipeline_flush()
         if self.agg is not None and getattr(
             self.agg, "global_exchange", False
         ):
@@ -1185,6 +1479,10 @@ class _StatefulBatchRt(_OpRt):
                 self.agg.flush()
 
     def on_upstream_eof(self) -> None:
+        # EOF is a drain point: pending device phases must fold and
+        # emit before the EOF emissions below, preserving stream
+        # order.
+        self.pipeline_flush()
         if self.wagg is not None:
             try:
                 with self._timer("stateful_batch_on_eof").time():
@@ -1223,10 +1521,17 @@ class _StatefulBatchRt(_OpRt):
 
     def next_notify_at(self) -> Optional[datetime]:
         if self.wagg is not None:
+            if self._pipe_pending():
+                return self._wagg_hint
             return self.wagg.notify_at()
         return min(self.sched.values()) if self.sched else None
 
     def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
+        # Snapshots only ever read post-flush state: the driver
+        # drains every pipeline before the close (and the cluster
+        # barrier refuses to close while any step reports in-flight
+        # work), so this flush is a no-op backstop.
+        self.pipeline_flush()
         if self.wagg is not None:
             with self._timer("snapshot").time():
                 snaps = self.wagg.snapshots_for(
@@ -1500,6 +1805,14 @@ class _Driver:
 
             force_platform(plat)
 
+        # BYTEWAX_TPU_COMPILE_CACHE=<dir> arms jax's persistent
+        # compilation cache before any backend comes up, so restarts
+        # (supervised recovery, redeploys, bench cold starts) reload
+        # compiled programs from disk instead of recompiling.
+        cache_dir = os.environ.get("BYTEWAX_TPU_COMPILE_CACHE")
+        if cache_dir:
+            _enable_compile_cache(cache_dir)
+
         # Multi-host accelerator pods: BYTEWAX_TPU_DISTRIBUTED=1 runs
         # jax.distributed.initialize before any backend comes up, so
         # each cluster process owns exactly its host's chips (on TPU
@@ -1681,7 +1994,14 @@ class _Driver:
                 self._last_gc = _time.monotonic()
 
     def _close_epoch_inner(self, workers: Optional[range] = None) -> None:
-        # Collective pre-close hooks first: every process reaches this
+        # Dispatch pipelines drain before ANY sync round this close
+        # performs (the pre_close collective flushes, the telemetry
+        # piggyback): no gsync point may be reached with this process
+        # still mid-pipeline.  Normally a no-op — the run loop (and
+        # the cluster barrier's drained check) already quiesced them.
+        for rt in self.rts:
+            rt.pipeline_flush()
+        # Collective pre-close hooks next: every process reaches this
         # point exactly once per epoch (close_epoch broadcast), so
         # global-mesh exchange flushes align across the cluster.
         for rt in self.rts:
@@ -1935,6 +2255,17 @@ class _Driver:
             self.comm.broadcast(("close_epoch", self.epoch, False))
             self._pending_close = (self.epoch, False)
 
+    def _drain_pipelines(self) -> bool:
+        """Flush every step's dispatch pipeline; True when any held
+        in-flight work (callers then re-drain queues before closing
+        the epoch, so the flushed emissions stay in this epoch)."""
+        pending = False
+        for rt in self.rts:
+            if getattr(rt, "_pipe", None) is not None and rt._pipe.pending():
+                pending = True
+                rt.pipeline_flush()
+        return pending
+
     def _status(self) -> Dict[str, Any]:
         """Live ``GET /status`` document (read racily off the API
         server thread — observability, not the epoch protocol)."""
@@ -2091,6 +2422,17 @@ class _Driver:
                     if elapsed >= interval_s and (
                         interval_s > 0 or self._progressed
                     ):
+                        # Quiesce the dispatch pipelines INLINE before
+                        # the close (no new input may sneak in
+                        # between): each flush emits into downstream
+                        # queues, and the drain pass cascades those
+                        # emissions to the sinks so this epoch's
+                        # snapshots cover them; downstream steps may
+                        # push fresh device phases while draining,
+                        # hence the loop.
+                        while self._drain_pipelines():
+                            for rt in self.rts:
+                                rt.drain()
                         self._close_epoch()
                         epoch_started = time.monotonic()
                 else:
@@ -2203,6 +2545,14 @@ class _Driver:
         finally:
             if self._gc_managed:
                 gc.enable()
+            # Stop pipeline workers before the mesh/store teardown: a
+            # clean exit drained them already; a fault unwind waits
+            # for the in-flight task to go quiet (no finalizers run)
+            # so a supervised restart never races a stale worker.
+            for rt in self.rts:
+                shutdown = getattr(rt, "pipeline_shutdown", None)
+                if shutdown is not None:
+                    shutdown()
             if api_server is not None:
                 api_server.shutdown()
             if clustered:

@@ -46,6 +46,8 @@ __all__ = [
     "note_fault",
     "note_fenced",
     "note_gsync",
+    "note_pipeline_depth",
+    "note_pipeline_stall",
     "note_restart",
     "note_transfer",
 ]
@@ -286,6 +288,35 @@ def note_demotion(step_id: str, reason: str, keys: int) -> None:
     RECORDER.record(
         "demotion", step=step_id, reason=reason, keys=keys
     )
+
+
+_pipeline_children: Dict[str, Any] = {}
+
+
+def note_pipeline_depth(step_id: str, depth: int) -> None:
+    """A device-tier step armed its dispatch pipeline at ``depth``
+    (see :mod:`bytewax_tpu.engine.pipeline`)."""
+    from bytewax_tpu._metrics import pipeline_depth
+
+    pipeline_depth.labels(step_id).set(depth)
+    RECORDER.counters["pipeline_depth"] = depth
+    RECORDER.record("pipeline_armed", step=step_id, depth=depth)
+
+
+def note_pipeline_stall(step_id: str, seconds: float) -> None:
+    """The main thread blocked ``seconds`` at a pipeline drain point
+    waiting for in-flight device work to finalize."""
+    child = _pipeline_children.get(step_id)
+    if child is None:
+        from bytewax_tpu._metrics import pipeline_flush_stall_seconds
+
+        with _lock:
+            child = _pipeline_children.setdefault(
+                step_id, pipeline_flush_stall_seconds.labels(step_id)
+            )
+    child.inc(seconds)
+    RECORDER.count("pipeline_flush_stall_seconds", seconds)
+    RECORDER.count("pipeline_flush_stall_count")
 
 
 def note_barrier(seconds: float) -> None:

@@ -334,6 +334,14 @@ class DeviceScanState(ScanUpdates):
                 )
         return out
 
+    def flush(self) -> None:
+        """Block until every dispatched scan has materialized on
+        device (see ``DeviceAggState.flush``)."""
+        if self._fields is not None:
+            import jax
+
+            jax.block_until_ready(self._fields)
+
     def demotion_snapshots(self) -> List[Tuple[str, Any]]:
         """Full-state drain for device→host demotion (see
         ``DeviceAggState.demotion_snapshots``)."""

@@ -283,6 +283,14 @@ class _ShardedSlots:
     def keys(self) -> List[str]:
         return list(self.key_to_kid)
 
+    def flush(self) -> None:
+        """Block until every dispatched exchange step has
+        materialized on the mesh (see ``xla.DeviceAggState.flush``)."""
+        if self._fields is not None:
+            import jax
+
+            jax.block_until_ready(self._fields)
+
     def demotion_snapshots(self) -> List[Tuple[str, Any]]:
         """Full-state drain for device→host demotion (subclasses
         supply ``snapshots_for``); see

@@ -20,6 +20,17 @@ both tiers identically, and the comparison is strict (``ts <
 watermark``; a row exactly at the watermark is on time).
 ``tests/test_window_accel.py::test_window_accel_lateness_boundary``
 pins this.
+
+Pipeline note (docs/performance.md): each ``on_batch*`` call returns
+``(late_events, device_phase)`` — the host phase (vocab sync,
+watermark math, late classification) runs on the caller's thread and
+mutates only host clock state; ``device_phase()`` (the fold
+scatter-combine, the due-window scan against a clock snapshot taken
+at ingest, the close snapshot fetch, and window-event construction)
+is safe to defer onto the engine's dispatch-pipeline worker.  The
+driver runs it inline at pipeline depth 1 — byte-identical to the
+pre-pipeline engine.  ``on_notify``/``on_eof``/``snapshots_for``
+remain synchronous and may only run with the pipeline drained.
 """
 
 from datetime import datetime, timedelta, timezone
@@ -160,6 +171,13 @@ class DeviceWindowAggState:
         # Sticky marker: itemized promotion failed a deterministic
         # check; stop re-trying it every batch.
         self._promote_failed = False
+        # Deferred device phases read the per-key clock as of their
+        # own ingest, so the ingest snapshots it; at pipeline depth 1
+        # the phase runs inline before the clock can move again and
+        # the copy is skipped.
+        from bytewax_tpu.engine.pipeline import pipeline_depth
+
+        self._clock_copies = pipeline_depth() > 1
 
     # -- clock -------------------------------------------------------------
 
@@ -195,13 +213,14 @@ class DeviceWindowAggState:
         self._vocab.sync(ids, vocab, self._key_ids_for)
         return self._vocab.table[ids]
 
-    def on_batch_columnar(self, batch) -> List[Tuple[str, Tuple[int, str, Any]]]:
+    def on_batch_columnar(self, batch):
         """Columnar fast path: a batch with ``"key"`` (strings) or
         dictionary-encoded ``"key_id"`` + ``key_vocab`` and ``"ts"``
         columns (``np.datetime64`` or int64 microseconds since the
         epoch), plus a ``"value"`` column for numeric folds, runs with
         no per-row Python.  Late rows are reported with their value
-        (counting: their timestamp)."""
+        (counting: their timestamp).  Returns ``(late_events,
+        device_phase)`` — see :meth:`_ingest`."""
         if "key_id" in batch.cols and batch.key_vocab is not None:
             kids = self._sync_vocab(
                 batch.numpy("key_id").astype(np.int64), batch.key_vocab
@@ -229,9 +248,7 @@ class DeviceWindowAggState:
     def is_empty(self) -> bool:
         return not self.open_close_us and not self.keys and not self.touched
 
-    def on_batch_items(
-        self, items: List[Any]
-    ) -> Optional[List[Tuple[str, Tuple[int, str, Any]]]]:
+    def on_batch_items(self, items: List[Any]):
         """Itemized promotion: one native pass dictionary-encodes the
         keys of timestamped ``(key, value)`` tuples and extracts
         epoch-us timestamps — ``(key, datetime)`` rows (counts) or
@@ -315,11 +332,10 @@ class DeviceWindowAggState:
         # keeps its .ts); the fold consumes the encoded column.
         return self._ingest(kids, ts_us, _ItemVals(items), fold_vals=vals)
 
-    def on_batch(
-        self, keys: List[str], values: List[Any]
-    ) -> List[Tuple[str, Tuple[int, str, Any]]]:
-        """Fold a batch; returns window events tagged like the host
-        tier's ``_WindowLogic`` ("E" emit / "L" late / "M" meta)."""
+    def on_batch(self, keys: List[str], values: List[Any]):
+        """Fold a batch; window events are tagged like the host tier's
+        ``_WindowLogic`` ("E" emit / "L" late / "M" meta).  Returns
+        ``(late_events, device_phase)`` — see :meth:`_ingest`."""
         spec = self.spec
         kids = self._key_ids_for(keys)
         ts_us = np.fromiter(
@@ -331,10 +347,19 @@ class DeviceWindowAggState:
 
     def _ingest(
         self, kids: np.ndarray, ts_us: np.ndarray, values, fold_vals=None
-    ) -> List[Tuple[str, Tuple[int, str, Any]]]:
-        """``values`` is indexed per late row (original objects where
+    ):
+        """Host phase of one delivery; returns ``(late_events,
+        device_phase)``.
+
+        ``values`` is indexed per late row (original objects where
         available); ``fold_vals`` optionally supplies the numeric fold
-        column when ``values`` is a lazy view rather than an array."""
+        column when ``values`` is a lazy view rather than an array.
+        ``device_phase()`` — the fold, the due-window scan (against
+        the clock as of THIS ingest), and window-event construction —
+        returns ``(close_events, notify_hint)`` and may run deferred
+        on the dispatch pipeline's worker; it touches only the
+        fold/open-window state the pipeline owns between submit and
+        finalize."""
         spec = self.spec
         now_us = datetime.now(timezone.utc).timestamp() * _US
         self.touched.update(
@@ -410,6 +435,7 @@ class DeviceWindowAggState:
             )
 
         ok = ~late_mask
+        kids_ok = ts_ok = vals_ok = None
         if ok.any():
             kids_ok = kids[ok]
             ts_ok = ts_us[ok]
@@ -419,10 +445,24 @@ class DeviceWindowAggState:
                 vals_ok = fold_vals[ok]
             else:
                 vals_ok = np.asarray(values)[ok]  # keep dtype for exact ints
-            self._absorb(kids_ok, ts_ok, vals_ok)
 
-        events.extend(self._close_due(now_us))
-        return events
+        # The deferred phase judges window dues by the watermark as of
+        # THIS ingest: snapshot the clock (the next ingest mutates it
+        # in place on the host thread while the phase may still be in
+        # flight on the pipeline worker).
+        clock = (
+            (self.base_us.copy(), self.sys_at_base.copy())
+            if self._clock_copies
+            else None
+        )
+
+        def device_phase():
+            if kids_ok is not None:
+                self._absorb(kids_ok, ts_ok, vals_ok)
+            closes = self._close_due(now_us, clock=clock)
+            return closes, self.notify_at(clock=clock)
+
+        return events, device_phase
 
     def _late_events(
         self, late_rows: np.ndarray, kids: np.ndarray, ts_us: np.ndarray, values
@@ -476,16 +516,30 @@ class DeviceWindowAggState:
             raise ValueError(msg)
 
         # Expand each row into the (static count of) windows that
-        # contain it, all vectorized.
-        e = np.arange(self.expand, dtype=np.int64)
-        wids = hi[:, None] - e[None, :]  # [n, expand]
-        in_window = (
-            ts_ok[:, None]
-            < spec.align_us + wids * spec.offset_us + spec.length_us
-        )
-        kid_rep = np.broadcast_to(kids_ok[:, None], wids.shape)[in_window]
-        wid_flat = wids[in_window]
-        val_rep = np.broadcast_to(vals_ok[:, None], wids.shape)[in_window]
+        # contain it, all vectorized.  Tumbling windows (expand == 1)
+        # skip the 2-D broadcast entirely: every row is in exactly its
+        # own window (ts < align + hi*offset + length holds by
+        # construction of hi when offset == length), saving five
+        # row-count-sized materializations per batch on the pipeline
+        # worker.
+        if self.expand == 1 and spec.offset_us == spec.length_us:
+            kid_rep = kids_ok
+            wid_flat = hi
+            val_rep = vals_ok
+        else:
+            e = np.arange(self.expand, dtype=np.int64)
+            wids = hi[:, None] - e[None, :]  # [n, expand]
+            in_window = (
+                ts_ok[:, None]
+                < spec.align_us + wids * spec.offset_us + spec.length_us
+            )
+            kid_rep = np.broadcast_to(kids_ok[:, None], wids.shape)[
+                in_window
+            ]
+            wid_flat = wids[in_window]
+            val_rep = np.broadcast_to(vals_ok[:, None], wids.shape)[
+                in_window
+            ]
 
         # Composite (key, window) ids; python work only per NEW
         # composite, per-row mapping is pure numpy.
@@ -530,11 +584,17 @@ class DeviceWindowAggState:
             self._open_cache = (kids, wids, closes)
         return self._open_cache
 
-    def _close_due(self, now_us: float) -> List[Tuple[str, Tuple[int, str, Any]]]:
+    def _close_due(
+        self, now_us: float, clock=None
+    ) -> List[Tuple[str, Tuple[int, str, Any]]]:
         if not self.open_close_us:
             return []
         kids_arr, wids_arr, closes_arr = self._open_arrays()
-        wm = self.base_us[kids_arr] + (now_us - self.sys_at_base[kids_arr])
+        base, sys_at = clock if clock is not None else (
+            self.base_us,
+            self.sys_at_base,
+        )
+        wm = base[kids_arr] + (now_us - sys_at[kids_arr])
         due_rows = np.nonzero(closes_arr <= wm)[0]
         if not len(due_rows):
             return []
@@ -583,17 +643,21 @@ class DeviceWindowAggState:
     def on_eof(self) -> List[Tuple[str, Tuple[int, str, Any]]]:
         return self._close_due(np.inf)
 
-    def notify_at(self) -> Optional[datetime]:
+    def notify_at(self, clock=None) -> Optional[datetime]:
         """System time of the earliest window close: the instant the
         key's watermark reaches the close time."""
         if not self.open_close_us:
             return None
         kids_arr, _wids_arr, closes_arr = self._open_arrays()
-        bases = self.base_us[kids_arr]
+        base, sys_at = clock if clock is not None else (
+            self.base_us,
+            self.sys_at_base,
+        )
+        bases = base[kids_arr]
         finite = np.isfinite(bases)
         if not finite.any():
             return None
-        ats = self.sys_at_base[kids_arr][finite] + (
+        ats = sys_at[kids_arr][finite] + (
             closes_arr[finite] - bases[finite]
         )
         return datetime.fromtimestamp(float(ats.min()) / _US, tz=timezone.utc)
@@ -899,11 +963,17 @@ class DeviceSessionAggState(DeviceWindowAggState):
             del self.session_slots[(kid, wid)]
         return acc
 
-    def _close_due(self, now_us: float) -> List[Tuple[str, Tuple[int, str, Any]]]:
+    def _close_due(
+        self, now_us: float, clock=None
+    ) -> List[Tuple[str, Tuple[int, str, Any]]]:
         if not self.open_close_us:
             return []
         kids_arr, wids_arr, dues_arr = self._open_arrays()
-        wm = self.base_us[kids_arr] + (now_us - self.sys_at_base[kids_arr])
+        base, sys_at = clock if clock is not None else (
+            self.base_us,
+            self.sys_at_base,
+        )
+        wm = base[kids_arr] + (now_us - sys_at[kids_arr])
         # Strict: a session closes when the watermark passes close +
         # gap (host: close_time < watermark - gap), not at equality.
         due_rows = np.nonzero(dues_arr < wm)[0]

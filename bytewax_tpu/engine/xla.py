@@ -586,6 +586,15 @@ class DeviceAggState:
     def keys(self) -> List[str]:
         return [k for k in self.slot_keys if k is not None]
 
+    def flush(self) -> None:
+        """Block until every dispatched fold has materialized on
+        device.  ``update*`` only enqueue under JAX async dispatch;
+        the engine's pipeline (``engine/pipeline.py``) defers all host
+        readbacks to drain points, and this is the state-level wait
+        those drain points (snapshot, demotion, EOF) rest on."""
+        if self._fields is not None:
+            jax.block_until_ready(self._fields)
+
     def demotion_snapshots(self) -> List[Tuple[str, Any]]:
         """Every live key's host-format snapshot — the full-state
         drain the driver uses to demote this step to the host tier

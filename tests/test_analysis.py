@@ -78,6 +78,11 @@ def test_fault_rule_flags_unknown_site_and_late_fire():
     msgs = "\n".join(d.message for d in diags)
     assert "device_dispatchx" in msgs
     assert "before firing" in msgs
+    # The reachability component: a pre-fire call that only REACHES a
+    # mutator through the call graph (the pipeline indirection shape)
+    # is flagged with its witness chain.
+    assert "_spin_helper" in msgs
+    assert "_process_device" in msgs
 
 
 def test_snapshot_rule_flags_missing_demotion_method():
