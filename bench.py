@@ -786,6 +786,100 @@ def _run_restart_recovery():
         faults.reset()
 
 
+def _run_residency_stress(
+    n_rows: int = 100_000, n_keys: int = 4096, budget: int = 64
+):
+    """Key cardinality ≫ budget: a keyed sum over ``n_keys`` keys with
+    ``BYTEWAX_TPU_STATE_BUDGET=budget`` and a disk spill dir, a 90/10
+    hot/cold access mix so evictions AND restores churn throughout.
+
+    Returns ``(events_per_sec, restore_p99_ms, evictions,
+    peak_resident)`` — and ASSERTS the output equals the host oracle
+    (the residency contract: budgeted runs are a memory shape, never
+    a semantics change) and that the resident peak held the budget.
+    """
+    import tempfile
+    from datetime import timedelta
+
+    import numpy as np
+
+    import bytewax_tpu.operators as op
+    from bytewax_tpu import xla
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine import flight
+    from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+    n_rows = int(os.environ.get("BENCH_RESIDENCY_ROWS", n_rows))
+    rng = np.random.RandomState(7)
+    hot = rng.randint(0, 48, size=n_rows)
+    cold = rng.randint(0, n_keys, size=n_rows)
+    take_cold = rng.rand(n_rows) < 0.1
+    key_ids = np.where(take_cold, cold, hot)
+    # Batches far smaller than the budget keep the drain-boundary
+    # budget invariant assertable (docs/state-residency.md).
+    inp = [
+        (f"u{int(k):05d}", int(v))
+        for k, v in zip(key_ids, rng.randint(0, 100, size=n_rows))
+    ]
+
+    env_keys = (
+        "BYTEWAX_TPU_STATE_BUDGET",
+        "BYTEWAX_TPU_HOST_STATE_BUDGET",
+        "BYTEWAX_TPU_SPILL_DIR",
+    )
+    saved = {k: os.environ.get(k) for k in env_keys}
+    main_rec = flight.RECORDER
+    flight.RECORDER = flight.FlightRecorder()
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            os.environ["BYTEWAX_TPU_STATE_BUDGET"] = str(budget)
+            os.environ["BYTEWAX_TPU_HOST_STATE_BUDGET"] = str(
+                budget * 4
+            )
+            os.environ["BYTEWAX_TPU_SPILL_DIR"] = td
+            out = []
+            flow = Dataflow("residency_bench_df")
+            s = op.input(
+                "inp", flow, TestingSource(inp, batch_size=32)
+            )
+            r = op.reduce_final("sum", s, xla.SUM)
+            op.output("out", r, TestingSink(out))
+            t0 = time.perf_counter()
+            run_main(flow, epoch_interval=timedelta(seconds=10))
+            dt = time.perf_counter() - t0
+        sums = {}
+        for k, v in inp:
+            sums[k] = sums.get(k, 0) + v
+        assert sorted(out) == sorted(sums.items()), (
+            "residency-stress output diverged from the host oracle"
+        )
+        rec = flight.RECORDER
+        peak = max(
+            (
+                v
+                for k, v in rec.counters.items()
+                if k.startswith("state_resident_keys_peak[")
+            ),
+            default=0,
+        )
+        assert peak <= budget, (
+            f"resident peak {peak} exceeded budget {budget}"
+        )
+        pct = rec.restore_percentiles()
+        restore_p99_ms = (
+            round(pct[1] * 1e3, 3) if pct is not None else None
+        )
+        evictions = int(rec.counters.get("state_evictions_count", 0))
+        return n_rows / dt, restore_p99_ms, evictions, int(peak)
+    finally:
+        flight.RECORDER = main_rec
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _note_regressions(extra: dict, headline: float) -> None:
     """Compare throughput metrics against the newest committed
     ``BENCH_r*.json`` and record any that dropped >10% — a
@@ -995,6 +1089,20 @@ def main() -> None:
     except Exception as ex:  # noqa: BLE001 - bench must still report
         extra["restart_recovery_s"] = None
         extra["restart_recovery_error"] = str(ex)[:200]
+
+    # Tiered key-state residency under stress (cardinality >> budget;
+    # docs/state-residency.md): throughput with continuous evict/
+    # restore/spill churn, plus restore latency percentiles — the
+    # price of a residency fault.
+    try:
+        res_rate, res_p99, res_evs, res_peak = _run_residency_stress()
+        extra["residency_stress_events_per_sec"] = round(res_rate)
+        extra["residency_restore_p99_ms"] = res_p99
+        extra["residency_evictions"] = res_evs
+        extra["residency_peak_resident"] = res_peak
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["residency_stress_events_per_sec"] = None
+        extra["residency_error"] = str(ex)[:200]
 
     # Static contract enforcement status: rule count + clean/dirty,
     # so the trajectory records enforcement growth round over round

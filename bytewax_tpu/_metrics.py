@@ -30,6 +30,9 @@ __all__ = [
     "item_out_count",
     "pipeline_depth",
     "pipeline_flush_stall_seconds",
+    "state_evictions_count",
+    "state_resident_keys",
+    "state_spill_bytes",
     "step_demotion_count",
     "worker_restart_count",
     "xla_compile_count",
@@ -212,6 +215,35 @@ step_demotion_count = Counter(
     "bytewax_step_demotion_count",
     "Stateful steps demoted from the device tier to the host tier "
     "after consecutive device faults",
+    ["step_id"],
+)
+
+
+# -- key-state residency families ---------------------------------------
+#
+# Fed by the tiered residency manager (``engine/residency.py``): with
+# BYTEWAX_TPU_STATE_BUDGET set, each device-tier step keeps at most
+# that many keys resident on device, evicting cold keys to host RAM
+# and spilling truly cold keys to BYTEWAX_TPU_SPILL_DIR.
+
+state_resident_keys = Gauge(
+    "bytewax_state_resident_keys",
+    "Device-resident keys per stateful step (sampled at the "
+    "residency manager's drain points; bounded by "
+    "BYTEWAX_TPU_STATE_BUDGET when set)",
+    ["step_id"],
+)
+
+state_evictions_count = Counter(
+    "bytewax_state_evictions_count",
+    "Keys evicted from the device tier per step and destination "
+    "tier (host = RAM snapshot cache, disk = spill store)",
+    ["step_id", "tier"],
+)
+
+state_spill_bytes = Counter(
+    "bytewax_state_spill_bytes",
+    "Serialized bytes written to the disk spill store per step",
     ["step_id"],
 )
 

@@ -197,9 +197,19 @@ FAULT_SITES = (
     "comm.send",
     "comm.recv",
     "device_dispatch",
+    "residency_restore",
     "snapshot.write",
     "snapshot.commit",
     "barrier",
+)
+
+#: Sites on the device-dispatch path whose injected fault is a
+#: retryable :class:`DeviceFault`: the fire must precede any
+#: device-state mutation in the firing function (the fire-before-
+#: mutate component below applies to each of these, not just
+#: ``device_dispatch``).
+FAULT_DEVICE_SITES = frozenset(
+    {"device_dispatch", "residency_restore"}
 )
 
 #: Calls that mutate device-tier state on the dispatch path.  In any
@@ -224,6 +234,10 @@ DEVICE_MUTATORS = frozenset(
         "on_batch_items",
         "load",
         "load_many",
+        # engine/residency.py tier-movement surfaces (both rewrite
+        # the slot tables).
+        "extract_keys",
+        "inject_keys",
         # engine/pipeline.py dispatch-pipeline entry points.
         "make_room",
         "push",
@@ -260,6 +274,16 @@ DEMOTION_METHOD = "demotion_snapshots"
 
 #: Class attribute marking the collective (never-demoting) tier.
 GLOBAL_EXCHANGE_ATTR = "global_exchange"
+
+#: The tiered-residency surface (engine/residency.py).  A class
+#: reachable from the dispatch-table factories that implements the
+#: eviction half must implement the restore half — an extracted key
+#: with no way back is stranded state — and the collective
+#: ``global_exchange = True`` tier must implement NEITHER: a
+#: per-process eviction there would desynchronize the collective
+#: step shapes across the cluster.
+RESIDENCY_EXTRACT = "extract_keys"
+RESIDENCY_INJECT = "inject_keys"
 
 # ---------------------------------------------------------------------------
 # BTX-BACKEND — standalone scripts must force a backend

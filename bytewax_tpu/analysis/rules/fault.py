@@ -178,11 +178,14 @@ def check(project: Project) -> List[Diagnostic]:
             # Fire-before-mutate on the device-dispatch path: no call
             # lexically before the fire may be — or transitively
             # reach, e.g. through engine/pipeline.py — a device-state
-            # mutator.
+            # mutator.  Applies to every retryable device-path site
+            # (device_dispatch AND residency_restore): their injected
+            # DeviceFault is only retryable because no device state
+            # has mutated yet.
             dispatch_fires = [
                 call
                 for call, site in fires
-                if site == "device_dispatch"
+                if site in contracts.FAULT_DEVICE_SITES
             ]
             if not dispatch_fires:
                 continue

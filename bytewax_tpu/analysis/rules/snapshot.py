@@ -16,6 +16,12 @@ factory→factory calls), then require each class's MRO to provide
 per-process (peers would block in the exchange forever; it unwinds
 to the supervisor instead), so defining the method there is flagged
 too.
+
+The residency surface (``engine/residency.py``) is checked the same
+way: a reachable class implementing ``extract_keys`` must implement
+``inject_keys`` (an evicted key needs a restore path), and the
+``global_exchange = True`` tier must implement neither (per-process
+eviction would desynchronize the collective step shapes).
 """
 
 from typing import List
@@ -80,6 +86,49 @@ def check(project: Project) -> List[Diagnostic]:
                         "interchange, docs/recovery.md) or mark the "
                         "class global_exchange = True if it is a "
                         "collective tier",
+                    )
+                )
+            # Residency pairing (docs/state-residency.md): the
+            # eviction half without the restore half strands every
+            # extracted key, and the collective tier must expose
+            # neither (a per-process eviction there desynchronizes
+            # the collective step shapes cluster-wide).
+            has_extract = (
+                project.class_method(cid, contracts.RESIDENCY_EXTRACT)
+                is not None
+            )
+            has_inject = (
+                project.class_method(cid, contracts.RESIDENCY_INJECT)
+                is not None
+            )
+            if is_global and (has_extract or has_inject):
+                out.append(
+                    Diagnostic(
+                        RULE_ID,
+                        cls_mod.rel,
+                        ci.node.lineno,
+                        f"{ci.name} is marked global_exchange=True "
+                        "but implements the residency surface "
+                        f"({contracts.RESIDENCY_EXTRACT}/"
+                        f"{contracts.RESIDENCY_INJECT}); the "
+                        "collective tier must never evict "
+                        "per-process — eviction would desynchronize "
+                        "the collective step shapes",
+                    )
+                )
+            elif not is_global and has_extract and not has_inject:
+                out.append(
+                    Diagnostic(
+                        RULE_ID,
+                        cls_mod.rel,
+                        ci.node.lineno,
+                        f"device-tier state class {ci.name} "
+                        f"implements {contracts.RESIDENCY_EXTRACT}() "
+                        f"but no {contracts.RESIDENCY_INJECT}(); an "
+                        "evicted key would have no restore path — "
+                        "implement the inject half (cross-tier "
+                        "snapshot interchange, "
+                        "docs/state-residency.md)",
                     )
                 )
     return out

@@ -169,6 +169,23 @@ class VocabMap:
             )
         return uniq
 
+    def drop_ids(self, internal_ids) -> int:
+        """Forget the external entries mapped to these *internal* ids
+        (back to unassigned): the next :meth:`sync` re-allocs them, so
+        a released internal id can be reused by another key without a
+        stale external mapping folding rows into the wrong slot.
+        Returns how many entries were dropped."""
+        if self.table is None or not len(self.table):
+            return 0
+        mask = np.isin(
+            self.table,
+            np.asarray(list(internal_ids), dtype=self.table.dtype),
+        )
+        n = int(mask.sum())
+        if n:
+            self.table[mask] = -1
+        return n
+
 
 _factorize = None
 

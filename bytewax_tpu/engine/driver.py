@@ -32,6 +32,7 @@ from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
 from bytewax_tpu.errors import DeviceFault, EpochStalled, note_context
 from bytewax_tpu.engine.flatten import Plan, flatten
 from bytewax_tpu.engine.recovery_store import RecoveryStore, ResumeFrom
+from bytewax_tpu.engine.residency import ResidentKeyState, maybe_wrap
 from bytewax_tpu.engine.xla import AccelSpec, DeviceAggState, NonNumericValues
 from bytewax_tpu.inputs import (
     AbortExecution,
@@ -638,6 +639,25 @@ class _StatefulBatchRt(_OpRt):
                 # Per-row-emitting stateful_map lowering (segmented
                 # device scan over per-key numeric state).
                 self.sagg = spec.make_state()
+        # Tiered key-state residency (docs/state-residency.md): with
+        # BYTEWAX_TPU_STATE_BUDGET set, the keyed-aggregation and scan
+        # tiers wrap in a manager that bounds device-resident keys,
+        # evicting cold keys to host snapshots / the disk spill store.
+        # Unset budget returns the state unchanged (byte-identical
+        # engine).  The collective global-exchange tier is excluded
+        # inside maybe_wrap, exactly like demotion; the window tier
+        # exposes extract/inject but is not driver-evicted yet.
+        self.agg = maybe_wrap(op.step_id, self.agg)
+        self.sagg = maybe_wrap(op.step_id, self.sagg)
+        #: The step's residency manager, or None when unbudgeted.
+        self._res: Optional[ResidentKeyState] = next(
+            (
+                s
+                for s in (self.agg, self.sagg)
+                if isinstance(s, ResidentKeyState)
+            ),
+            None,
+        )
         if (
             self.wagg is not None
             or self.sagg is not None
@@ -651,9 +671,24 @@ class _StatefulBatchRt(_OpRt):
             # readbacks), which runs on the pipeline's worker.  The
             # global-exchange tier is excluded: its flush is a cluster
             # collective and must stay on the globally-ordered path.
-            from bytewax_tpu.engine.pipeline import DevicePipeline
+            from bytewax_tpu.engine.pipeline import (
+                DevicePipeline,
+                pipeline_depth,
+            )
 
-            self._pipe = DevicePipeline(op.step_id)
+            # With a residency budget armed the pipeline is capped at
+            # depth 2: _dispatch_device's make_room then fully drains
+            # before each dispatch, so the manager's resident-key
+            # counts (read on this thread in prepare/over_budget) are
+            # never stale against a fold still running on the worker —
+            # at depth >= 3 a pending fold could alloc keys past the
+            # budget unseen.
+            depth = (
+                min(pipeline_depth(), 2)
+                if self._res is not None
+                else None
+            )
+            self._pipe = DevicePipeline(op.step_id, depth=depth)
             _flight.note_pipeline_depth(op.step_id, self._pipe.depth)
         # Stream resumed states in store pages (never materialize the
         # whole keyed state as one dict — reference pages its resume
@@ -1118,6 +1153,17 @@ class _StatefulBatchRt(_OpRt):
                 return False
             else:
                 self._dev_faults = 0
+                if self._res is not None and self._res.over_budget():
+                    # Eviction runs only at a drain point: quiesce the
+                    # in-flight device phases first so no deferred
+                    # fold can reference a reclaimed slot, then demote
+                    # this step's coldest keys off device.  Runs in
+                    # the try's else arm so an eviction-side error is
+                    # never mistaken for a retryable dispatch fault
+                    # (the delivery already folded — a retry would
+                    # double-count it).
+                    self.pipeline_flush()
+                    self._res.evict_to_budget(self.driver.epoch)
                 return True
 
     def _demote(self, reason: str) -> None:
@@ -1150,7 +1196,11 @@ class _StatefulBatchRt(_OpRt):
             _flight.note_demotion(self.op.step_id, reason, 0)
             return
         pairs = state.demotion_snapshots()
+        # demotion_snapshots on a residency-managed state drains EVERY
+        # tier (resident, evicted, spilled); the host logics own the
+        # keys now, so the manager retires with the device state.
         self.wagg = self.agg = self.sagg = None
+        self._res = None
         migrated = 0
         for key, snap in pairs:
             if snap is None:
@@ -1175,6 +1225,17 @@ class _StatefulBatchRt(_OpRt):
 
     def _process_accel(self, entries: List[Entry]) -> None:
         assert self.agg is not None
+        if self._res is not None:
+            # Residency faults resolve BEFORE dispatch, on this
+            # thread: a delivery touching an evicted/spilled key
+            # restores it (behind the pinned residency_restore chaos
+            # site, which fires before any state mutates — a DeviceFault
+            # there unwinds into the retry/demotion handling with the
+            # delivery fully replayable).  Restores flush the pipeline
+            # first; pure touches are dict updates.
+            self._res.prepare_entries(
+                entries, self.driver.epoch, self.pipeline_flush
+            )
         if self._pipe is None:
             # The collective global-exchange tier never pipelines: it
             # only buffers here (the exchange runs at the globally-
@@ -1272,8 +1333,11 @@ class _StatefulBatchRt(_OpRt):
                 # depth > 2 a newer delivery may already be in flight
                 # — its fold implies state, so the silent fallback
                 # becomes the step-qualified error below instead of
-                # dropping it.
+                # dropping it.  (keys() on a residency-managed state
+                # counts evicted/spilled keys too, so the fallback
+                # never strands cold state.)
                 self.agg = None
+                self._res = None
                 self._pipe_shutdown()
                 self.process("up", rest)
                 return
@@ -1281,6 +1345,13 @@ class _StatefulBatchRt(_OpRt):
 
     def _process_scan_accel(self, entries: List[Entry]) -> None:
         assert self.sagg is not None
+        if self._res is not None:
+            # See _process_accel: restore evicted keys before the
+            # delivery dispatches (scan outputs read per-key state, so
+            # the restore must land before the fold).
+            self._res.prepare_entries(
+                entries, self.driver.epoch, self.pipeline_flush
+            )
         for i, (_w, items) in enumerate(entries):
             try:
                 with self._timer("stateful_batch_on_batch").time():
@@ -1295,8 +1366,11 @@ class _StatefulBatchRt(_OpRt):
                     # values, malformed tuples): permanently fall
                     # back to the host tier before any device state
                     # exists — it re-runs the mapper per item and
-                    # raises the step-qualified errors.
+                    # raises the step-qualified errors.  (keys() on a
+                    # residency-managed state counts evicted/spilled
+                    # keys, so cold state blocks the silent fallback.)
                     self.sagg = None
+                    self._res = None
                     self._pipe_shutdown()
                     self.process("up", entries[i:])
                     return
@@ -2279,6 +2353,11 @@ class _Driver:
                 rt.op.step_id: rt.demoted
                 for rt in rts
                 if getattr(rt, "demoted", None)
+            },
+            "residency": {
+                rt.op.step_id: rt._res.status()
+                for rt in rts
+                if getattr(rt, "_res", None) is not None
             },
             "worker_count": self.worker_count,
             "workers": [self.local_lo, self.local_hi],

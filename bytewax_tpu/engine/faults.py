@@ -11,13 +11,14 @@ Plan syntax — comma-separated specs::
     BYTEWAX_TPU_FAULTS="site:kind:epoch[:proc][:xN]"
 
 - ``site``: one of :data:`SITES` (``comm.send``, ``comm.recv``,
-  ``device_dispatch``, ``snapshot.write``, ``snapshot.commit``,
-  ``barrier``).
+  ``device_dispatch``, ``residency_restore``, ``snapshot.write``,
+  ``snapshot.commit``, ``barrier``).
 - ``kind``: ``delay`` (sleep ``BYTEWAX_TPU_FAULT_DELAY_S``, default
   0.05s), ``drop`` (suppress the frame — only meaningful at
   ``comm.send``; breaks the barrier's in-flight accounting on purpose,
   so the stall watchdog must heal it), ``error`` (raise
-  :class:`bytewax_tpu.errors.DeviceFault` at ``device_dispatch``,
+  :class:`bytewax_tpu.errors.DeviceFault` at ``device_dispatch`` and
+  ``residency_restore`` — the retryable device-path sites —
   :class:`InjectedFault` elsewhere), ``crash`` (raise
   :class:`InjectedCrash` — simulated sudden process death: the driver
   unwinds *without* an abort broadcast, so peers discover it exactly
@@ -69,10 +70,17 @@ SITES = (
     "comm.send",
     "comm.recv",
     "device_dispatch",
+    "residency_restore",
     "snapshot.write",
     "snapshot.commit",
     "barrier",
 )
+
+#: Sites on the device-dispatch path: ``kind=error`` raises a
+#: retryable :class:`~bytewax_tpu.errors.DeviceFault` (fired before
+#: any device state mutates — the driver retries the delivery, then
+#: demotes) instead of a plain :class:`InjectedFault`.
+_DEVICE_SITES = ("device_dispatch", "residency_restore")
 
 _KINDS = ("delay", "drop", "error", "crash")
 
@@ -281,11 +289,11 @@ def fire(site: str, **ctx: Any) -> Optional[str]:
         return "drop"
     if kind == "crash":
         raise InjectedCrash(site, kind, _epoch)
-    if site == "device_dispatch":
+    if site in _DEVICE_SITES:
         from bytewax_tpu.errors import DeviceFault
 
         raise DeviceFault(
-            f"injected device fault at epoch {_epoch} "
+            f"injected device fault at {site!r}, epoch {_epoch} "
             f"(step {ctx.get('step')!r})"
         )
     raise InjectedFault(site, kind, _epoch)

@@ -43,12 +43,16 @@ __all__ = [
     "note_barrier",
     "note_comm",
     "note_demotion",
+    "note_eviction",
     "note_fault",
     "note_fenced",
     "note_gsync",
     "note_pipeline_depth",
     "note_pipeline_stall",
+    "note_resident",
+    "note_residency_restore",
     "note_restart",
+    "note_spill",
     "note_transfer",
 ]
 
@@ -83,6 +87,10 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=max(ring_len, 16))
         self.counters: Dict[str, float] = {}
         self._close_s: deque = deque(maxlen=_CLOSE_BUF)
+        #: Residency-restore durations (always on, like _close_s) so
+        #: bench.py reports restore latency percentiles without the
+        #: ring perturbing the measured loops.
+        self._restore_s: deque = deque(maxlen=_CLOSE_BUF)
         self.active = False
         #: proc_id -> latest piggybacked summary (clustered runs).
         self.cluster: Dict[int, Any] = {}
@@ -136,6 +144,17 @@ class FlightRecorder:
         """``(p50_seconds, p99_seconds, n)`` over the recent closes, or
         None before the first recorded close."""
         xs = sorted(self._copied(lambda: list(self._close_s), []))
+        if not xs:
+            return None
+        n = len(xs)
+        return xs[n // 2], xs[min(n - 1, int(n * 0.99))], n
+
+    def restore_percentiles(
+        self,
+    ) -> Optional[Tuple[float, float, int]]:
+        """``(p50_seconds, p99_seconds, n)`` over recent residency
+        restores, or None before the first restore."""
+        xs = sorted(self._copied(lambda: list(self._restore_s), []))
         if not xs:
             return None
         n = len(xs)
@@ -276,6 +295,50 @@ def note_restart(attempt: int, cause: str, backoff_s: float) -> None:
     RECORDER.record(
         "restart", attempt=attempt, cause=cause, backoff_s=backoff_s
     )
+
+
+def note_resident(step_id: str, n: int) -> None:
+    """Sample the device-resident key count of one step (taken at the
+    residency manager's drain points).  The peak counter is the
+    budget-invariant audit: it only ever ratchets up, so a sample that
+    exceeded ``BYTEWAX_TPU_STATE_BUDGET`` stays visible."""
+    from bytewax_tpu._metrics import state_resident_keys
+
+    state_resident_keys.labels(step_id).set(n)
+    key = f"state_resident_keys[{step_id}]"
+    RECORDER.counters[key] = n
+    peak = f"state_resident_keys_peak[{step_id}]"
+    if n > RECORDER.counters.get(peak, 0):
+        RECORDER.counters[peak] = n
+
+
+def note_eviction(step_id: str, n: int, tier: str) -> None:
+    """``n`` keys left the device tier for ``tier`` (``host`` RAM
+    snapshots or the ``disk`` spill store)."""
+    from bytewax_tpu._metrics import state_evictions_count
+
+    state_evictions_count.labels(step_id, tier).inc(n)
+    RECORDER.count("state_evictions_count", n)
+    RECORDER.record("eviction", step=step_id, keys=n, tier=tier)
+
+
+def note_residency_restore(step_id: str, n: int, seconds: float) -> None:
+    """One residency-fault restore: ``n`` evicted/spilled keys
+    reinstated on device before a delivery dispatched."""
+    RECORDER.count("residency_restore_count", n)
+    RECORDER.count("residency_restore_seconds", seconds)
+    RECORDER._restore_s.append(seconds)
+    RECORDER.record(
+        "restore", step=step_id, keys=n, seconds=round(seconds, 6)
+    )
+
+
+def note_spill(step_id: str, nbytes: int) -> None:
+    """Serialized bytes written to the disk spill store."""
+    from bytewax_tpu._metrics import state_spill_bytes
+
+    state_spill_bytes.labels(step_id).inc(nbytes)
+    RECORDER.count("state_spill_bytes", nbytes)
 
 
 def note_demotion(step_id: str, reason: str, keys: int) -> None:

@@ -352,3 +352,21 @@ class DeviceScanState(ScanUpdates):
         if slot is not None:
             self.slot_keys[slot] = None
             self._free.append(slot)
+
+    # -- residency (engine/residency.py) ------------------------------------
+
+    def extract_keys(self, keys: List[str]) -> List[Tuple[str, Any]]:
+        """Snapshot AND release the given keys — the residency
+        manager's eviction surface (see
+        ``xla.DeviceAggState.extract_keys``).  Freed slots reset to
+        the kind's identities on reuse via :meth:`alloc`."""
+        snaps = self.snapshots_for(keys)
+        for key in keys:
+            self.discard(key)
+        return [(k, s) for k, s in snaps if s is not None]
+
+    def inject_keys(self, items: List[Tuple[str, Any]]) -> None:
+        """Reinstall previously-extracted keys (field-order host
+        tuples, one scatter per field) — the residency-fault restore
+        path."""
+        self.load_many(items)

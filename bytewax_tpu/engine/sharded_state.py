@@ -124,8 +124,26 @@ def make_agg_state(kind: str, driver=None):
                 and jax.process_count() == driver.proc_count
                 and jax.process_count() > 1
             )
-        except Exception:  # noqa: BLE001 — no reachable backend
-            eligible = False
+        except Exception as ex:  # noqa: BLE001 — probe failed HERE only
+            # The tier decision must be SYMMETRIC across the cluster:
+            # the values probed above (distributed init, process
+            # count) are identical on every process, but an exception
+            # (unimportable backend, a dead accelerator tunnel) can be
+            # per-process.  Swallowing it into ``eligible = False``
+            # would downgrade only this process to a non-collective
+            # tier while peers that did build GlobalAggState block
+            # forever in the collective flush — so under
+            # BYTEWAX_TPU_DISTRIBUTED=1 a failed probe is a hard
+            # error.  Opt the whole cluster out of the global tier
+            # with BYTEWAX_TPU_GLOBAL_EXCHANGE=0 instead.
+            msg = (
+                "BYTEWAX_TPU_DISTRIBUTED=1 is set but probing the "
+                f"distributed jax runtime failed on this process ({ex}); "
+                "a silent per-process downgrade would deadlock the "
+                "peers' collective flushes — fix the backend or run "
+                "the whole cluster with BYTEWAX_TPU_GLOBAL_EXCHANGE=0"
+            )
+            raise RuntimeError(msg) from ex
         if eligible:
             # Construction errors must PROPAGATE: a one-process
             # downgrade to a non-collective tier would deadlock the
@@ -214,14 +232,26 @@ class _ShardedSlots:
         """Hook: bookkeeping for a newly-assigned key."""
 
     def discard(self, key: str) -> None:
+        kid = self._release(key)
+        if kid is not None:
+            self._drop_vocab_ids([kid])
+
+    def _release(self, key: str) -> Optional[int]:
+        """Free a key's slot WITHOUT the vocab drop (extract_keys
+        batches that into one pass); returns the freed wire id."""
         kid = self.key_to_kid.pop(key, None)
         if kid is not None:
             shard, slot = kid % self.n_shards, kid // self.n_shards
             self._free[shard].append(slot)
             self._on_discard(key, kid)
+        return kid
 
     def _on_discard(self, key: str, kid: int) -> None:
         """Hook: bookkeeping for a released key."""
+
+    def _drop_vocab_ids(self, kids: List[int]) -> None:
+        """Hook: un-map released wire ids from any external-id vocab
+        (one vectorized pass per batch of kids)."""
 
     def _global_idx(self, kid: int) -> int:
         shard, slot = kid % self.n_shards, kid // self.n_shards
@@ -297,6 +327,29 @@ class _ShardedSlots:
         ``xla.DeviceAggState.demotion_snapshots``."""
         return self.snapshots_for(self.keys())
 
+    # -- residency (engine/residency.py) ------------------------------------
+
+    def extract_keys(self, keys: List[str]) -> List[Tuple[str, Any]]:
+        """Snapshot AND release the given keys — the residency
+        manager's eviction surface (see
+        ``xla.DeviceAggState.extract_keys``).  Freed per-shard slots
+        reset lazily via the pending-reset list on reuse; the vocab
+        drop runs as ONE vectorized pass for the whole victim batch."""
+        snaps = self.snapshots_for(keys)
+        kids = [
+            k for k in (self._release(key) for key in keys)
+            if k is not None
+        ]
+        if kids:
+            self._drop_vocab_ids(kids)
+        return [(k, s) for k, s in snaps if s is not None]
+
+    def inject_keys(self, items: List[Tuple[str, Any]]) -> None:
+        """Reinstall previously-extracted keys (host-format
+        snapshots, one scatter per field) — the residency-fault
+        restore path (subclasses supply ``load_many``)."""
+        self.load_many(items)
+
 
 class ShardedAggState(_ShardedSlots):
     """Slot-table aggregation state sharded over a device mesh.
@@ -364,6 +417,12 @@ class ShardedAggState(_ShardedSlots):
             self._iddict = {}
             self._id_keys = []
             self._id_to_kid = np.empty(0, dtype=np.int32)
+
+    def _drop_vocab_ids(self, kids: List[int]) -> None:
+        # The vocab table maps each key's external id to its (now
+        # reusable) wire id; drop them so a post-evict return of the
+        # key re-allocs instead of folding into a reassigned slot.
+        self._vocab.drop_ids(kids)
 
     def _step_for(self, total_rows: int, capacity: int):
         from bytewax_tpu.ops.sharded import make_sharded_step
@@ -946,7 +1005,7 @@ class GlobalAggState:
         self._fields = None
         self.dtype = None  # decided collectively at first flush
         self._round = 0
-        self._steps: Dict[Tuple[int, int], Any] = {}
+        self._steps: Dict[Tuple[int, int, Any], Any] = {}
 
     # -- placement -----------------------------------------------------------
 
@@ -1115,7 +1174,11 @@ class GlobalAggState:
     def _step_for(self, rows_per_dev: int, capacity: int):
         from bytewax_tpu.ops.sharded import make_sharded_step
 
-        key = (rows_per_dev, capacity)
+        # dtype is part of the key: finalize() resets self.dtype to
+        # None and the next lock may pick the OTHER dtype — a stale
+        # cached step would ride int values through the float32
+        # bitcast lane.
+        key = (rows_per_dev, capacity, self.dtype)
         step = self._steps.get(key)
         if step is None:
             step = make_sharded_step(

@@ -323,8 +323,20 @@ class DeviceWindowAggState:
         if new_keys:
             kids_new = self._key_ids_for(new_keys)
             # wa_encode assigned len(iddict)-ordered ids; they must
-            # line up with the engine's first-seen allocation.
-            assert int(kids_new[-1]) == len(self.keys) - 1
+            # line up with the engine's first-seen allocation.  Not an
+            # assert: under ``python -O`` a desync would silently
+            # misattribute every subsequent window fold to the wrong
+            # keys instead of failing the step.
+            if int(kids_new[-1]) != len(self.keys) - 1:
+                self._promote_failed = True
+                msg = (
+                    "itemized windowing promotion desynchronized from "
+                    "the engine key-id space (native id "
+                    f"{int(kids_new[-1])} vs engine id "
+                    f"{len(self.keys) - 1}); this is an engine "
+                    "invariant violation — please report it"
+                )
+                raise RuntimeError(msg)
         kids = ids.astype(np.int64)
         if self.spec.kind == "count":
             return self._ingest(kids, ts_us, _LateTs(ts_us))
@@ -775,6 +787,41 @@ class DeviceWindowAggState:
             self.agg.load(f"{key}\x00{wid}", state)
         self._replay_queue(kid, snap)
 
+    # -- residency (engine/residency.py) ------------------------------------
+    #
+    # The extract/inject surface for window state: a key drains to its
+    # host-tier ``_WindowSnapshot`` and its device fold slots are
+    # released.  NOTE the scheduling caveat: an extracted key's open
+    # windows stop closing by wall clock until the key is reinstated,
+    # so callers must route snapshot reads AND notify scheduling
+    # through a residency cache — the driver does not evict window
+    # state yet (docs/state-residency.md).
+
+    def extract_keys(self, keys: List[str]) -> List[Tuple[str, Any]]:
+        """Snapshot AND release the given keys: open windows close
+        their device slots; the per-key clock entries stay (a later
+        ``inject_keys`` restores the snapshotted clock)."""
+        out = []
+        for key, snap in self.snapshots_for(keys):
+            if snap is None:
+                continue
+            kid = self.key_ids[key]
+            for k2, wid in [
+                kw for kw in self.open_close_us if kw[0] == kid
+            ]:
+                del self.open_close_us[(k2, wid)]
+                self.agg.discard(f"{key}\x00{wid}")
+            self._open_cache = None
+            self.touched.discard(key)
+            out.append((key, snap))
+        return out
+
+    def inject_keys(self, items: List[Tuple[str, Any]]) -> None:
+        """Reinstate previously-extracted keys from their host-tier
+        ``_WindowSnapshot``s."""
+        for key, snap in items:
+            self.load(key, snap)
+
 
 class DeviceSessionAggState(DeviceWindowAggState):
     """Session windows on the device tier: key-local gap merges.
@@ -1093,3 +1140,28 @@ class DeviceSessionAggState(DeviceWindowAggState):
             self.agg.load(slot_key, state)
             self.session_slots[(kid, target)].append(slot_key)
         self._replay_queue(kid, snap)
+
+    def extract_keys(self, keys: List[str]) -> List[Tuple[str, Any]]:
+        """Session variant of the residency extract: open sessions
+        drain into the snapshot (which carries ``next_id``, so session
+        ids stay unique across an extract/inject round trip) and their
+        device slots are released."""
+        out = []
+        for key, snap in self.snapshots_for(keys):
+            kid = self.key_ids.get(key)
+            if snap is None or kid is None:
+                continue
+            # Keys with ZERO open sessions still extract: their
+            # snapshot carries next_id/clock state (session state is
+            # never discarded once a key exists), and skipping them
+            # would leave a residency manager believing it evicted a
+            # key that released nothing.
+            for wid in list(self.sessions.get(kid, {})):
+                for slot_key in self.session_slots.pop((kid, wid), []):
+                    self.agg.discard(slot_key)
+                self.open_close_us.pop((kid, wid), None)
+            self.sessions[kid] = {}
+            self._open_cache = None
+            self.touched.discard(key)
+            out.append((key, snap))
+        return out

@@ -183,6 +183,16 @@ class DeviceAggState:
     def discard(self, key: str) -> None:
         """Release a key's slot for reuse (its state is reset when the
         slot is reallocated)."""
+        slot = self._release(key)
+        if slot is not None and self._vocab.drop_ids([slot]):
+            # The on-device id→slot table still routes the dropped
+            # external id to this (now reusable) slot; rebuild it
+            # on the next vocab sync.
+            self._dev_map = None
+
+    def _release(self, key: str) -> Optional[int]:
+        """Free a key's slot WITHOUT the vocab drop (extract_keys
+        batches that into one pass); returns the freed slot."""
         slot = self.key_to_slot.pop(key, None)
         if slot is not None:
             self.slot_keys[slot] = None  # type: ignore[call-overload]
@@ -198,6 +208,7 @@ class DeviceAggState:
                 self._iddict = {}
                 self._id_keys = []
                 self._id_to_slot = np.empty(0, dtype=np.int32)
+        return slot
 
     def _apply_resets(self) -> None:
         if self._fields is None:
@@ -492,16 +503,13 @@ class DeviceAggState:
             self.dtype = jnp.int32
 
     def load(self, key: str, state: Any) -> None:
-        """Install a resumed snapshot for a key (host-tier format)."""
+        """Install a resumed snapshot for a key (host-tier format).
+        Slot assignment goes through :meth:`alloc` so freed (evicted/
+        discarded) slots are reused instead of growing the table."""
         self._maybe_lock_int(state)
         field_vals = self._field_vals(state)
-        self._grow_to(len(self.key_to_slot) + 2)
+        slot = self.alloc(key)
         self._ensure_fields()
-        slot = self.key_to_slot.get(key)
-        if slot is None:
-            slot = len(self.slot_keys)
-            self.key_to_slot[key] = slot
-            self.slot_keys.append(key)
         for name, val in field_vals.items():
             self._fields[name] = (
                 self._fields[name].at[slot].set(jnp.asarray(val, self.dtype))
@@ -523,15 +531,12 @@ class DeviceAggState:
         slots = np.empty(len(items), dtype=np.int32)
         for i, (key, state) in enumerate(items):
             fv = self._field_vals(state)
-            slot = self.key_to_slot.get(key)
-            if slot is None:
-                slot = len(self.slot_keys)
-                self.key_to_slot[key] = slot
-                self.slot_keys.append(key)
-            slots[i] = slot
+            # alloc reuses freed (evicted/discarded) slots and grows
+            # on demand; pending resets apply in _ensure_fields below,
+            # BEFORE the scatter installs the resumed values.
+            slots[i] = self.alloc(key)
             for name in names:
                 cols[name][i] = fv[name]
-        self._grow_to(len(self.slot_keys) + 1)
         self._ensure_fields()
         _flight.note_transfer(
             "h2d",
@@ -601,3 +606,27 @@ class DeviceAggState:
         after repeated device faults (host logics rebuild from these
         exactly as a recovery resume would)."""
         return self.snapshots_for(self.keys())
+
+    # -- residency (engine/residency.py) ------------------------------------
+
+    def extract_keys(self, keys: List[str]) -> List[Tuple[str, Any]]:
+        """Snapshot AND release the given keys (one device_get for the
+        batch): the residency manager's eviction surface.  Released
+        slots reset lazily on reuse; keys with no folded state release
+        with no snapshot.  The vocab drop runs as ONE vectorized pass
+        over the whole victim batch (a per-key drop is an O(vocab)
+        scan each).  Callers own the drain-point scheduling — no fold
+        referencing these slots may be in flight."""
+        snaps = self.snapshots_for(keys)
+        slots = [
+            s for s in (self._release(key) for key in keys)
+            if s is not None
+        ]
+        if slots and self._vocab.drop_ids(slots):
+            self._dev_map = None
+        return [(k, s) for k, s in snaps if s is not None]
+
+    def inject_keys(self, items: List[Tuple[str, Any]]) -> None:
+        """Reinstall previously-extracted keys (host-format snapshots,
+        one scatter per field) — the residency-fault restore path."""
+        self.load_many(items)

@@ -129,10 +129,20 @@ class ScanKind:
 
     def snapshot_of(self, row: Tuple) -> Tuple:
         """Host-format state tuple from one slot row (device scalars
-        → exact Python ints / floats, in field order)."""
+        → exact Python bools / ints / floats, in field order).  The
+        bool branch must come first: ``jnp.bool_`` is not an integer
+        subdtype, so without it a bool field snapshots as a float and
+        a host-tier resume sees ``1.0`` where its mapper kept
+        ``True`` — breaking the cross-tier interchange contract for
+        bool state."""
         out = []
         for (name, (_i, dtype)), v in zip(self.fields.items(), row):
-            out.append(int(v) if jnp.issubdtype(dtype, jnp.integer) else float(v))
+            if jnp.issubdtype(dtype, jnp.bool_):
+                out.append(bool(v))
+            elif jnp.issubdtype(dtype, jnp.integer):
+                out.append(int(v))
+            else:
+                out.append(float(v))
         return tuple(out)
 
     def __repr__(self) -> str:

@@ -298,6 +298,11 @@ class _JaxStatefulMap(ScanMap):
             outs = (outs,)
 
         def scalar(x, like):
+            # type(like) reconstructs the exact host scalar per field
+            # — including bool (``type(True) is bool``), the scalar-
+            # path mirror of ScanKind.snapshot_of's jnp.bool_ branch:
+            # a bool init field always snapshots as a Python bool
+            # here, never a 0.0/1.0 float carrier.
             x = x.item() if hasattr(x, "item") else x
             return type(like)(x)
 

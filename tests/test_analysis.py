@@ -91,6 +91,20 @@ def test_snapshot_rule_flags_missing_demotion_method():
     assert "OrphanDeviceState" in diags[0].message
 
 
+def test_snapshot_rule_flags_residency_pairing():
+    diags = _diags("fixture_residency_missing.py", ["BTX-SNAPSHOT"])
+    msgs = "\n".join(d.message for d in diags)
+    # extract_keys with no inject_keys: stranded evictions.
+    assert "HalfResidentState" in msgs
+    assert "inject_keys" in msgs
+    # The collective tier must implement NEITHER half.
+    assert "EvictingGlobalState" in msgs
+    assert any(
+        "global_exchange" in d.message and "residency" in d.message
+        for d in diags
+    )
+
+
 def test_backend_rule_flags_unforced_script():
     diags = _diags(
         "fixture_backend_script.py", ["BTX-BACKEND"], scripts=True

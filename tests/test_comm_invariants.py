@@ -81,7 +81,9 @@ def test_control_frame_inventory_is_pinned():
     # (``deliver``/``route``) and everything else must be legal at
     # the protocol point it arrives at.  (The robustness PR
     # deliberately added no frame kinds: supervised-restart signaling
-    # rides socket closes plus per-frame generation fencing.)
+    # rides socket closes plus per-frame generation fencing.  The
+    # residency PR added none either: eviction/restore/spill are
+    # process-local tier movement — nothing rides the mesh.)
     assert contracts.CONTROL_FRAMES == {
         "deliver",
         "route",
@@ -99,16 +101,27 @@ def test_control_frame_inventory_is_pinned():
 
 
 def test_fault_site_inventory_is_pinned():
+    # The residency PR added exactly one site: residency_restore, the
+    # restore-before-dispatch path of the tiered key-state manager
+    # (engine/residency.py).  It is a retryable device-path site
+    # (DeviceFault, fired before any state mutates), pinned in
+    # FAULT_DEVICE_SITES alongside device_dispatch.
     assert contracts.FAULT_SITES == (
         "comm.send",
         "comm.recv",
         "device_dispatch",
+        "residency_restore",
         "snapshot.write",
         "snapshot.commit",
         "barrier",
     )
+    assert contracts.FAULT_DEVICE_SITES == {
+        "device_dispatch",
+        "residency_restore",
+    }
     # Injector originates no traffic; every fire() site is pinned;
-    # device_dispatch fires before any device-state mutation.
+    # the retryable device-path sites fire before any device-state
+    # mutation.
     diags = _check(["BTX-FAULT"])
     assert not diags, format_diagnostics(diags)
 

@@ -615,6 +615,83 @@ def test_jax_stateful_map_cross_tier_snapshot(recovery_config):
     _assert_rows_close(out1 + out2, want)
 
 
+def test_jax_stateful_map_bool_state_cross_tier_snapshot(
+    recovery_config,
+):
+    """Bool state fields must snapshot as exact Python bools on the
+    device tier (ScanKind.snapshot_of's jnp.bool_ branch): a latch
+    armed before the abort must resume armed on the HOST tier, and
+    the stored snapshot itself must carry a bool, not a 1.0 float."""
+    import pickle
+    from datetime import timedelta
+
+    import jax.numpy as jnp
+
+    from bytewax_tpu.engine.recovery_store import RecoveryStore
+    from bytewax_tpu.testing import TestingSource as TS
+
+    def latch(state, v):
+        (armed,) = state
+        armed = jnp.logical_or(armed, v > 5.0)
+        return (armed,), (armed,)
+
+    def make():
+        return xla.jax_stateful_map(latch, (False,))
+
+    items = [("a", 1.0), ("a", 9.0), ("b", 2.0)]
+    tail = [("a", 0.5), ("b", 1.0)]
+    _, want = _oracle_for(make, items + tail)
+    inp = items + [TS.ABORT()] + tail
+
+    def build(out):
+        flow = Dataflow("scan_bool_rt")
+        s = op.input("inp", flow, TestingSource(inp, batch_size=1))
+        s = op.stateful_map("scan", s, make())
+        op.output("out", s, TestingSink(out))
+        return flow
+
+    out1 = []
+    run_main(
+        build(out1),
+        epoch_interval=timedelta(0),
+        recovery_config=recovery_config,
+    )
+    # The device-tier snapshot rows hold exact Python bools.
+    store = RecoveryStore(recovery_config.db_dir)
+    try:
+        snaps = {
+            key: pickle.loads(ser)
+            for sid, key, ser in store.iter_snaps(10**6)
+            if "stateful_batch" in sid
+        }
+    finally:
+        store.close()
+    assert snaps, "expected scan-state snapshots in the store"
+    for state in snaps.values():
+        assert isinstance(state[0], bool), state
+    assert snaps["a"] == (True,)
+    # And the host tier resumes from them with identical semantics.
+    out2 = []
+    env_prev = os.environ.get("BYTEWAX_TPU_ACCEL")
+    os.environ["BYTEWAX_TPU_ACCEL"] = "0"
+    try:
+        run_main(
+            build(out2),
+            epoch_interval=timedelta(0),
+            recovery_config=recovery_config,
+        )
+    finally:
+        if env_prev is None:
+            os.environ.pop("BYTEWAX_TPU_ACCEL", None)
+        else:
+            os.environ["BYTEWAX_TPU_ACCEL"] = env_prev
+    got = out1 + out2
+    _assert_rows_close(got, want)
+    # Host-tier emissions after the resume are exact bools too (the
+    # scalar-path mirror in _JaxStatefulMap.__call__).
+    assert all(isinstance(row[1], bool) for _k, row in out2)
+
+
 def test_jax_stateful_map_rejects_bad_fns_at_construction():
     import jax.numpy as jnp
 
