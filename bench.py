@@ -786,6 +786,92 @@ def _run_restart_recovery():
         faults.reset()
 
 
+def _run_rescale_resume():
+    """Stop-at-N → first-epoch-close-at-M wall time, in seconds.
+
+    An in-process 2-lane cluster runs a keyed flow (5k keys through
+    the device scan tier) to a mid-stream EOF, populating the
+    recovery store; the relaunch at 3 lanes with
+    ``BYTEWAX_TPU_RESCALE=1`` then pays driver build + resume math +
+    the startup rescale migration (route rewrite over every keyed
+    row) + state reload + the first epoch close — the end-to-end
+    pause an operator pays to resize a running flow, the rescale
+    sibling of ``restart_recovery_s``.
+    """
+    import tempfile
+    from datetime import timedelta
+
+    import bytewax_tpu.operators as op
+    from bytewax_tpu import xla
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine import flight
+    from bytewax_tpu.engine.driver import cluster_main
+    from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+    from bytewax_tpu.testing import TestingSink, TestingSource
+
+    n_keys = 5000
+    env_keys = ("BYTEWAX_TPU_RESCALE", "BYTEWAX_FLIGHT_RECORDER")
+    saved = {k: os.environ.get(k) for k in env_keys}
+    os.environ["BYTEWAX_FLIGHT_RECORDER"] = "1"
+    main_rec = flight.RECORDER
+    flight.RECORDER = flight.FlightRecorder(1 << 15)
+    flight.RECORDER.activate(True)
+
+    def flow_of(items, out):
+        flow = Dataflow("rescale_bench_df")
+        s = op.input(
+            "inp", flow, TestingSource(items, batch_size=256)
+        )
+        scored = op.stateful_map("ema", s, xla.ema(0.3))
+        op.output("out", scored, TestingSink(out))
+        return flow
+
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            init_db_dir(td, 2)
+            inp = [
+                (f"k{i % n_keys:05d}", float(i % 97))
+                for i in range(2 * n_keys)
+            ]
+            half = len(inp) // 2
+            items = inp[:half] + [TestingSource.EOF()] + inp[half:]
+            cluster_main(
+                flow_of(items, []),
+                [],
+                0,
+                worker_count_per_proc=2,
+                epoch_interval=timedelta(0),
+                recovery_config=RecoveryConfig(td),
+            )
+            os.environ["BYTEWAX_TPU_RESCALE"] = "1"
+            t0 = time.time()
+            cluster_main(
+                flow_of(items, []),
+                [],
+                0,
+                worker_count_per_proc=3,
+                epoch_interval=timedelta(0),
+                recovery_config=RecoveryConfig(td),
+            )
+        events = flight.RECORDER.tail(1 << 15)
+        if not any(e["kind"] == "rescale" for e in events):
+            msg = "rescale migration did not run"
+            raise RuntimeError(msg)
+        first_close_t = next(
+            e["t"]
+            for e in events
+            if e["kind"] == "epoch_close" and e["t"] >= t0
+        )
+        return first_close_t - t0
+    finally:
+        flight.RECORDER = main_rec
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _run_residency_stress(
     n_rows: int = 100_000, n_keys: int = 4096, budget: int = 64
 ):
@@ -1089,6 +1175,15 @@ def main() -> None:
     except Exception as ex:  # noqa: BLE001 - bench must still report
         extra["restart_recovery_s"] = None
         extra["restart_recovery_error"] = str(ex)[:200]
+
+    # Elastic rescale-on-resume: stop a 2-lane flow, relaunch at 3
+    # lanes with the store migration (docs/recovery.md) — the pause
+    # an operator pays to resize a running flow.
+    try:
+        extra["rescale_resume_s"] = round(_run_rescale_resume(), 3)
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["rescale_resume_s"] = None
+        extra["rescale_resume_error"] = str(ex)[:200]
 
     # Tiered key-state residency under stress (cardinality >> budget;
     # docs/state-residency.md): throughput with continuous evict/

@@ -30,6 +30,8 @@ __all__ = [
     "item_out_count",
     "pipeline_depth",
     "pipeline_flush_stall_seconds",
+    "rescale_duration_seconds",
+    "rescale_migrated_keys",
     "state_evictions_count",
     "state_resident_keys",
     "state_spill_bytes",
@@ -209,6 +211,20 @@ worker_restart_count = Counter(
     "bytewax_worker_restart_count",
     "Supervised worker restarts after a restartable fault "
     "(peer death, epoch stall, injected crash)",
+)
+
+rescale_migrated_keys = Counter(
+    "bytewax_rescale_migrated_keys",
+    "Distinct keyed-snapshot state keys re-routed by a "
+    "rescale-on-resume migration at run startup (recovery store "
+    "written by N workers, cluster relaunched with M)",
+)
+
+rescale_duration_seconds = Histogram(
+    "bytewax_rescale_duration_seconds",
+    "Wall time of one rescale-on-resume store migration (the "
+    "all-partition route rewrite, run before any epoch processing)",
+    buckets=DURATION_BUCKETS,
 )
 
 step_demotion_count = Counter(

@@ -193,6 +193,10 @@ FAULTS_MODULE = "bytewax_tpu.engine.faults"
 
 #: Every site the engine threads a ``fire()`` call through.  Must
 #: equal ``faults.SITES`` (the rule cross-checks the module's AST).
+#: ``rescale_migrate`` is the rescale-on-resume migration
+#: (``recovery_store.RecoveryStore.rescale``): fired inside the
+#: all-partition transaction before any row moves, legal only at run
+#: startup — the one globally-ordered re-entry point.
 FAULT_SITES = (
     "comm.send",
     "comm.recv",
@@ -200,6 +204,7 @@ FAULT_SITES = (
     "residency_restore",
     "snapshot.write",
     "snapshot.commit",
+    "rescale_migrate",
     "barrier",
 )
 

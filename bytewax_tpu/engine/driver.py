@@ -18,6 +18,7 @@ this driver's epoch/recovery bookkeeping.
 
 import os
 import pickle
+import random
 import time
 import zlib
 from datetime import datetime, timedelta, timezone
@@ -150,7 +151,118 @@ def _enable_compile_cache(cache_dir: str) -> None:
             pass
 
 
-def _supervised(make: Callable[[int], "_Driver"]) -> None:
+#: rescale_hint thresholds (docs/recovery.md): an epoch close whose
+#: p99 exceeds this fraction of the epoch interval means snapshots
+#: are eating the processing budget; flush stalls above this fraction
+#: mean the host is waiting on the device pipeline; more than this
+#: many residency restores per epoch means the working set thrashes
+#: the device budget; sustained spill traffic above this byte rate
+#: (while restores are non-negligible) means state actively pages
+#: through the disk tier.  Below the QUIET thresholds with more than
+#: one worker, the cluster is oversized.  All signals are lifetime
+#: per-epoch-close averages off cumulative counters, so the quiet
+#: bounds are small-but-nonzero: a one-off warm-up stall/spill decays
+#: below them as closes accumulate instead of pinning the advice
+#: forever.
+_HINT_CLOSE_FRAC = 0.5
+_HINT_STALL_FRAC = 0.2
+_HINT_RESTORES_PER_CLOSE = 1.0
+_HINT_SPILL_BYTES_PER_CLOSE = 4096.0
+_HINT_QUIET_CLOSE_FRAC = 0.05
+_HINT_QUIET_STALL_FRAC = 0.01
+_HINT_QUIET_RESTORES = 0.1
+_HINT_QUIET_SPILL_BYTES = 256.0
+
+
+def derive_rescale_hint(
+    *,
+    worker_count: int,
+    epoch_interval_s: float,
+    close_p99_s: Optional[float],
+    stall_s_per_close: float,
+    restores_per_close: float,
+    spill_bytes_per_close: float = 0.0,
+) -> Tuple[str, List[str]]:
+    """Pure rescale advice from the engine's load signals.
+
+    Returns ``(advice, reasons)`` where advice is ``"grow"`` (the
+    cluster is saturated: stop it and relaunch with more processes
+    and ``--rescale``), ``"shrink"`` (it is idle enough that fewer
+    processes would do), or ``"hold"``.  Signals are per-epoch-close
+    averages so the advice is rate-based, not run-length-based; with
+    no closes recorded yet everything reads zero and the advice is
+    ``hold``.  Deliberately conservative: ``shrink`` needs EVERY
+    signal quiet, ``grow`` needs any one loud."""
+    reasons: List[str] = []
+    if (
+        close_p99_s is not None
+        and epoch_interval_s > 0
+        and close_p99_s > _HINT_CLOSE_FRAC * epoch_interval_s
+    ):
+        reasons.append(
+            f"epoch_close_p99 {close_p99_s:.3f}s exceeds "
+            f"{_HINT_CLOSE_FRAC:.0%} of the {epoch_interval_s:g}s "
+            "epoch interval"
+        )
+    if (
+        epoch_interval_s > 0
+        and stall_s_per_close > _HINT_STALL_FRAC * epoch_interval_s
+    ):
+        reasons.append(
+            f"pipeline flush stalls {stall_s_per_close:.3f}s/epoch "
+            f"exceed {_HINT_STALL_FRAC:.0%} of the epoch interval"
+        )
+    if restores_per_close > _HINT_RESTORES_PER_CLOSE:
+        reasons.append(
+            f"{restores_per_close:.1f} residency restores/epoch: the "
+            "keyed working set thrashes the device state budget"
+        )
+    if (
+        spill_bytes_per_close > _HINT_SPILL_BYTES_PER_CLOSE
+        and restores_per_close > _HINT_QUIET_RESTORES
+    ):
+        reasons.append(
+            f"{spill_bytes_per_close:.0f} spill bytes/epoch alongside "
+            "restores: state is actively paging through the disk tier"
+        )
+    if reasons:
+        return "grow", reasons
+    if (
+        worker_count > 1
+        and epoch_interval_s > 0
+        and close_p99_s is not None
+        and close_p99_s < _HINT_QUIET_CLOSE_FRAC * epoch_interval_s
+        and stall_s_per_close
+        < _HINT_QUIET_STALL_FRAC * epoch_interval_s
+        and restores_per_close < _HINT_QUIET_RESTORES
+        and spill_bytes_per_close < _HINT_QUIET_SPILL_BYTES
+    ):
+        return "shrink", [
+            f"epoch_close_p99 {close_p99_s:.3f}s is under "
+            f"{_HINT_QUIET_CLOSE_FRAC:.0%} of the epoch interval with "
+            "negligible pipeline stalls and residency pressure"
+        ]
+    return "hold", reasons
+
+
+def _backoff_delay(
+    base: float, attempt: int, rng: random.Random
+) -> float:
+    """Capped exponential restart backoff with per-process jitter.
+
+    The jitter factor is drawn uniformly from [0.5, 1.5) off a
+    per-``proc_id``-seeded stream: without it, every process of a
+    crashed cluster sleeps the *identical* deterministic delay and
+    redials simultaneously — a thundering-herd handshake (and one
+    dial-timeout round) on every generation bump."""
+    return min(base * (2 ** (attempt - 1)), 30.0) * (
+        0.5 + rng.random()
+    )
+
+
+def _supervised(
+    make: Callable[[int], "_Driver"], proc_id: int = 0
+) -> None:
     """Run a driver under the restart supervisor.
 
     ``make(generation)`` builds a fresh driver (re-opening the
@@ -159,7 +271,9 @@ def _supervised(make: Callable[[int], "_Driver"]) -> None:
     retried up to ``BYTEWAX_TPU_MAX_RESTARTS`` times *per failure
     burst* (default 0 — supervision off, faults propagate exactly as
     before) with capped exponential backoff starting at
-    ``BYTEWAX_TPU_RESTART_BACKOFF_S``.
+    ``BYTEWAX_TPU_RESTART_BACKOFF_S``, jittered per process (seeded
+    by ``proc_id``, so restart schedules are deterministic per
+    process but desynchronized across the cluster).
 
     The budget and backoff are burst-scoped (the Erlang/k8s
     crash-loop intensity model): an execution that stays healthy for
@@ -171,12 +285,16 @@ def _supervised(make: Callable[[int], "_Driver"]) -> None:
     Restarts re-enter at run startup — a globally-ordered point (mesh
     handshake + the unconditional "fcfg" sync round), so the restarted
     cluster performs the same sequence of sync rounds from scratch and
-    the gsync/barrier contract holds across generations.
+    the gsync/barrier contract holds across generations.  Run startup
+    is also where rescale-on-resume happens: a supervised cluster
+    stopped at N processes and relaunched at M re-shards its keyed
+    state there, before any epoch processing (docs/recovery.md).
     """
     max_restarts = _max_restarts()
     reset_s = float(
         os.environ.get("BYTEWAX_TPU_RESTART_RESET_S", "300") or 300
     )
+    rng = random.Random(f"bytewax-restart:{proc_id}")
     attempt = 0
     generation = 0
     while True:
@@ -195,7 +313,7 @@ def _supervised(make: Callable[[int], "_Driver"]) -> None:
                 os.environ.get("BYTEWAX_TPU_RESTART_BACKOFF_S", "0.5")
                 or 0.5
             )
-            delay = min(base * (2 ** (attempt - 1)), 30.0)
+            delay = _backoff_delay(base, attempt, rng)
             _flight.note_restart(attempt, type(ex).__name__, delay)
             import logging
 
@@ -646,9 +764,15 @@ class _StatefulBatchRt(_OpRt):
         # Unset budget returns the state unchanged (byte-identical
         # engine).  The collective global-exchange tier is excluded
         # inside maybe_wrap, exactly like demotion; the window tier
-        # exposes extract/inject but is not driver-evicted yet.
-        self.agg = maybe_wrap(op.step_id, self.agg)
-        self.sagg = maybe_wrap(op.step_id, self.sagg)
+        # exposes extract/inject but is not driver-evicted yet.  The
+        # worker count stamps spilled rows' route column (recovery
+        # snaps-format parity).
+        self.agg = maybe_wrap(
+            op.step_id, self.agg, worker_count=driver.worker_count
+        )
+        self.sagg = maybe_wrap(
+            op.step_id, self.sagg, worker_count=driver.worker_count
+        )
         #: The step's residency manager, or None when unbudgeted.
         self._res: Optional[ResidentKeyState] = next(
             (
@@ -1943,9 +2067,29 @@ class _Driver:
         self.store: Optional[RecoveryStore] = None
         self._loads: Dict[Tuple[str, str], bytes] = {}
         resume = ResumeFrom(0, 1)
+        #: Rescale-on-resume opt-in (--rescale / BYTEWAX_TPU_RESCALE):
+        #: without it, resuming a store written by a different worker
+        #: count refuses with WorkerCountMismatchError instead of
+        #: reading keyed rows with a stale route modulus.
+        self.rescale_enabled = os.environ.get(
+            "BYTEWAX_TPU_RESCALE", "0"
+        ) not in ("", "0")
+        #: Worker count(s) the resumed execution was written with,
+        #: when they differ from this cluster's (the startup rescale
+        #: phase migrates the store before any keyed snapshot is
+        #: read); None when no rescale is needed.
+        self._rescale_from: Optional[Tuple[int, ...]] = None
         if recovery_config is not None:
             self.store = RecoveryStore(recovery_config.db_dir)
-            resume = self.store.resume_from()
+            resume = self.store.resume_from(
+                worker_count=self.worker_count,
+                allow_rescale=self.rescale_enabled,
+            )
+            if resume.stored_worker_counts not in (
+                (),
+                (self.worker_count,),
+            ):
+                self._rescale_from = resume.stored_worker_counts
             # Eagerly load only input/output partition states (a
             # bounded handful, needed at build_part time); unbounded
             # keyed stateful snapshots stream in store pages via
@@ -2024,11 +2168,18 @@ class _Driver:
     def iter_resume_states(self, step_id: str):
         """Stream ``(key, state)`` resume pairs for a stateful step in
         store pages — memory bounded by the page size, not the keyed
-        state size."""
+        state size.  Reads are route-scoped to this process's worker
+        lanes (rows are route-stamped at write time and migrated by
+        the startup rescale phase when the worker count changed), so
+        a resuming cluster reads ~1/M of the keyed state per process;
+        the caller's ``is_local`` check stays the correctness
+        backstop."""
         if self.store is None:
             return
         for _sid, key, ser in self.store.iter_snaps(
-            self.resume.resume_epoch, step_ids=[step_id]
+            self.resume.resume_epoch,
+            step_ids=[step_id],
+            routes=list(range(self.local_lo, self.local_hi)),
         ):
             yield key, pickle.loads(ser)
 
@@ -2340,6 +2491,105 @@ class _Driver:
                 rt.pipeline_flush()
         return pending
 
+    def _startup_rescale(self, clustered: bool) -> None:
+        """Migrate the recovery store to this cluster's worker count
+        when the resumed execution was written by a different one.
+
+        Runs at run startup — the one globally-ordered re-entry point
+        — after the startup agreement round proved every process
+        observes the same old→new mapping, and before ANY runtime
+        builds (no process may read keyed snapshots mid-migration).
+        The coordinator migrates (one all-partition transaction,
+        ``rescale_migrate`` fault site fired before any row moves);
+        peers block in a gsync round until the migration committed.
+        Whether the round runs is decided by the agreed view, so
+        every process performs the same sequence of sync rounds.
+        """
+        if self.store is None or self._rescale_from is None:
+            return
+        migrated = 0
+        if self.proc_id == 0:
+            t0 = time.monotonic()
+            migrated = self.store.rescale(
+                self.worker_count, ex_num=self.resume.ex_num - 1
+            )
+            _flight.note_rescale(
+                self._rescale_from,
+                self.worker_count,
+                migrated,
+                time.monotonic() - t0,
+            )
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "rescaled recovery store from %s worker(s) to %d "
+                "(%d keyed snapshot rows re-routed)",
+                "/".join(map(str, self._rescale_from)),
+                self.worker_count,
+                migrated,
+            )
+        if clustered:
+            # Ordinary gsync round (an existing frame kind at a
+            # globally-ordered point): peers wait here until the
+            # coordinator's migration transaction committed, then all
+            # resume reads see the new routing.  A coordinator fault
+            # mid-migration closes the mesh; peers observe the socket
+            # close and restart under their supervisors — retrying
+            # the (rolled-back, idempotent) migration from scratch.
+            self.global_sync(
+                ("rescaled", self.next_gsync_tag()), migrated
+            )
+        self._rescale_from = None
+
+    def _rescale_hint(self) -> Dict[str, Any]:
+        """The ``/status`` rescale recommendation (docs/recovery.md):
+        a ``grow``/``shrink``/``hold`` advice derived from epoch-close
+        latency, pipeline flush stalls, and residency restore/spill
+        pressure, for an external autoscaler (or the operator) to
+        stop the cluster and relaunch it at a better size with
+        ``--rescale``.  Read racily off the API-server thread —
+        observability, not the epoch protocol."""
+        rec = _flight.RECORDER
+        counters = rec.counters
+        closes = max(int(counters.get("epoch_close_count", 0)), 1)
+        pct = rec.epoch_close_percentiles()
+        close_p99_s = pct[1] if pct is not None else None
+        stall_s_per_close = (
+            counters.get("pipeline_flush_stall_seconds", 0.0) / closes
+        )
+        restores_per_close = (
+            counters.get("residency_restore_count", 0.0) / closes
+        )
+        spill_bytes_per_close = (
+            counters.get("state_spill_bytes", 0.0) / closes
+        )
+        interval_s = self.epoch_interval.total_seconds()
+        advice, reasons = derive_rescale_hint(
+            worker_count=self.worker_count,
+            epoch_interval_s=interval_s,
+            close_p99_s=close_p99_s,
+            stall_s_per_close=stall_s_per_close,
+            restores_per_close=restores_per_close,
+            spill_bytes_per_close=spill_bytes_per_close,
+        )
+        return {
+            "advice": advice,
+            "reasons": reasons,
+            "signals": {
+                "worker_count": self.worker_count,
+                "epoch_interval_s": interval_s,
+                "epoch_close_p99_s": close_p99_s,
+                "flush_stall_s_per_close": round(stall_s_per_close, 6),
+                "restores_per_close": round(restores_per_close, 3),
+                "spill_bytes_per_close": round(
+                    spill_bytes_per_close, 1
+                ),
+                "epoch_closes": int(
+                    counters.get("epoch_close_count", 0)
+                ),
+            },
+        }
+
     def _status(self) -> Dict[str, Any]:
         """Live ``GET /status`` document (read racily off the API
         server thread — observability, not the epoch protocol)."""
@@ -2361,6 +2611,7 @@ class _Driver:
             },
             "worker_count": self.worker_count,
             "workers": [self.local_lo, self.local_hi],
+            "rescale_hint": self._rescale_hint(),
             "epoch": self.epoch,
             "eof": bool(rts) and all(rt.eof for rt in rts),
             "queue_depths": {
@@ -2375,26 +2626,91 @@ class _Driver:
         }
 
     def run(self) -> None:
-        # Build runtimes (applies resume state).
-        for i, op in enumerate(self.plan.ops):
-            rt = _RT_FOR[op.name](op, self)
-            rt.idx = i
-            self.rts.append(rt)
+        clustered = self.comm is not None
 
-        local_workers = range(self.local_lo, self.local_hi)
-        if self.store is not None:
-            self.store.write_ex_started(
-                self.resume.ex_num,
-                self.worker_count,
-                self.resume.resume_epoch,
-                workers=local_workers,
-            )
+        # Flight recorder: ring writes on only when someone can look
+        # at them; the compile listener is counters-only and always
+        # on.  The epoch-close telemetry piggyback is a sync round
+        # every process must enter, so the cluster AGREES on it at
+        # startup with one unconditional gsync round (all processes
+        # run this exact sequence, making env divergence a disabled
+        # piggyback instead of a hung barrier).  The same round
+        # carries each process's rescale view (stored worker counts,
+        # this cluster's count, the resume point): every process must
+        # observe the SAME old→new mapping before any keyed snapshot
+        # is read, so a divergent cluster (mismatched -w, stale store
+        # view) fails loudly here instead of mis-sharding state.
+        _flight.ensure_compile_listener()
+        _flight.RECORDER.activate(_flight.enabled())
+        try:
+            if clustered:
+                replies = self.global_sync(
+                    ("fcfg", self.next_gsync_tag()),
+                    {
+                        "flight": _flight.enabled(),
+                        "rescale": (
+                            self._rescale_from,
+                            self.worker_count,
+                            self.rescale_enabled,
+                            self.resume.ex_num,
+                            self.resume.resume_epoch,
+                        ),
+                    },
+                )
+                self._flight_sync = all(
+                    r["flight"] for r in replies.values()
+                )
+                views = {r["rescale"] for r in replies.values()}
+                if len(views) != 1:
+                    msg = (
+                        "cluster processes disagree on the "
+                        f"resume/rescale view {list(views)}: every "
+                        "process must see the same recovery store and "
+                        "worker count before keyed state is re-sharded"
+                    )
+                    raise RuntimeError(msg)
+            else:
+                self._flight_sync = False
+
+            # Rescale-on-resume runs HERE — run startup, the one
+            # globally-ordered re-entry point — before any runtime
+            # builds (i.e. before any process reads keyed snapshots).
+            self._startup_rescale(clustered)
+
+            # Build runtimes (applies resume state).
+            for i, op in enumerate(self.plan.ops):
+                rt = _RT_FOR[op.name](op, self)
+                rt.idx = i
+                self.rts.append(rt)
+
+            local_workers = range(self.local_lo, self.local_hi)
+            if self.store is not None:
+                self.store.write_ex_started(
+                    self.resume.ex_num,
+                    self.worker_count,
+                    self.resume.resume_epoch,
+                    workers=local_workers,
+                )
+        except BaseException:
+            # A startup fault (rescale migration, agreement divergence,
+            # a builder error) unwinds before the run loop's own
+            # finally exists: close the mesh NOW so peers blocked in a
+            # startup sync round observe the socket close (and restart
+            # under supervision) instead of waiting out the heartbeat.
+            for rt in self.rts:
+                shutdown = getattr(rt, "pipeline_shutdown", None)
+                if shutdown is not None:
+                    shutdown()
+            if clustered:
+                self.comm.close()
+            if self.store is not None:
+                self.store.close()
+            raise
 
         inputs = [rt for rt in self.rts if isinstance(rt, _InputRt)]
         epoch_started = time.monotonic()
         interval_s = self.epoch_interval.total_seconds()
         aborted = False
-        clustered = self.comm is not None
         self._holding = False
         self._hold_t0: Optional[float] = None
         #: Stall-watchdog clock: when this process started wanting an
@@ -2405,23 +2721,6 @@ class _Driver:
         self._gen = 0
         self._reports: Dict[int, tuple] = {}
         self._last_report: Optional[tuple] = None
-
-        # Flight recorder: ring writes on only when someone can look
-        # at them; the compile listener is counters-only and always
-        # on.  The epoch-close telemetry piggyback is a sync round
-        # every process must enter, so the cluster AGREES on it at
-        # startup with one unconditional gsync round (all processes
-        # run this exact sequence, making env divergence a disabled
-        # piggyback instead of a hung barrier).
-        _flight.ensure_compile_listener()
-        _flight.RECORDER.activate(_flight.enabled())
-        if clustered:
-            replies = self.global_sync(
-                ("fcfg", self.next_gsync_tag()), _flight.enabled()
-            )
-            self._flight_sync = all(replies.values())
-        else:
-            self._flight_sync = False
 
         from bytewax_tpu.engine.webserver import maybe_start_server
 
@@ -2666,6 +2965,12 @@ def run_main(
     hiccups) rebuild the driver — which recomputes ``resume_from()``
     — and resume from the last committed epoch with exponential
     backoff.
+
+    Resuming a recovery store written by a different worker count
+    refuses with :class:`WorkerCountMismatchError` unless
+    rescale-on-resume is enabled (``--rescale`` /
+    ``BYTEWAX_TPU_RESCALE=1``), in which case the keyed state is
+    re-sharded at startup (docs/recovery.md).
     """
     _supervised(
         lambda gen: _Driver(
@@ -2674,7 +2979,8 @@ def run_main(
             epoch_interval=epoch_interval,
             recovery_config=recovery_config,
             generation=gen,
-        )
+        ),
+        proc_id=0,
     )
 
 
@@ -2704,6 +3010,15 @@ def cluster_main(
     faults tear the mesh down, the restarted processes re-form it with
     a new fenced generation, and execution resumes from the last
     committed epoch.
+
+    A cluster relaunched against a recovery store written by a
+    DIFFERENT total worker count (processes × lanes) refuses with
+    :class:`WorkerCountMismatchError` unless rescale-on-resume is
+    enabled (``--rescale`` / ``BYTEWAX_TPU_RESCALE=1``): the keyed
+    state is then re-sharded to the new routing at run startup — the
+    one globally-ordered re-entry point — before any epoch
+    processing, preserving exactly-once via the truncating-sink
+    resume (docs/recovery.md).
     """
     _supervised(
         lambda gen: _Driver(
@@ -2716,5 +3031,6 @@ def cluster_main(
             else None,
             proc_id=proc_id,
             generation=gen,
-        )
+        ),
+        proc_id=proc_id,
     )

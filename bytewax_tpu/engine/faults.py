@@ -12,7 +12,7 @@ Plan syntax — comma-separated specs::
 
 - ``site``: one of :data:`SITES` (``comm.send``, ``comm.recv``,
   ``device_dispatch``, ``residency_restore``, ``snapshot.write``,
-  ``snapshot.commit``, ``barrier``).
+  ``snapshot.commit``, ``rescale_migrate``, ``barrier``).
 - ``kind``: ``delay`` (sleep ``BYTEWAX_TPU_FAULT_DELAY_S``, default
   0.05s), ``drop`` (suppress the frame — only meaningful at
   ``comm.send``; breaks the barrier's in-flight accounting on purpose,
@@ -66,6 +66,9 @@ __all__ = [
 ]
 
 #: Every site the engine threads a :func:`fire` call through.
+#: ``rescale_migrate`` fires inside the rescale-on-resume store
+#: transaction, before any row moves, so a mid-migration fault rolls
+#: back whole and retries cleanly under the supervisor.
 SITES = (
     "comm.send",
     "comm.recv",
@@ -73,6 +76,7 @@ SITES = (
     "residency_restore",
     "snapshot.write",
     "snapshot.commit",
+    "rescale_migrate",
     "barrier",
 )
 

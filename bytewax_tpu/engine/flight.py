@@ -49,6 +49,7 @@ __all__ = [
     "note_gsync",
     "note_pipeline_depth",
     "note_pipeline_stall",
+    "note_rescale",
     "note_resident",
     "note_residency_restore",
     "note_restart",
@@ -294,6 +295,31 @@ def note_restart(attempt: int, cause: str, backoff_s: float) -> None:
     RECORDER.counters["last_restart_at"] = time.time()
     RECORDER.record(
         "restart", attempt=attempt, cause=cause, backoff_s=backoff_s
+    )
+
+
+def note_rescale(
+    from_counts: Any, to_count: int, migrated_keys: int, seconds: float
+) -> None:
+    """One rescale-on-resume migration completed at run startup: the
+    recovery store's keyed snapshot rows were re-routed from the old
+    worker count(s) to ``to_count``."""
+    from bytewax_tpu._metrics import (
+        rescale_duration_seconds,
+        rescale_migrated_keys,
+    )
+
+    rescale_migrated_keys.inc(migrated_keys)
+    rescale_duration_seconds.observe(seconds)
+    RECORDER.count("rescale_count")
+    RECORDER.count("rescale_migrated_keys", migrated_keys)
+    RECORDER.count("rescale_duration_seconds", seconds)
+    RECORDER.record(
+        "rescale",
+        from_counts=str(from_counts),
+        to_count=to_count,
+        keys=migrated_keys,
+        seconds=round(seconds, 6),
     )
 
 

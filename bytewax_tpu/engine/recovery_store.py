@@ -15,8 +15,20 @@ Tables per ``part-{i}.sqlite3``:
 - ``fronts(ex_num, worker_index, epoch)`` — worker frontier, upserted
   at every epoch close.
 - ``commits(epoch)`` — GC watermark for this partition.
-- ``snaps(step_id, state_key, epoch, ser_change)`` — pickled state
-  changes; ``NULL`` ``ser_change`` is a discard marker.
+- ``snaps(step_id, state_key, epoch, ser_change, route)`` — pickled
+  state changes; ``NULL`` ``ser_change`` is a discard marker.
+  ``route`` is the key's home worker lane under the writing
+  execution's worker count (``adler32(state_key) % worker_count`` —
+  the driver's keyed-routing hash), so each resuming process reads
+  only its own rows instead of streaming every partition's whole
+  state.  ``route`` is only valid for the worker count that stamped
+  it: resuming at a different count must either refuse
+  (:class:`WorkerCountMismatchError`) or migrate every row to the new
+  modulus first (:meth:`RecoveryStore.rescale`, run at startup — the
+  one globally-ordered re-entry point).  The residency spill tier
+  (``engine/residency.py``) reuses this exact row format, including
+  ``route``, and migrates through the same
+  :func:`rescale_snaps_rows` routine.
 """
 
 import os
@@ -33,7 +45,11 @@ __all__ = [
     "NoPartitionsError",
     "RecoveryStore",
     "ResumeFrom",
+    "WorkerCountMismatchError",
+    "ensure_route_column",
     "init_db_dir",
+    "rescale_snaps_rows",
+    "route_of",
 ]
 
 _SCHEMA = """
@@ -64,9 +80,23 @@ CREATE TABLE IF NOT EXISTS snaps (
     state_key TEXT NOT NULL,
     epoch INTEGER NOT NULL,
     ser_change BLOB,
+    route INTEGER NOT NULL DEFAULT -1,
     PRIMARY KEY (step_id, state_key, epoch)
 );
 """
+
+
+def ensure_route_column(con: sqlite3.Connection) -> None:
+    """Upgrade a pre-routing ``snaps`` table in place: rows written by
+    an older store get ``route = -1`` (unknown), which every reader
+    includes regardless of its route filter — the engine's in-memory
+    ownership check still applies, so legacy rows resume exactly as
+    before, just without the read-scoping win."""
+    cols = [row[1] for row in con.execute("PRAGMA table_info(snaps)")]
+    if "route" not in cols:
+        con.execute(
+            "ALTER TABLE snaps ADD COLUMN route INTEGER NOT NULL DEFAULT -1"
+        )
 
 
 class NoPartitionsError(FileNotFoundError):
@@ -84,6 +114,33 @@ class InconsistentPartitionsError(ValueError):
     state needed to resume was already garbage collected in some
     partition.  Your ``backup_interval`` is probably shorter than the
     time between your backups."""
+
+
+class WorkerCountMismatchError(ValueError):
+    """Raised when a recovery store written by N workers is resumed by
+    a cluster with M != N workers and rescale-on-resume is not
+    enabled.  Keyed snapshot rows are route-stamped with the writing
+    execution's worker modulus, so resuming at a different size
+    without migrating them would silently mis-route (drop) keyed
+    state.  Rerun with ``--rescale`` / ``BYTEWAX_TPU_RESCALE=1`` to
+    migrate the store to the new worker count at run startup."""
+
+    def __init__(self, stored_counts, actual_count: int):
+        stored = sorted(set(stored_counts))
+        shown = stored[0] if len(stored) == 1 else stored
+        msg = (
+            f"recovery store was last written by an execution with "
+            f"{shown} worker(s), but this cluster has "
+            f"{actual_count}; resuming would route keyed snapshot "
+            "rows with a stale modulus and silently lose state.  "
+            "Enable rescale-on-resume with --rescale / "
+            "BYTEWAX_TPU_RESCALE=1 (the store is migrated to the new "
+            "worker count at run startup), or restart with the "
+            "original worker count."
+        )
+        super().__init__(msg)
+        self.stored_counts = tuple(stored)
+        self.actual_count = actual_count
 
 
 def _connect(path: Path) -> sqlite3.Connection:
@@ -119,11 +176,22 @@ def init_db_dir(db_dir: Union[str, Path], count: int) -> None:
 
 
 class ResumeFrom:
-    """Where to resume processing: execution number and epoch."""
+    """Where to resume processing: execution number and epoch.
 
-    def __init__(self, ex_num: int, resume_epoch: int):
+    ``stored_worker_counts`` carries the worker count(s) recorded by
+    the execution being resumed (empty for a fresh store; more than
+    one value only after a crash mid-rescale, which the next rescale
+    pass heals idempotently)."""
+
+    def __init__(
+        self,
+        ex_num: int,
+        resume_epoch: int,
+        stored_worker_counts: Tuple[int, ...] = (),
+    ):
         self.ex_num = ex_num
         self.resume_epoch = resume_epoch
+        self.stored_worker_counts = tuple(sorted(set(stored_worker_counts)))
 
     def __repr__(self) -> str:
         return f"ResumeFrom(ex_num={self.ex_num}, resume_epoch={self.resume_epoch})"
@@ -135,6 +203,48 @@ INIT_EPOCH = 1
 
 def _stable_hash(key: str) -> int:
     return zlib.adler32(key.encode("utf-8"))
+
+
+def route_of(state_key: str, worker_count: int) -> int:
+    """The home worker lane of a state key — the same
+    ``adler32 % worker_count`` hash the driver routes keyed exchanges
+    with, so a route-filtered resume read returns exactly the keys
+    the reading process owns."""
+    return _stable_hash(state_key) % worker_count
+
+
+def rescale_snaps_rows(
+    con: sqlite3.Connection,
+    new_worker_count: int,
+    page_size: int = 1000,
+) -> int:
+    """Re-stamp every ``snaps`` row's ``route`` for a new worker
+    count, paging over distinct state keys so migration memory stays
+    bounded by the page.  Works on any ``snaps``-format SQLite — the
+    recovery partitions and the residency spill tier share the row
+    format AND this migration routine.  Returns the number of
+    distinct keys migrated.  The caller owns the transaction (the
+    recovery store wraps all partitions in one all-or-nothing
+    transaction; see :meth:`RecoveryStore.rescale`)."""
+    migrated = 0
+    last = ""
+    while True:
+        rows = con.execute(
+            "SELECT DISTINCT state_key FROM snaps "
+            "WHERE state_key > ? ORDER BY state_key LIMIT ?",
+            (last, page_size),
+        ).fetchall()
+        if not rows:
+            return migrated
+        last = rows[-1][0]
+        con.executemany(
+            "UPDATE snaps SET route = ? WHERE state_key = ?",
+            [
+                (route_of(key, new_worker_count), key)
+                for (key,) in rows
+            ],
+        )
+        migrated += len(rows)
 
 
 class RecoveryStore:
@@ -155,6 +265,7 @@ class RecoveryStore:
         for path in paths:
             con = _connect(path)
             con.executescript(_SCHEMA)
+            ensure_route_column(con)
             row = con.execute(
                 "SELECT part_index, part_count FROM parts"
             ).fetchone()
@@ -196,13 +307,25 @@ class RecoveryStore:
 
     # -- resume calculation ------------------------------------------------
 
-    def resume_from(self) -> ResumeFrom:
+    def resume_from(
+        self,
+        worker_count: Optional[int] = None,
+        allow_rescale: bool = False,
+    ) -> ResumeFrom:
         """Compute the next execution number and the epoch to resume at.
 
         Mirrors the reference's resume SQL
         (``src/recovery.rs:1180-1275``): the resume epoch is the
         minimum over workers of each worker's latest frontier in the
         most recent execution; inconsistent GC raises.
+
+        When the caller passes its ``worker_count``, it is reconciled
+        against the count the resumed execution recorded: a mismatch
+        raises :class:`WorkerCountMismatchError` unless
+        ``allow_rescale`` is set, in which case the stored count(s)
+        ride back on ``ResumeFrom.stored_worker_counts`` and the
+        caller must run :meth:`rescale` before reading any keyed
+        snapshots.
         """
         exs: List[Tuple[int, int, int, int]] = []
         fronts: List[Tuple[int, int, int]] = []
@@ -224,7 +347,15 @@ class RecoveryStore:
         else:
             last_ex = max(row[0] for row in exs)
             last_rows = [row for row in exs if row[0] == last_ex]
-            worker_count = last_rows[0][2]
+            stored_counts = tuple(sorted({row[2] for row in last_rows}))
+            if (
+                worker_count is not None
+                and stored_counts != (worker_count,)
+                and not allow_rescale
+            ):
+                raise WorkerCountMismatchError(
+                    stored_counts, worker_count
+                )
             front_by_worker: Dict[int, int] = {}
             for ex_num, worker_index, epoch in fronts:
                 if ex_num == last_ex:
@@ -240,7 +371,9 @@ class RecoveryStore:
             # (e.g. a partition was restored from a stale backup)
             # simply don't constrain the minimum; the commit check
             # below catches true inconsistency.
-            resume = ResumeFrom(last_ex + 1, min(worker_epochs))
+            resume = ResumeFrom(
+                last_ex + 1, min(worker_epochs), stored_counts
+            )
 
         for idx, con in self._cons.items():
             row = con.execute("SELECT MAX(epoch) FROM commits").fetchone()
@@ -265,6 +398,7 @@ class RecoveryStore:
         before_epoch: int,
         step_ids: Optional[List[str]] = None,
         page_size: Optional[int] = None,
+        routes: Optional[List[int]] = None,
     ):
         """Yield ``(step_id, state_key, ser_change)`` for the latest
         state change per (step, key) strictly before an epoch, reading
@@ -272,7 +406,18 @@ class RecoveryStore:
         resume memory is bounded by the page — not the total state
         size.  Discard markers are skipped.  Each (step, key) lives in
         exactly one partition file (snapshots are key-hash
-        partitioned on write), so partitions stream independently."""
+        partitioned on write), so partitions stream independently.
+
+        ``routes`` scopes the read to rows whose home worker lane is
+        in the list (each resuming process passes its own lanes, so a
+        rescaled cluster reads 1/M of the state per process instead
+        of all of it M times).  Rows with an unknown route (``-1``,
+        written by a pre-routing store) are always included; callers
+        keep their own ownership filter as the correctness backstop.
+        Routes are only meaningful when they were stamped (or
+        migrated) under the caller's worker count — the
+        ``resume_from()`` reconciliation guarantees that before any
+        routed read happens."""
         if page_size is None:
             page_size = self.SNAP_PAGE
         conds = ["epoch < ?", "(step_id, state_key) > (?, ?)"]
@@ -280,6 +425,11 @@ class RecoveryStore:
         if step_ids is not None:
             filt = "step_id IN (%s)" % ",".join("?" * len(step_ids))
             conds.append(filt)
+        if routes is not None:
+            conds.append(
+                "(route < 0 OR route IN (%s))"
+                % ",".join("?" * len(routes))
+            )
         sql = (
             "SELECT s.step_id, s.state_key, s.ser_change "
             "FROM snaps s JOIN ("
@@ -298,6 +448,8 @@ class RecoveryStore:
                 args: List = [before_epoch, *last]
                 if step_ids is not None:
                     args += list(step_ids)
+                if routes is not None:
+                    args += list(routes)
                 rows = con.execute(sql, (*args, page_size)).fetchall()
                 if not rows:
                     break
@@ -367,9 +519,15 @@ class RecoveryStore:
                 con = self._part_for_key(step_id, state_key)
                 con.execute(
                     "INSERT OR REPLACE INTO snaps "
-                    "(step_id, state_key, epoch, ser_change) "
-                    "VALUES (?, ?, ?, ?)",
-                    (step_id, state_key, epoch, ser_change),
+                    "(step_id, state_key, epoch, ser_change, route) "
+                    "VALUES (?, ?, ?, ?, ?)",
+                    (
+                        step_id,
+                        state_key,
+                        epoch,
+                        ser_change,
+                        route_of(state_key, worker_count),
+                    ),
                 )
             for worker_index in workers if workers is not None else range(
                 worker_count
@@ -423,3 +581,52 @@ class RecoveryStore:
         else:
             for con in self._cons.values():
                 con.execute("COMMIT")
+
+    # -- rescale-on-resume -------------------------------------------------
+
+    def rescale(
+        self, new_worker_count: int, ex_num: Optional[int] = None
+    ) -> int:
+        """Migrate the store to a new worker count: re-stamp every
+        keyed snapshot row's route for the M-worker modulus and
+        rewrite the resumed execution's ``exs`` provenance to the new
+        count, in ONE all-partition transaction (the write_epoch
+        locking pattern) so a crash mid-migration rolls back whole —
+        the supervisor's retry re-enters at run startup and re-runs
+        the migration from scratch.  The pinned ``rescale_migrate``
+        fault site fires before any row moves.  Idempotent: re-running
+        it (e.g. after a crash that committed only some partitions)
+        recomputes the same routes.  Returns the number of distinct
+        state keys migrated.
+
+        May run ONLY at run startup — the one globally-ordered
+        re-entry point — and before any process reads keyed snapshots
+        (the driver's startup agreement round orders peers behind the
+        coordinator's migration).
+        """
+        for _idx, con in sorted(self._cons.items()):
+            con.execute("BEGIN IMMEDIATE")
+        migrated = 0
+        try:
+            # Chaos site: fires inside the transaction, before any row
+            # moves, so an injected error/crash proves mid-migration
+            # faults retry cleanly under the supervisor.
+            _faults.fire("rescale_migrate")
+            for con in self._cons.values():
+                migrated += rescale_snaps_rows(
+                    con, new_worker_count, page_size=self.SNAP_PAGE
+                )
+                if ex_num is not None and ex_num >= 0:
+                    con.execute(
+                        "UPDATE exs SET worker_count = ? "
+                        "WHERE ex_num = ?",
+                        (new_worker_count, ex_num),
+                    )
+        except BaseException:
+            for con in self._cons.values():
+                con.execute("ROLLBACK")
+            raise
+        else:
+            for con in self._cons.values():
+                con.execute("COMMIT")
+        return migrated

@@ -90,19 +90,25 @@ def state_budget() -> Optional[int]:
     return budget
 
 
-def maybe_wrap(step_id: str, state: Any) -> Any:
+def maybe_wrap(
+    step_id: str, state: Any, worker_count: Optional[int] = None
+) -> Any:
     """Wrap a device-tier key-state object in a residency manager when
     a budget is configured.  Returns ``state`` unchanged when the
     budget is unset (byte-identical engine) or the state is the
     collective global-exchange tier (per-process eviction would
     desynchronize the collective step shapes — same exclusion as
-    demotion)."""
+    demotion).  ``worker_count`` stamps spilled rows' ``route`` home
+    lane (the recovery snaps-format column); None leaves them
+    unrouted (-1)."""
     if state is None:
         return None
     budget = state_budget()
     if budget is None or getattr(state, "global_exchange", False):
         return state
-    return ResidentKeyState(step_id, state, budget)
+    return ResidentKeyState(
+        step_id, state, budget, worker_count=worker_count
+    )
 
 
 def _final_of_snap(kind: str, snap: Any) -> Any:
@@ -159,14 +165,18 @@ def _entry_keys(items: Any) -> List[str]:
 
 
 #: Same ``snaps`` DDL as the recovery store (recovery_store._SCHEMA):
-#: the spill tier IS recovery-format rows, just process-local and
-#: keyed by the live execution's epoch.
+#: the spill tier IS recovery-format rows — including the ``route``
+#: home-lane column — just process-local and keyed by the live
+#: execution's epoch, so the rescale-on-resume migration routine
+#: (:func:`bytewax_tpu.engine.recovery_store.rescale_snaps_rows`)
+#: applies to spill files unchanged.
 _SPILL_SCHEMA = """
 CREATE TABLE IF NOT EXISTS snaps (
     step_id TEXT NOT NULL,
     state_key TEXT NOT NULL,
     epoch INTEGER NOT NULL,
     ser_change BLOB,
+    route INTEGER NOT NULL DEFAULT -1,
     PRIMARY KEY (step_id, state_key, epoch)
 );
 """
@@ -185,7 +195,14 @@ class SpillStore:
     from a previous process's spill file.
     """
 
-    def __init__(self, db_dir: str, step_id: str):
+    def __init__(
+        self,
+        db_dir: str,
+        step_id: str,
+        worker_count: Optional[int] = None,
+    ):
+        from bytewax_tpu.engine.recovery_store import ensure_route_column
+
         path = Path(db_dir)
         path.mkdir(parents=True, exist_ok=True)
         tag = zlib.adler32(step_id.encode("utf-8")) & 0xFFFFFFFF
@@ -195,7 +212,12 @@ class SpillStore:
         self._con.execute("PRAGMA busy_timeout = 5000")
         self._con.execute("PRAGMA synchronous = NORMAL")
         self._con.executescript(_SPILL_SCHEMA)
+        ensure_route_column(self._con)
         self.step_id = step_id
+        #: Worker count the rows' ``route`` column is stamped under
+        #: (None = unrouted rows, route -1 — the recovery-format
+        #: "unknown home" marker).
+        self.worker_count = worker_count
         # Purge any rows a previous execution left behind: the file
         # name reuses the pid, so a supervised restart (same process)
         # or a crashed run would otherwise leave stale higher-epoch
@@ -210,15 +232,25 @@ class SpillStore:
         self, items: Iterable[Tuple[str, Any]], epoch: int
     ) -> int:
         """Write host-format snapshots; returns serialized bytes."""
+        from bytewax_tpu.engine.recovery_store import route_of
+
         nbytes = 0
         for key, state in items:
             ser = pickle.dumps(state)
             nbytes += len(ser)
             self._con.execute(
                 "INSERT OR REPLACE INTO snaps "
-                "(step_id, state_key, epoch, ser_change) "
-                "VALUES (?, ?, ?, ?)",
-                (self.step_id, key, epoch, ser),
+                "(step_id, state_key, epoch, ser_change, route) "
+                "VALUES (?, ?, ?, ?, ?)",
+                (
+                    self.step_id,
+                    key,
+                    epoch,
+                    ser,
+                    route_of(key, self.worker_count)
+                    if self.worker_count
+                    else -1,
+                ),
             )
         return nbytes
 
@@ -246,6 +278,21 @@ class SpillStore:
         self._con.execute(
             "DELETE FROM snaps WHERE step_id = ?", (self.step_id,)
         )
+
+    def rescale(self, new_worker_count: int) -> int:
+        """Re-stamp every spilled row's home lane for a new worker
+        count — the spill tier speaks the recovery ``snaps`` row
+        format, so it migrates through the SAME routine the recovery
+        partitions do.  Spill files are per-execution ephemeral (a
+        restart resumes spilled keys from the *recovery* store), so
+        the engine never calls this on the resume path; it exists so
+        the format contract stays closed: any snaps-format file in
+        the system is rescalable."""
+        from bytewax_tpu.engine.recovery_store import rescale_snaps_rows
+
+        migrated = rescale_snaps_rows(self._con, new_worker_count)
+        self.worker_count = new_worker_count
+        return migrated
 
     def close(self) -> None:
         self._con.close()
@@ -275,7 +322,13 @@ class ResidentKeyState:
     and :meth:`evict_to_budget` only after flushing the pipeline.
     """
 
-    def __init__(self, step_id: str, inner: Any, budget: int):
+    def __init__(
+        self,
+        step_id: str,
+        inner: Any,
+        budget: int,
+        worker_count: Optional[int] = None,
+    ):
         self._inner = inner
         self.step_id = step_id
         self.budget = budget
@@ -290,7 +343,9 @@ class ResidentKeyState:
             int(raw_host) if raw_host else 8 * budget
         ) if spill_dir else None
         self._spill = (
-            SpillStore(spill_dir, step_id) if spill_dir else None
+            SpillStore(spill_dir, step_id, worker_count=worker_count)
+            if spill_dir
+            else None
         )
         #: Host tier: key -> host-format snapshot, insertion-ordered
         #: (oldest eviction first — the spill candidate order).

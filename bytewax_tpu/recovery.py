@@ -5,7 +5,11 @@ boundary and written into a fixed number of SQLite *recovery
 partitions*; on resume the engine computes the epoch to roll back to
 and rebuilds all state from the latest consistent snapshots.  The
 partition count is independent of the worker/chip count, which is what
-makes rescaling work.
+makes rescaling possible: resuming at a *different* worker count is an
+explicit opt-in (``--rescale`` / ``BYTEWAX_TPU_RESCALE=1``) that
+re-shards every keyed snapshot row to the new routing at run startup;
+without it, a mismatched resume raises
+:class:`WorkerCountMismatchError` (see ``docs/recovery.md``).
 
 Store layout parity with the reference (``/root/reference/src/recovery.rs``):
 ``part-{i}.sqlite3`` files, snapshots keyed by ``(step_id, state_key,
@@ -26,6 +30,7 @@ from bytewax_tpu.engine.recovery_store import (
     InconsistentPartitionsError,
     MissingPartitionsError,
     NoPartitionsError,
+    WorkerCountMismatchError,
     init_db_dir,
 )
 
@@ -34,6 +39,7 @@ __all__ = [
     "MissingPartitionsError",
     "NoPartitionsError",
     "RecoveryConfig",
+    "WorkerCountMismatchError",
     "init_db_dir",
 ]
 

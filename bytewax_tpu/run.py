@@ -195,6 +195,17 @@ def _create_arg_parser() -> argparse.ArgumentParser:
         action=_EnvDefault,
         envvar="BYTEWAX_RECOVERY_BACKUP_INTERVAL",
     )
+    recovery.add_argument(
+        "--rescale",
+        action="store_true",
+        default=os.environ.get("BYTEWAX_TPU_RESCALE", "0")
+        not in ("", "0"),
+        help="Enable rescale-on-resume: when the recovery store was "
+        "written by a different worker count, migrate its keyed "
+        "state to this cluster's routing at run startup instead of "
+        "refusing with WorkerCountMismatchError "
+        "(env: BYTEWAX_TPU_RESCALE=1; see docs/recovery.md)",
+    )
     supervision = parser.add_argument_group(
         "Supervision",
         "Restart this worker in place after restartable faults "
@@ -293,6 +304,8 @@ def _main() -> None:
         os.environ["BYTEWAX_TPU_RESTART_BACKOFF_S"] = str(
             args.restart_backoff
         )
+    if args.rescale:
+        os.environ["BYTEWAX_TPU_RESCALE"] = "1"
     module_str, dataflow_name = _prepare_import(args.import_str)
     flow = _locate_dataflow(module_str, dataflow_name)
     recovery_config = None

@@ -341,6 +341,8 @@ def _cluster_test_main() -> None:
             passthrough += ["-s", str(args.snapshot_interval.total_seconds())]
         if args.backup_interval is not None:
             passthrough += ["-b", str(args.backup_interval.total_seconds())]
+        if args.rescale:
+            passthrough += ["--rescale"]
         sys.argv = passthrough
         run_main_cli()
         return
@@ -376,6 +378,8 @@ def _cluster_test_main() -> None:
             cmd += ["-s", str(args.snapshot_interval.total_seconds())]
         if args.backup_interval is not None:
             cmd += ["-b", str(args.backup_interval.total_seconds())]
+        if args.rescale:
+            cmd += ["--rescale"]
         procs.append(subprocess.Popen(cmd, env=env))
 
     exit_code = 0

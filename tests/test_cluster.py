@@ -553,7 +553,11 @@ op.output("out", s, FileSink({out_path!r}))
 
 def test_cluster_3proc_recovery_rescale(tmp_path):
     # 3-proc cluster writes snapshots; a 2-proc cluster resumes the
-    # same store (elastic rescale across executions).
+    # same store (elastic rescale across executions).  Rescale is an
+    # explicit opt-in since the rescale PR — the resumed run passes
+    # --rescale so the startup pass re-routes the keyed rows to the
+    # 2-worker modulus (tests/test_rescale.py covers the refusal and
+    # crash-retry paths).
     flow_py = tmp_path / "rescale_flow.py"
     out_path = str(tmp_path / "out.txt")
     flow_py.write_text(
@@ -584,7 +588,7 @@ op.output("out", fmt, FileSink({out_path!r}))
         timeout=60,
     )
 
-    def run_cluster(procs):
+    def run_cluster(procs, rescale=False):
         return subprocess.run(
             [
                 sys.executable,
@@ -599,7 +603,8 @@ op.output("out", fmt, FileSink({out_path!r}))
                 "0",
                 "-b",
                 "0",
-            ],
+            ]
+            + (["--rescale"] if rescale else []),
             env=_env(),
             cwd=tmp_path,
             capture_output=True,
@@ -612,7 +617,7 @@ op.output("out", fmt, FileSink({out_path!r}))
     first = Path(out_path).read_text().split()
     assert len(first) == 20
 
-    res = run_cluster(2)
+    res = run_cluster(2, rescale=True)
     assert res.returncode == 0, res.stderr[-2000:]
     lines = Path(out_path).read_text().split()
     # The running sums continue from the snapshotted state: the final

@@ -106,6 +106,13 @@ def test_fault_site_inventory_is_pinned():
     # (engine/residency.py).  It is a retryable device-path site
     # (DeviceFault, fired before any state mutates), pinned in
     # FAULT_DEVICE_SITES alongside device_dispatch.
+    # The rescale PR added exactly one more: rescale_migrate, fired
+    # inside the rescale-on-resume store transaction before any row
+    # moves (engine/recovery_store.py), so a mid-migration crash
+    # rolls back whole and retries under the supervisor.  It is NOT a
+    # device site (a plain restartable InjectedFault, not a
+    # DeviceFault), and the rescale mapping agreement added no
+    # control-frame kinds — it rides existing startup gsync rounds.
     assert contracts.FAULT_SITES == (
         "comm.send",
         "comm.recv",
@@ -113,6 +120,7 @@ def test_fault_site_inventory_is_pinned():
         "residency_restore",
         "snapshot.write",
         "snapshot.commit",
+        "rescale_migrate",
         "barrier",
     )
     assert contracts.FAULT_DEVICE_SITES == {

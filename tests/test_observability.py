@@ -208,6 +208,12 @@ def test_status_endpoint(entry_point, monkeypatch, tmp_path):
     assert status["recorder"]["enabled"] is True
     assert isinstance(status["recorder"]["counters"], dict)
     assert isinstance(status["cluster"], dict)
+    # The rescale recommendation signal (docs/recovery.md) is always
+    # present for external autoscalers to poll.
+    hint = status["rescale_hint"]
+    assert hint["advice"] in ("grow", "shrink", "hold")
+    assert isinstance(hint["reasons"], list)
+    assert hint["signals"]["worker_count"] == status["worker_count"]
 
 
 def test_status_cluster_gsync_piggyback(tmp_path):

@@ -145,7 +145,11 @@ def test_stateful_continuation(recovery_config):
     assert out == [("a", 4), ("b", 5)]
 
 
-def test_rescale(tmp_path):
+def test_rescale(tmp_path, monkeypatch):
+    # Rescale-on-resume is opt-in: with BYTEWAX_TPU_RESCALE=1 the
+    # keyed state is re-sharded to the new worker count at run
+    # startup (grow AND shrink), state intact across every resize.
+    monkeypatch.setenv("BYTEWAX_TPU_RESCALE", "1")
     init_db_dir(tmp_path, 3)
     recovery_config = RecoveryConfig(str(tmp_path))
 
@@ -184,6 +188,46 @@ def test_rescale(tmp_path):
     out.clear()
     entry_point(1)
     assert out == [("a", 8), ("b", 5)]
+
+
+def test_rescale_refused_without_flag(tmp_path, monkeypatch):
+    # Resuming a store written by N workers at M != N without the
+    # rescale opt-in must raise the typed mismatch error (naming the
+    # stored and actual counts and how to enable rescale) instead of
+    # silently routing snaps rows with a stale modulus.
+    from bytewax_tpu.recovery import WorkerCountMismatchError
+
+    monkeypatch.delenv("BYTEWAX_TPU_RESCALE", raising=False)
+    init_db_dir(tmp_path, 2)
+    recovery_config = RecoveryConfig(str(tmp_path))
+    inp = [("a", 4), ("b", 7), TestingSource.EOF(), ("a", 9)]
+    out = []
+    flow = build_keep_max_dataflow(inp, out)
+
+    def entry_point(worker_count_per_proc):
+        cluster_main(
+            flow,
+            addresses=[],
+            proc_id=0,
+            epoch_interval=ZERO_TD,
+            recovery_config=recovery_config,
+            worker_count_per_proc=worker_count_per_proc,
+        )
+
+    entry_point(3)
+    assert out == [("a", 4), ("b", 7)]
+    with pytest.raises(
+        WorkerCountMismatchError,
+        match=r"3 worker\(s\).*has 2.*BYTEWAX_TPU_RESCALE=1",
+    ) as exc_info:
+        entry_point(2)
+    assert exc_info.value.stored_counts == (3,)
+    assert exc_info.value.actual_count == 2
+    # Nothing was consumed or emitted by the refused execution; the
+    # same-count resume still works.
+    out.clear()
+    entry_point(3)
+    assert out == [("a", 9)]
 
 
 def test_no_parts(tmp_path):

@@ -1,0 +1,668 @@
+"""Elastic rescale-on-resume (tentpole of the rescale PR).
+
+A recovery store written by N total workers resumes at M != N only
+through the explicit rescale pass (``--rescale`` /
+``BYTEWAX_TPU_RESCALE=1``), which re-routes every keyed snapshot row
+to the new M-worker modulus at run startup — the one globally-ordered
+re-entry point.  Without the opt-in, the typed
+``WorkerCountMismatchError`` refuses instead of routing rows with a
+stale modulus.  Faults are injected ONLY through the engine's own
+injector (the pinned ``rescale_migrate`` site — no monkeypatching of
+engine internals).
+"""
+
+import os
+import pickle
+import random
+import sqlite3
+import subprocess
+import sys
+from datetime import timedelta
+from pathlib import Path
+
+import pytest
+
+import bytewax_tpu.operators as op
+from bytewax_tpu import xla
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.engine import faults, flight
+from bytewax_tpu.engine.driver import (
+    _backoff_delay,
+    cluster_main,
+    derive_rescale_hint,
+    run_main,
+)
+from bytewax_tpu.engine.recovery_store import (
+    RecoveryStore,
+    WorkerCountMismatchError,
+    init_db_dir,
+    rescale_snaps_rows,
+    route_of,
+)
+from bytewax_tpu.engine.residency import SpillStore
+from bytewax_tpu.recovery import RecoveryConfig
+from bytewax_tpu.testing import TestingSink, TestingSource
+
+ZERO_TD = timedelta(seconds=0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fault_plan():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- store-level: the mismatch gate ------------------------------------
+
+
+def _seed_store(tmp_path, worker_count, keys=("a", "b", "c")):
+    init_db_dir(tmp_path, 2)
+    store = RecoveryStore(tmp_path)
+    store.write_ex_started(0, worker_count, 1)
+    store.write_epoch(
+        0,
+        worker_count,
+        1,
+        [("df.s", k, pickle.dumps(ord(k[0]))) for k in keys],
+        None,
+    )
+    return store
+
+
+def test_resume_from_worker_count_gate(tmp_path):
+    store = _seed_store(tmp_path, worker_count=2)
+    # Equal counts and the legacy no-count call are untouched.
+    assert store.resume_from(worker_count=2).stored_worker_counts == (2,)
+    assert store.resume_from().resume_epoch == 2
+    # A mismatch without the opt-in refuses with the typed error,
+    # naming stored vs. actual and how to enable rescale.
+    with pytest.raises(
+        WorkerCountMismatchError,
+        match=r"2 worker\(s\).*has 5.*--rescale.*BYTEWAX_TPU_RESCALE=1",
+    ) as exc_info:
+        store.resume_from(worker_count=5)
+    assert exc_info.value.stored_counts == (2,)
+    assert exc_info.value.actual_count == 5
+    # With the opt-in, the stored counts ride back for the migration.
+    resume = store.resume_from(worker_count=5, allow_rescale=True)
+    assert resume.stored_worker_counts == (2,)
+    assert (resume.ex_num, resume.resume_epoch) == (1, 2)
+    store.close()
+
+
+def test_rescale_rewrites_routes_and_exs_provenance(tmp_path):
+    keys = [f"k{i:02d}" for i in range(40)]
+    store = _seed_store(tmp_path, worker_count=2, keys=keys)
+    migrated = store.rescale(3, ex_num=0)
+    assert migrated == len(keys)
+    for part in sorted(Path(tmp_path).glob("part-*.sqlite3")):
+        con = sqlite3.connect(part)
+        for key, route in con.execute(
+            "SELECT state_key, route FROM snaps"
+        ):
+            assert route == route_of(key, 3)
+        for (count,) in con.execute("SELECT worker_count FROM exs"):
+            assert count == 3
+        con.close()
+    # The provenance makes the migration durable: the store now
+    # resumes at 3 workers without rescale, and refuses at 2.
+    assert store.resume_from(worker_count=3).stored_worker_counts == (3,)
+    with pytest.raises(WorkerCountMismatchError):
+        store.resume_from(worker_count=2)
+    store.close()
+
+
+def test_rescale_route_scoped_reads_partition_the_state(tmp_path):
+    # After migration to M workers, the per-lane route filters return
+    # a disjoint cover of the keyed state — each resuming process
+    # reads exactly its own keys.
+    keys = [f"user-{i}" for i in range(64)]
+    store = _seed_store(tmp_path, worker_count=2, keys=keys)
+    store.rescale(3, ex_num=0)
+    by_lane = {
+        w: {k for _s, k, _b in store.iter_snaps(2, routes=[w])}
+        for w in range(3)
+    }
+    assert set().union(*by_lane.values()) == set(keys)
+    for w in range(3):
+        assert by_lane[w] == {k for k in keys if route_of(k, 3) == w}
+        for other in range(w + 1, 3):
+            assert not (by_lane[w] & by_lane[other])
+    store.close()
+
+
+def test_rescale_mid_migration_crash_rolls_back_whole(
+    tmp_path, monkeypatch
+):
+    # The pinned fault site fires inside the all-partition transaction
+    # before any row moves: an injected crash leaves the store exactly
+    # as it was (old routes, old exs provenance), and the retry —
+    # what the supervisor does after re-entering run startup —
+    # migrates cleanly.
+    keys = [f"k{i:02d}" for i in range(10)]
+    store = _seed_store(tmp_path, worker_count=2, keys=keys)
+    monkeypatch.setenv(
+        "BYTEWAX_TPU_FAULTS", "rescale_migrate:crash:*:x1"
+    )
+    faults.configure(0)
+    with pytest.raises(faults.InjectedCrash):
+        store.rescale(3, ex_num=0)
+    for part in sorted(Path(tmp_path).glob("part-*.sqlite3")):
+        con = sqlite3.connect(part)
+        for key, route in con.execute(
+            "SELECT state_key, route FROM snaps"
+        ):
+            assert route == route_of(key, 2), "rollback was not whole"
+        for (count,) in con.execute("SELECT worker_count FROM exs"):
+            assert count == 2
+        con.close()
+    # The x1 spec is spent: the retry (same process, same plan — the
+    # supervisor's restart semantics) succeeds and is idempotent.
+    assert store.rescale(3, ex_num=0) == len(keys)
+    assert store.rescale(3, ex_num=0) == len(keys)
+    store.close()
+
+
+# -- row-format pin: recovery partitions and the spill tier ------------
+
+
+def _table_shape(db_path):
+    con = sqlite3.connect(db_path)
+    info = [
+        (name, ctype, notnull, pk)
+        for _cid, name, ctype, notnull, _dflt, pk in con.execute(
+            "PRAGMA table_info(snaps)"
+        )
+    ]
+    con.close()
+    return info
+
+
+def test_spill_rows_share_snaps_format_and_migration(tmp_path):
+    # The residency spill tier IS recovery-format rows: identical
+    # column shape (route included), identical route stamping, and
+    # the SAME migration routine applies.
+    db = tmp_path / "db"
+    db.mkdir()
+    store = _seed_store(db, worker_count=2)
+    store.close()
+    spill = SpillStore(str(tmp_path / "spill"), "df.s", worker_count=2)
+    spill.put_many(
+        [(f"u{i}", float(i)) for i in range(20)], epoch=1
+    )
+    part = next(Path(db).glob("part-0.sqlite3"))
+    assert _table_shape(part) == _table_shape(spill._path)
+    con = sqlite3.connect(spill._path)
+    for key, route in con.execute("SELECT state_key, route FROM snaps"):
+        assert route == route_of(key, 2)
+    con.close()
+    # Shared migration routine, via the SpillStore surface.
+    assert spill.rescale(5) == 20
+    con = sqlite3.connect(spill._path)
+    for key, route in con.execute("SELECT state_key, route FROM snaps"):
+        assert route == route_of(key, 5)
+    con.close()
+    # And rescale_snaps_rows works directly on any snaps-format file.
+    con = sqlite3.connect(spill._path)
+    assert rescale_snaps_rows(con, 7) == 20
+    con.close()
+    spill.close()
+
+
+# -- supervisor backoff jitter ----------------------------------------
+
+
+def test_restart_backoff_jitter_is_seeded_per_proc():
+    def delays(proc_id):
+        rng = random.Random(f"bytewax-restart:{proc_id}")
+        return [_backoff_delay(0.5, a, rng) for a in range(1, 7)]
+
+    # Deterministic per process (reproducible restart schedules)...
+    assert delays(0) == delays(0)
+    # ...but desynchronized across the cluster: no two processes of a
+    # crashed cluster redial on the same schedule (thundering herd).
+    assert delays(0) != delays(1) != delays(2)
+    # Jitter stays within [0.5x, 1.5x) of the capped exponential
+    # curve, so backoff still backs off and still caps.
+    for proc in range(4):
+        for attempt, d in enumerate(delays(proc), start=1):
+            base = min(0.5 * (2 ** (attempt - 1)), 30.0)
+            assert 0.5 * base <= d < 1.5 * base
+
+
+# -- the rescale recommendation signal ---------------------------------
+
+
+def test_rescale_hint_grow_on_slow_epoch_close():
+    advice, reasons = derive_rescale_hint(
+        worker_count=2,
+        epoch_interval_s=10.0,
+        close_p99_s=6.0,
+        stall_s_per_close=0.0,
+        restores_per_close=0.0,
+    )
+    assert advice == "grow"
+    assert any("epoch_close_p99" in r for r in reasons)
+
+
+def test_rescale_hint_grow_on_flush_stalls_and_restores():
+    advice, reasons = derive_rescale_hint(
+        worker_count=1,
+        epoch_interval_s=10.0,
+        close_p99_s=0.1,
+        stall_s_per_close=3.0,
+        restores_per_close=0.0,
+    )
+    assert advice == "grow" and any("stall" in r for r in reasons)
+    advice, reasons = derive_rescale_hint(
+        worker_count=1,
+        epoch_interval_s=0.0,
+        close_p99_s=0.001,
+        stall_s_per_close=0.0,
+        restores_per_close=8.0,
+    )
+    assert advice == "grow"
+    assert any("residency restores" in r for r in reasons)
+    # Active two-way disk-tier traffic (spills AND restores) is its
+    # own grow reason — the residency-spill-rate signal.
+    advice, reasons = derive_rescale_hint(
+        worker_count=1,
+        epoch_interval_s=10.0,
+        close_p99_s=0.1,
+        stall_s_per_close=0.0,
+        restores_per_close=0.5,
+        spill_bytes_per_close=65536.0,
+    )
+    assert advice == "grow"
+    assert any("spill bytes" in r for r in reasons)
+
+
+def test_rescale_hint_transients_decay_instead_of_latching():
+    # Signals are lifetime averages off cumulative counters: a one-off
+    # warm-up spill/restore/stall must neither pin "grow" forever nor
+    # block "shrink" forever once amortized over many epoch closes.
+    advice, _ = derive_rescale_hint(
+        worker_count=4,
+        epoch_interval_s=10.0,
+        close_p99_s=0.1,
+        stall_s_per_close=0.001,  # one 1s stall over 1000 closes
+        restores_per_close=0.01,  # one restore over 100 closes
+        spill_bytes_per_close=10.0,  # one small spill, amortized
+    )
+    assert advice == "shrink"
+
+
+def test_rescale_hint_shrink_only_when_everything_quiet():
+    quiet = dict(
+        epoch_interval_s=10.0,
+        close_p99_s=0.1,
+        stall_s_per_close=0.0,
+        restores_per_close=0.0,
+    )
+    advice, reasons = derive_rescale_hint(worker_count=4, **quiet)
+    assert advice == "shrink" and reasons
+    # A single worker can't shrink; any pressure flips to hold.
+    assert derive_rescale_hint(worker_count=1, **quiet)[0] == "hold"
+    assert (
+        derive_rescale_hint(
+            worker_count=4, **{**quiet, "restores_per_close": 0.5}
+        )[0]
+        == "hold"
+    )
+
+
+def test_rescale_hint_hold_before_any_signal():
+    advice, reasons = derive_rescale_hint(
+        worker_count=2,
+        epoch_interval_s=10.0,
+        close_p99_s=None,
+        stall_s_per_close=0.0,
+        restores_per_close=0.0,
+    )
+    assert (advice, reasons) == ("hold", [])
+
+
+# -- in-process engine: grow + shrink with the spill tier populated ----
+
+
+def _ema_flow(inp, out):
+    flow = Dataflow("rescale_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=4))
+    scored = op.stateful_map("ema", s, xla.ema(0.3))
+    op.output("out", scored, TestingSink(out))
+    return flow
+
+
+def _canon(rows):
+    # (key, (orig, ema)) rows; round so device f32 vs host f64
+    # arithmetic compares stably (the test_chaos demotion idiom).
+    return sorted(
+        (k, tuple(round(float(x), 3) for x in v)) for k, v in rows
+    )
+
+
+def _entry(worker_count):
+    if worker_count == 1:
+        return run_main
+    return lambda *a, **kw: cluster_main(
+        *a, [], 0, worker_count_per_proc=worker_count, **kw
+    )
+
+
+@pytest.mark.parametrize(
+    "n_from,n_to",
+    [(1, 3), (3, 1), (2, 3), (3, 2)],
+    ids=["grow-1to3", "shrink-3to1", "grow-2to3", "shrink-3to2"],
+)
+def test_rescale_resume_with_spilled_keys(
+    tmp_path, monkeypatch, n_from, n_to
+):
+    # A run stopped at N total workers resumes at M != N (grow AND
+    # shrink, covering the run_main and in-process cluster_main entry
+    # points) with the residency budget so small that most keys sit
+    # in the host/disk spill tiers when the stop happens — outputs
+    # must equal an uninterrupted host-tier oracle.
+    n_keys, n_rows = 32, 256
+    inp = [
+        (f"u{i % n_keys:02d}", float(i % 11)) for i in range(n_rows)
+    ]
+    half = n_rows // 2
+    db = tmp_path / "db"
+    db.mkdir()
+    init_db_dir(db, 2)
+    rc = RecoveryConfig(str(db))
+    monkeypatch.setenv("BYTEWAX_TPU_RESCALE", "1")
+    monkeypatch.setenv("BYTEWAX_TPU_STATE_BUDGET", "2")
+    monkeypatch.setenv("BYTEWAX_TPU_HOST_STATE_BUDGET", "4")
+    monkeypatch.setenv(
+        "BYTEWAX_TPU_SPILL_DIR", str(tmp_path / "spill")
+    )
+
+    spilled_before = flight.RECORDER.counters.get(
+        "state_spill_bytes", 0
+    )
+    out = []
+    _entry(n_from)(
+        _ema_flow(
+            inp[:half] + [TestingSource.EOF()] + inp[half:], out
+        ),
+        epoch_interval=ZERO_TD,
+        recovery_config=rc,
+    )
+    assert _canon(out) == _canon(_host_ema_oracle(inp[:half]))
+    # The stop really left keys in the spill tier (the rescale must
+    # carry them: their epoch snapshots read through the manager).
+    assert (
+        flight.RECORDER.counters.get("state_spill_bytes", 0)
+        > spilled_before
+    )
+
+    rescales_before = flight.RECORDER.counters.get("rescale_count", 0)
+    out2 = []
+    _entry(n_to)(
+        _ema_flow(
+            inp[:half] + [TestingSource.EOF()] + inp[half:], out2
+        ),
+        epoch_interval=ZERO_TD,
+        recovery_config=rc,
+    )
+    assert (
+        flight.RECORDER.counters.get("rescale_count", 0)
+        == rescales_before + 1
+    )
+    assert flight.RECORDER.counters.get("rescale_migrated_keys", 0) > 0
+    assert _canon(out2) == _canon(
+        _host_ema_oracle(inp)[half:]
+    ), f"keyed state lost or duplicated across the {n_from}->{n_to} rescale"
+
+
+def _host_ema_oracle(rows, alpha=0.3):
+    # xla.ema semantics: debiased EMA over (count, s) state.
+    state = {}
+    out = []
+    for key, value in rows:
+        count, s = state.get(key, (0, 0.0))
+        count += 1
+        s = s * (1.0 - alpha) + alpha * value
+        state[key] = (count, s)
+        ema = s / (1.0 - (1.0 - alpha) ** count)
+        out.append((key, (value, ema)))
+    return out
+
+
+def test_rescale_resume_migration_crash_retries_under_supervisor(
+    tmp_path, monkeypatch
+):
+    # End-to-end through the real fault site IN-PROCESS: the first
+    # rescale attempt crashes mid-migration; the supervisor re-enters
+    # at run startup, the rolled-back migration re-runs, and the
+    # resumed output is exactly-once.
+    inp = [(f"k{i % 4}", float(i)) for i in range(64)]
+    half = len(inp) // 2
+    db = tmp_path / "db"
+    db.mkdir()
+    init_db_dir(db, 1)
+    rc = RecoveryConfig(str(db))
+    out = []
+    _entry(2)(
+        _ema_flow(inp[:half] + [TestingSource.EOF()] + inp[half:], out),
+        epoch_interval=ZERO_TD,
+        recovery_config=rc,
+    )
+
+    monkeypatch.setenv("BYTEWAX_TPU_RESCALE", "1")
+    monkeypatch.setenv(
+        "BYTEWAX_TPU_FAULTS", "rescale_migrate:crash:*:x1"
+    )
+    monkeypatch.setenv("BYTEWAX_TPU_MAX_RESTARTS", "2")
+    monkeypatch.setenv("BYTEWAX_TPU_RESTART_BACKOFF_S", "0.05")
+    faults.reset()
+    restarts_before = flight.RECORDER.counters.get(
+        "worker_restart_count", 0
+    )
+    out2 = []
+    _entry(3)(
+        _ema_flow(inp[:half] + [TestingSource.EOF()] + inp[half:], out2),
+        epoch_interval=ZERO_TD,
+        recovery_config=rc,
+    )
+    assert (
+        flight.RECORDER.counters.get("worker_restart_count", 0)
+        == restarts_before + 1
+    )
+    assert _canon(out2) == _canon(_host_ema_oracle(inp)[half:])
+
+
+# -- subprocess clusters: 2<->3 processes under injected crashes -------
+
+
+def _env(extra=None, accel=False):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    env["BYTEWAX_TPU_PLATFORM"] = "cpu"
+    if not accel:
+        env["BYTEWAX_TPU_ACCEL"] = "0"  # keep subprocess startup light
+    for k in (
+        "BYTEWAX_TPU_FAULTS",
+        "BYTEWAX_TPU_MAX_RESTARTS",
+        "BYTEWAX_TPU_RESCALE",
+        "BYTEWAX_TPU_STATE_BUDGET",
+        "BYTEWAX_TPU_SPILL_DIR",
+        "BYTEWAX_TPU_HOST_STATE_BUDGET",
+    ):
+        env.pop(k, None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+_SEQ_FLOW = '''
+import os
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.inputs import FixedPartitionedSource, StatefulSourcePartition
+
+
+class _Part(StatefulSourcePartition):
+    def __init__(self, name, resume):
+        self._name = name
+        self._i = resume or 0
+
+    def next_batch(self):
+        if self._i >= int(os.environ["RESCALE_CAP"]):
+            raise StopIteration()
+        self._i += 1
+        return [(f"{{self._name}}-{{self._i % 8}}", float(self._i % 13))]
+
+    def snapshot(self):
+        return self._i
+
+
+class SeqSource(FixedPartitionedSource):
+    def list_parts(self):
+        return ["p0", "p1"]
+
+    def build_part(self, step_id, name, resume):
+        return _Part(name, resume)
+
+
+flow = Dataflow("rescale_cluster_df")
+s = op.input("inp", flow, SeqSource())
+s = op.stateful_map("ema", s, lambda st, v: (
+    (v if st is None else st + 0.3 * (v - st),) * 2
+))
+s = op.map("fmt", s, lambda kv: (kv[0], f"{{kv[0]}}={{kv[1]:.3f}}"))
+op.output("out", s, FileSink({out_path!r}))
+'''
+
+
+def _spawn_cluster(tmp_path, name, procs, cap, db, out_path, extra_env):
+    flow_py = tmp_path / f"{name}.py"
+    flow_py.write_text(_SEQ_FLOW.format(out_path=str(out_path)))
+    env = _env(extra_env)
+    env["RESCALE_CAP"] = str(cap)
+    cmd = [
+        sys.executable,
+        "-m",
+        "bytewax_tpu.testing",
+        f"{flow_py}:flow",
+        "-p",
+        str(procs),
+        "-r",
+        str(db),
+        "-s",
+        "0",
+        "-b",
+        "0",
+    ]
+    if extra_env and extra_env.get("BYTEWAX_TPU_RESCALE") == "1":
+        cmd.append("--rescale")
+    return subprocess.run(
+        cmd,
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+
+
+def _seq_oracle(cap):
+    want = []
+    for part in ("p0", "p1"):
+        emas = {}
+        for i in range(1, cap + 1):
+            key = f"{part}-{i % 8}"
+            v = float(i % 13)
+            prev = emas.get(key)
+            emas[key] = (
+                v if prev is None else prev + 0.3 * (v - prev)
+            )
+            want.append(f"{key}={emas[key]:.3f}")
+    return sorted(want)
+
+
+def _init_db(tmp_path, name):
+    db = tmp_path / f"{name}_db"
+    db.mkdir()
+    subprocess.run(
+        [sys.executable, "-m", "bytewax_tpu.recovery", str(db), "2"],
+        env=_env(),
+        check=True,
+        timeout=60,
+    )
+    return db
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "p_from,p_to", [(2, 3), (3, 2)], ids=["grow-2to3", "shrink-3to2"]
+)
+def test_cluster_rescale_under_injected_migration_crash(
+    tmp_path, p_from, p_to
+):
+    # A real multi-process cluster stops at N processes (EOF at half
+    # the input); the relaunch at M processes takes an injected CRASH
+    # at the pinned rescale_migrate site on proc 0 (mid-migration,
+    # inside the store transaction).  The supervisors restart the
+    # whole cluster, the rolled-back migration re-runs, and the final
+    # output is byte-identical to an uninterrupted run — exactly-once
+    # across both the resize and the crash.
+    name = f"resc_{p_from}to{p_to}"
+    cap = 40
+    db = _init_db(tmp_path, name)
+    out = tmp_path / f"{name}_out.txt"
+
+    res = _spawn_cluster(
+        tmp_path, name, p_from, cap // 2, db, out, {}
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+
+    res = _spawn_cluster(
+        tmp_path,
+        name,
+        p_to,
+        cap,
+        db,
+        out,
+        {
+            "BYTEWAX_TPU_RESCALE": "1",
+            "BYTEWAX_TPU_FAULTS": "rescale_migrate:crash:*:0:x1",
+            "BYTEWAX_TPU_MAX_RESTARTS": "3",
+            "BYTEWAX_TPU_RESTART_BACKOFF_S": "0.1",
+        },
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "supervised restart" in res.stderr, res.stderr[-3000:]
+    assert "rescaled recovery store" in res.stderr, res.stderr[-3000:]
+    assert sorted(out.read_text().split()) == _seq_oracle(cap)
+
+
+@pytest.mark.slow
+def test_cluster_rescale_refused_without_flag(tmp_path):
+    # The same relaunch WITHOUT the opt-in fails fast on every
+    # process with the typed mismatch error and consumes nothing.
+    name = "refuse"
+    cap = 20
+    db = _init_db(tmp_path, name)
+    out = tmp_path / f"{name}_out.txt"
+    res = _spawn_cluster(tmp_path, name, 2, cap // 2, db, out, {})
+    assert res.returncode == 0, res.stderr[-3000:]
+    before = sorted(out.read_text().split())
+
+    res = _spawn_cluster(tmp_path, name, 3, cap, db, out, {})
+    assert res.returncode != 0
+    assert "WorkerCountMismatchError" in res.stderr
+    assert sorted(out.read_text().split()) == before
+
+    # And with it, the run completes against the oracle.
+    res = _spawn_cluster(
+        tmp_path, name, 3, cap, db, out, {"BYTEWAX_TPU_RESCALE": "1"}
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert sorted(out.read_text().split()) == _seq_oracle(cap)
