@@ -1159,6 +1159,12 @@ def main() -> None:
         extra["epoch_close_p50_ms"] = round(p50_s * 1e3, 3)
         extra["epoch_close_p99_ms"] = round(p99_s_close * 1e3, 3)
         extra["epoch_closes_recorded"] = n_closes_rec
+    # Epoch-ledger attribution (docs/observability.md): where this
+    # round's epochs actually went — host routing vs device folds vs
+    # flush stalls vs barrier/gsync/snapshot — as fractions of the
+    # attributed time, so BENCH_* files track the measured bottleneck
+    # round over round, not just the close latency.
+    extra["epoch_phase_fractions"] = flight.ledger_fractions()
 
     # Persistent-compile-cache cold vs warm start (fresh processes;
     # the warm figure is what a supervised restart or redeploy pays).

@@ -23,6 +23,7 @@ __all__ = [
     "comm_frames",
     "device_transfer_bytes",
     "epoch_close_duration_seconds",
+    "epoch_phase_seconds",
     "fault_injected_count",
     "generate_python_metrics",
     "gsync_round_count",
@@ -32,6 +33,7 @@ __all__ = [
     "pipeline_flush_stall_seconds",
     "rescale_duration_seconds",
     "rescale_migrated_keys",
+    "source_lag_seconds",
     "state_evictions_count",
     "state_resident_keys",
     "state_spill_bytes",
@@ -124,6 +126,25 @@ DURATION_HISTOGRAMS: Dict[str, Histogram] = {
 # The reference instruments only user-code call sites; these cover the
 # parts this reproduction adds — the device tier and the clustered
 # epoch protocol (fed by ``bytewax_tpu/engine/flight.py``).
+
+epoch_phase_seconds = Counter(
+    "bytewax_epoch_phase_seconds",
+    "Per-epoch time attribution (the epoch ledger, "
+    "docs/observability.md): cumulative seconds spent in each engine "
+    "phase, exclusive of nested phases.  step_id is '*' for "
+    "process-wide phases (barrier, gsync, snapshot, commit)",
+    ["phase", "step_id"],
+)
+
+source_lag_seconds = Gauge(
+    "bytewax_source_lag_seconds",
+    "Source lag accounting: kind=event_time is wall-clock now minus "
+    "the freshest event timestamp a source batch carried at ingest "
+    "(the watermark trails it by the configured wait); "
+    "kind=processing is one delivery's ingest-to-emit latency "
+    "through a device-tier step's dispatch pipeline",
+    ["step_id", "kind"],
+)
 
 epoch_close_duration_seconds = Histogram(
     "bytewax_epoch_close_duration_seconds",

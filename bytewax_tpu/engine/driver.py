@@ -16,6 +16,7 @@ eligible segments of the same plan on the device mesh; both tiers share
 this driver's epoch/recovery bookkeeping.
 """
 
+import contextlib
 import os
 import pickle
 import random
@@ -90,6 +91,48 @@ def _route_hashes_of(strs) -> np.ndarray:
 
 def _now() -> datetime:
     return datetime.now(timezone.utc)
+
+
+def _batch_event_lag_s(items: Any, now: datetime) -> Optional[float]:
+    """Event-time lag of one source batch at ingest: wall-clock now
+    minus the freshest event timestamp the batch carries (``ts``
+    column on a columnar batch; a trailing datetime/TsValue row on an
+    itemized one — sources emit in arrival order, so the last row is
+    the freshest).  None when the batch carries no discoverable event
+    time; the watermark trails this by the clock's configured wait."""
+    try:
+        if isinstance(items, ArrayBatch):
+            col = items.cols.get("ts")
+            if col is None:
+                return None
+            arr = np.asarray(col)
+            if not len(arr) or not np.issubdtype(
+                arr.dtype, np.datetime64
+            ):
+                return None
+            latest = arr.max().astype("datetime64[us]")
+            if np.isnat(latest):
+                # A NaT (missing timestamp) propagates through max()
+                # and would turn the lag into NaN — which json.dumps
+                # renders as a bare token no spec-compliant parser
+                # accepts, poisoning /status cluster-wide.
+                return None
+            now64 = np.datetime64(now.replace(tzinfo=None), "us")
+            return float((now64 - latest) / np.timedelta64(1, "s"))
+        last = items[-1]
+    except (TypeError, IndexError, KeyError, ValueError):
+        return None
+    value = last
+    if isinstance(last, tuple) and len(last) == 2:
+        value = last[1]
+    ts = value if isinstance(value, datetime) else None
+    if ts is None:
+        ts = getattr(value, "ts", None)
+        if not isinstance(ts, datetime):
+            return None
+    if ts.tzinfo is None:
+        return None
+    return (now - ts).total_seconds()
 
 
 def _extract_kv(item: Any, step_id: str) -> Tuple[str, Any]:
@@ -172,6 +215,13 @@ _HINT_QUIET_CLOSE_FRAC = 0.05
 _HINT_QUIET_STALL_FRAC = 0.01
 _HINT_QUIET_RESTORES = 0.1
 _HINT_QUIET_SPILL_BYTES = 256.0
+#: Ledger-fraction thresholds: epochs whose attributed time is mostly
+#: device folds + pipeline flush stalls are compute-saturated (grow);
+#: epochs mostly spent waiting in the cluster barrier mean THIS
+#: process is ahead of its peers — growing it buys nothing (hold, or
+#: shrink when everything else is quiet too).
+_HINT_DEVICE_FRAC = 0.5
+_HINT_BARRIER_FRAC = 0.5
 
 
 def derive_rescale_hint(
@@ -182,6 +232,7 @@ def derive_rescale_hint(
     stall_s_per_close: float,
     restores_per_close: float,
     spill_bytes_per_close: float = 0.0,
+    phase_fractions: Optional[Dict[str, float]] = None,
 ) -> Tuple[str, List[str]]:
     """Pure rescale advice from the engine's load signals.
 
@@ -192,7 +243,14 @@ def derive_rescale_hint(
     averages so the advice is rate-based, not run-length-based; with
     no closes recorded yet everything reads zero and the advice is
     ``hold``.  Deliberately conservative: ``shrink`` needs EVERY
-    signal quiet, ``grow`` needs any one loud."""
+    signal quiet, ``grow`` needs any one loud.
+
+    ``phase_fractions`` is the epoch ledger's measured attribution
+    (:func:`bytewax_tpu.engine.flight.ledger_fractions`), when
+    available: device-or-flush-dominated epochs are their own grow
+    reason, and barrier-dominated epochs veto grow (this process is
+    waiting on its peers — more of it won't help) and count toward
+    shrink instead."""
     reasons: List[str] = []
     if (
         close_p99_s is not None
@@ -225,7 +283,28 @@ def derive_rescale_hint(
             f"{spill_bytes_per_close:.0f} spill bytes/epoch alongside "
             "restores: state is actively paging through the disk tier"
         )
+    fractions = phase_fractions or {}
+    device_frac = fractions.get("device", 0.0) + fractions.get(
+        "flush", 0.0
+    )
+    barrier_frac = fractions.get("barrier", 0.0)
+    if device_frac > _HINT_DEVICE_FRAC:
+        reasons.append(
+            f"ledger: {device_frac:.0%} of attributed epoch time is "
+            "device folds + pipeline flush stalls — the device tier "
+            "is the measured bottleneck"
+        )
+    barrier_bound = barrier_frac > _HINT_BARRIER_FRAC
     if reasons:
+        if barrier_bound:
+            # The attribution says this process spends its epochs
+            # waiting for peers — its own loud signals are skew, not
+            # saturation, and a grow would add more waiters.
+            return "hold", [
+                f"ledger: {barrier_frac:.0%} of attributed epoch "
+                "time is barrier wait — this process is ahead of "
+                "its peers; growing would add waiters, not throughput"
+            ] + reasons
         return "grow", reasons
     if (
         worker_count > 1
@@ -241,6 +320,12 @@ def derive_rescale_hint(
             f"epoch_close_p99 {close_p99_s:.3f}s is under "
             f"{_HINT_QUIET_CLOSE_FRAC:.0%} of the epoch interval with "
             "negligible pipeline stalls and residency pressure"
+        ]
+    if barrier_bound and worker_count > 1:
+        return "shrink", [
+            f"ledger: {barrier_frac:.0%} of attributed epoch time "
+            "is barrier wait — the cluster is skewed or oversized "
+            "for the load; fewer processes may do"
         ]
     return "hold", reasons
 
@@ -303,6 +388,14 @@ def _supervised(
             make(generation).run()
             return
         except _RESTARTABLE as ex:
+            # Crash post-mortem (BYTEWAX_TPU_POSTMORTEM_DIR): the
+            # flight ring tail, counters, and the in-flight epoch's
+            # ledger, written before any restart decision so the
+            # evidence survives whether this burst restarts or gives
+            # up.  ``generation`` is still the generation that failed.
+            _flight.write_postmortem(
+                proc_id, generation, type(ex).__name__, str(ex)
+            )
             if time.monotonic() - started >= reset_s:
                 attempt = 0  # healthy run: new failure burst
             if attempt >= max_restarts:
@@ -413,24 +506,43 @@ class _OpRt:
         )
 
     def drain(self) -> None:
-        for port, q in self.queues.items():
-            if q:
-                entries, self.queues[port] = q, []
-                for w, items in entries:
-                    self._count_inp(w, len(items))
-                if self.driver.trace_ops:
-                    # Per-activation spans, like the reference's
-                    # debug_span!("operator") (src/operators.rs:184) —
-                    # only when a backend/DEBUG logging wants them.
-                    with _span(
-                        "operator",
-                        step_id=self.op.step_id,
-                        port=port,
-                        entries=len(entries),
-                    ):
+        if not any(self.queues.values()):
+            return
+        # Ledger: everything the main thread does to move this step's
+        # queued deliveries (routing, host folds, pipeline submits) is
+        # the "host" phase; nested leaf phases (flush stalls, restores,
+        # evictions, readbacks) subtract so the sums stay disjoint.
+        rec = _flight.RECORDER
+        rec.phase_push()
+        t0 = time.monotonic()
+        try:
+            for port, q in self.queues.items():
+                if q:
+                    entries, self.queues[port] = q, []
+                    for w, items in entries:
+                        self._count_inp(w, len(items))
+                    if self.driver.trace_ops:
+                        # Per-activation spans, like the reference's
+                        # debug_span!("operator") (src/operators.rs:184) —
+                        # only when a backend/DEBUG logging wants them.
+                        with _span(
+                            "operator",
+                            step_id=self.op.step_id,
+                            port=port,
+                            entries=len(entries),
+                        ):
+                            self.process(port, entries)
+                    else:
                         self.process(port, entries)
-                else:
-                    self.process(port, entries)
+        finally:
+            gross = time.monotonic() - t0
+            _flight.note_phase(
+                "host",
+                self.op.step_id,
+                max(gross - rec.phase_pop(), 0.0),
+                gross=gross,
+                t0=t0,
+            )
 
     def process(self, port: str, entries: List[Entry]) -> None:
         raise NotImplementedError()
@@ -526,38 +638,55 @@ class _InputRt(_OpRt):
 
     def poll(self, now: datetime) -> bool:
         progressed = False
-        for name in list(self.parts.keys()):
-            part = self.parts[name]
-            na = self.next_awake[name]
-            if na is not None and na > now:
-                continue
-            try:
-                with self._timer(
-                    "inp_part_next_batch", self.part_worker.get(name)
-                ).time():
-                    batch = part.next_batch()
-                if not isinstance(batch, (list, ArrayBatch)):
-                    batch = list(batch)
-            except StopIteration:
-                if self.stateful:
-                    self.pending_snaps.append((name, part.snapshot()))
-                part.close()
-                del self.parts[name]
-                progressed = True
-                continue
-            except AbortExecution:
-                raise _Abort() from None
-            except BaseException as ex:  # noqa: BLE001
-                _reraise(self.op.step_id, "`next_batch`", ex)
-            if batch:
-                self.emit(
-                    "down", (self.part_worker[name], batch)
+        polled = False
+        t0 = time.monotonic()
+        try:
+            for name in list(self.parts.keys()):
+                part = self.parts[name]
+                na = self.next_awake[name]
+                if na is not None and na > now:
+                    continue
+                polled = True
+                try:
+                    with self._timer(
+                        "inp_part_next_batch", self.part_worker.get(name)
+                    ).time():
+                        batch = part.next_batch()
+                    if not isinstance(batch, (list, ArrayBatch)):
+                        batch = list(batch)
+                except StopIteration:
+                    if self.stateful:
+                        self.pending_snaps.append((name, part.snapshot()))
+                    part.close()
+                    del self.parts[name]
+                    progressed = True
+                    continue
+                except AbortExecution:
+                    raise _Abort() from None
+                except BaseException as ex:  # noqa: BLE001
+                    _reraise(self.op.step_id, "`next_batch`", ex)
+                if batch:
+                    self.emit(
+                        "down", (self.part_worker[name], batch)
+                    )
+                    progressed = True
+                    lag = _batch_event_lag_s(batch, now)
+                    if lag is not None:
+                        _flight.note_source_lag(
+                            self.op.step_id, "event_time", lag
+                        )
+                part_na = part.next_awake()
+                if part_na is None and not batch:
+                    part_na = now + _EMPTY_COOLDOWN
+                self.next_awake[name] = part_na
+        finally:
+            if polled:
+                _flight.note_phase(
+                    "ingest",
+                    self.op.step_id,
+                    time.monotonic() - t0,
+                    t0=t0,
                 )
-                progressed = True
-            part_na = part.next_awake()
-            if part_na is None and not batch:
-                part_na = now + _EMPTY_COOLDOWN
-            self.next_awake[name] = part_na
         if not self.parts:
             self.eof = True
         return progressed
@@ -1632,14 +1761,33 @@ class _StatefulBatchRt(_OpRt):
                 at = self.wagg.notify_at()
             if at is not None and at <= now:
                 # Window close is a drain point: quiesce the pipeline,
-                # then scan/close synchronously as before.
-                self.pipeline_flush()
+                # then scan/close synchronously as before.  Host-phase
+                # ledger time (the flush stall inside subtracts as its
+                # own leaf).
+                rec = _flight.RECORDER
+                rec.phase_push()
+                t0 = time.monotonic()
                 try:
-                    with self._timer("stateful_batch_on_notify").time():
-                        events = self.wagg.on_notify()
-                except BaseException as ex:  # noqa: BLE001
-                    _reraise(self.op.step_id, "the device window fold", ex)
-                self._emit_window_events(events)
+                    self.pipeline_flush()
+                    try:
+                        with self._timer(
+                            "stateful_batch_on_notify"
+                        ).time():
+                            events = self.wagg.on_notify()
+                    except BaseException as ex:  # noqa: BLE001
+                        _reraise(
+                            self.op.step_id, "the device window fold", ex
+                        )
+                    self._emit_window_events(events)
+                finally:
+                    gross = time.monotonic() - t0
+                    _flight.note_phase(
+                        "host",
+                        self.op.step_id,
+                        max(gross - rec.phase_pop(), 0.0),
+                        gross=gross,
+                        t0=t0,
+                    )
             return
         due = sorted(
             (key for key, at in self.sched.items() if at <= now)
@@ -2138,6 +2286,9 @@ class _Driver:
         )
 
         self.rts: List[_OpRt] = []
+        #: /healthz readiness: True once run startup (mesh handshake,
+        #: agreement round, rescale migration, runtime builds) is done.
+        self._ready = False
 
     # -- cluster topology --------------------------------------------------
 
@@ -2188,10 +2339,38 @@ class _Driver:
             self.rts[ci].queues[port].append(entry)
         self._progressed = True
 
+    @contextlib.contextmanager
+    def _ledger_phase(self, phase: str, step_id: str = "*"):
+        """Time one engine phase into the epoch ledger (exclusive of
+        phases nested inside it) — and, when a tracing backend is
+        active, as a nested OTLP span on the existing tracing path."""
+        rec = _flight.RECORDER
+        rec.phase_push()
+        t0 = time.monotonic()
+        try:
+            if self.trace_ops:
+                with _span("epoch_phase", phase=phase):
+                    yield
+            else:
+                yield
+        finally:
+            gross = time.monotonic() - t0
+            _flight.note_phase(
+                phase,
+                step_id,
+                max(gross - rec.phase_pop(), 0.0),
+                gross=gross,
+                t0=t0,
+            )
+
     def _close_epoch(self, workers: Optional[range] = None) -> None:
         from bytewax_tpu.tracing import span
 
         closing = self.epoch
+        # Ledger phases accrued from here to the seal (inside
+        # note_epoch_close) form the close-window breakdown, whose sum
+        # tracks the epoch_close_duration_seconds observation below.
+        _flight.RECORDER.mark_close()
         t0 = time.monotonic()
         with span("epoch_close", epoch=closing):
             self._close_epoch_inner(workers)
@@ -2224,21 +2403,24 @@ class _Driver:
         # piggyback): no gsync point may be reached with this process
         # still mid-pipeline.  Normally a no-op — the run loop (and
         # the cluster barrier's drained check) already quiesced them.
-        for rt in self.rts:
-            rt.pipeline_flush()
+        with self._ledger_phase("close_flush"):
+            for rt in self.rts:
+                rt.pipeline_flush()
         # Collective pre-close hooks next: every process reaches this
         # point exactly once per epoch (close_epoch broadcast), so
         # global-mesh exchange flushes align across the cluster.
-        for rt in self.rts:
-            rt.pre_close()
+        with self._ledger_phase("collective"):
+            for rt in self.rts:
+                rt.pre_close()
         if self.store is not None:
             snaps: List[Tuple[str, str, Optional[bytes]]] = []
-            for rt in self.rts:
-                for state_key, state in rt.epoch_snaps():
-                    ser = (
-                        pickle.dumps(state) if state is not None else None
-                    )
-                    snaps.append((rt.op.step_id, state_key, ser))
+            with self._ledger_phase("snapshot"):
+                for rt in self.rts:
+                    for state_key, state in rt.epoch_snaps():
+                        ser = (
+                            pickle.dumps(state) if state is not None else None
+                        )
+                        snaps.append((rt.op.step_id, state_key, ser))
             _flight.RECORDER.record(
                 "snapshot", epoch=self.epoch, states=len(snaps)
             )
@@ -2253,20 +2435,22 @@ class _Driver:
                     # their previous frontier.
                     commit_epoch -= 1
                 commit_epoch = commit_epoch if commit_epoch > 0 else None
-            self.store.write_epoch(
-                self.resume.ex_num,
-                self.worker_count,
-                self.epoch,
-                snaps,
-                commit_epoch,
-                workers=workers,
-                # In a cluster only the coordinator commits/GCs, after
-                # its own frontier write.
-                do_commit=self.proc_id == 0,
-            )
+            with self._ledger_phase("commit"):
+                self.store.write_epoch(
+                    self.resume.ex_num,
+                    self.worker_count,
+                    self.epoch,
+                    snaps,
+                    commit_epoch,
+                    workers=workers,
+                    # In a cluster only the coordinator commits/GCs,
+                    # after its own frontier write.
+                    do_commit=self.proc_id == 0,
+                )
         else:
-            for rt in self.rts:
-                rt.epoch_snaps()  # still clears awoken sets
+            with self._ledger_phase("snapshot"):
+                for rt in self.rts:
+                    rt.epoch_snaps()  # still clears awoken sets
         if self.comm is not None and self._flight_sync:
             # Telemetry piggyback on the epoch-close sync ladder:
             # one gsync round carrying each process's compact
@@ -2396,7 +2580,12 @@ class _Driver:
                 if msg[0] == "abort":
                     raise _Abort()
                 self._pump_stash.append((_src, msg))
-        _flight.note_gsync(tag, time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        _flight.note_gsync(tag, dt)
+        # Ledger: a leaf phase — when this round runs inside a timed
+        # parent (the pre_close collective flush), the parent records
+        # exclusive time and this stays its own line.
+        _flight.note_phase("gsync", "*", dt, t0=t0)
         return got
 
     def _apply_eof_step(self, k: int) -> None:
@@ -2564,6 +2753,9 @@ class _Driver:
             counters.get("state_spill_bytes", 0.0) / closes
         )
         interval_s = self.epoch_interval.total_seconds()
+        # Attribution-backed advice: the epoch ledger's measured
+        # phase split, not just the loose rate signals.
+        phase_fractions = _flight.ledger_fractions()
         advice, reasons = derive_rescale_hint(
             worker_count=self.worker_count,
             epoch_interval_s=interval_s,
@@ -2571,6 +2763,7 @@ class _Driver:
             stall_s_per_close=stall_s_per_close,
             restores_per_close=restores_per_close,
             spill_bytes_per_close=spill_bytes_per_close,
+            phase_fractions=phase_fractions,
         )
         return {
             "advice": advice,
@@ -2587,6 +2780,7 @@ class _Driver:
                 "epoch_closes": int(
                     counters.get("epoch_close_count", 0)
                 ),
+                "phase_fractions": phase_fractions,
             },
         }
 
@@ -2618,11 +2812,40 @@ class _Driver:
                 rt.op.step_id: sum(len(q) for q in rt.queues.values())
                 for rt in rts
             },
+            "ledger": {
+                "last": _flight.RECORDER.last_ledger,
+                "recent": _flight.RECORDER.ledgers(8),
+                # API-server thread: copy-with-retry, the main thread
+                # inserts new phase keys mid-iteration otherwise.
+                "phase_totals": {
+                    k: round(v, 6)
+                    for k, v in _flight.RECORDER._copied(
+                        lambda: dict(_flight.RECORDER.phase_totals), {}
+                    ).items()
+                },
+                "phase_fractions": _flight.ledger_fractions(),
+                "lag": _flight.RECORDER.ledger_lag(),
+            },
             "recorder": _flight.RECORDER.snapshot(),
             "cluster": {
                 str(pid): summary
                 for pid, summary in _flight.RECORDER.cluster.items()
             },
+        }
+
+    def _health(self) -> Dict[str, Any]:
+        """``GET /healthz`` readiness payload.  Liveness is the HTTP
+        server answering at all; readiness means run startup finished
+        on this process — the mesh handshake, the "fcfg" agreement
+        round, any rescale migration, and the runtime builds all
+        completed (the server only starts after them, so an
+        in-startup or mid-restart-backoff process simply refuses the
+        connection — also not ready)."""
+        return {
+            "ready": self._ready,
+            "proc_id": self.proc_id,
+            "generation": self.generation,
+            "epoch": self.epoch,
         }
 
     def run(self) -> None:
@@ -2642,6 +2865,7 @@ class _Driver:
         # view) fails loudly here instead of mis-sharding state.
         _flight.ensure_compile_listener()
         _flight.RECORDER.activate(_flight.enabled())
+        _flight.RECORDER.proc_id = self.proc_id
         try:
             if clustered:
                 replies = self.global_sync(
@@ -2728,7 +2952,9 @@ class _Driver:
             self.plan.flow,
             status_fn=self._status,
             port_offset=self.api_port_offset,
+            health_fn=self._health,
         )
+        self._ready = True
 
         # Epoch-aligned garbage collection (see _close_epoch); opt
         # out with BYTEWAX_TPU_GC=auto to keep Python's automatic

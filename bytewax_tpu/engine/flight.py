@@ -17,7 +17,23 @@ epoch protocol.  It keeps, per process:
   reporting (``bench.py`` and the ``/status`` plane);
 - the latest **cluster summaries** collected by the gsync piggyback at
   epoch close (see ``engine/driver.py``), so process 0's ``/status``
-  shows every process.
+  shows every process;
+- the **epoch ledger**: per-epoch, per-step time attribution
+  (always-on dict adds, like the counters).  Instrumented phase
+  boundaries in the driver, the dispatch pipeline, and the residency
+  manager call :func:`note_phase` with *exclusive* durations — a
+  parent phase (an epoch-close sub-phase, a host drain) subtracts the
+  gross time of phases nested inside it via the phase stack, so the
+  per-epoch sums are disjoint main-thread intervals (the ``device``
+  phase is the exception: it is measured on the pipeline worker and
+  overlaps the host phases by design).  ``note_epoch_close`` seals
+  the accumulating ledger into a per-epoch record carrying the
+  full-epoch phase breakdown, the close-window breakdown (whose sum
+  tracks ``epoch_close_duration_seconds``), source-lag samples, and
+  drain-point queue depths.  Sealed records feed ``/status``, the
+  epoch-close gsync piggyback, ``bench.py``'s phase fractions, the
+  rescale hint, and — with ``BYTEWAX_TPU_TRACE_DIR`` set — a
+  Chrome/Perfetto ``trace_event`` JSON dump per completed epoch.
 
 XLA compiles are observed via ``jax.monitoring`` duration events
 (:func:`ensure_compile_listener`), so every jit in the engine —
@@ -29,32 +45,38 @@ by the API server thread; they are observability data, not an epoch
 protocol, and a torn read is harmless.
 """
 
+import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
     "RECORDER",
     "FlightRecorder",
     "enabled",
     "ensure_compile_listener",
+    "ledger_fractions",
     "note_barrier",
     "note_comm",
     "note_demotion",
     "note_eviction",
     "note_fault",
     "note_fenced",
+    "note_flush_depth",
     "note_gsync",
+    "note_phase",
     "note_pipeline_depth",
     "note_pipeline_stall",
     "note_rescale",
     "note_resident",
     "note_residency_restore",
     "note_restart",
+    "note_source_lag",
     "note_spill",
     "note_transfer",
+    "write_postmortem",
 ]
 
 _RING_LEN = int(os.environ.get("BYTEWAX_FLIGHT_RING", 512))
@@ -62,6 +84,16 @@ _RING_LEN = int(os.environ.get("BYTEWAX_FLIGHT_RING", 512))
 _CLOSE_BUF = 1024
 #: Ring events returned in a /status snapshot.
 _TAIL = 64
+#: Sealed epoch-ledger records kept for /status.
+_LEDGER_BUF = 32
+#: Phase intervals collected per epoch for the Perfetto dump (beyond
+#: this the dump is truncated, never the ledger sums).
+_SPAN_CAP = 4096
+
+#: Ledger phases recorded off the main thread (the pipeline worker's
+#: device phase overlaps host phases by design): excluded from the
+#: close-window breakdown and from main-thread sum checks.
+_OFF_THREAD_PHASES = frozenset({"device"})
 
 
 def _truthy(name: str) -> bool:
@@ -95,9 +127,57 @@ class FlightRecorder:
         self.active = False
         #: proc_id -> latest piggybacked summary (clustered runs).
         self.cluster: Dict[int, Any] = {}
+        #: Process id stamped by the driver at run start (Perfetto
+        #: file names, postmortems).
+        self.proc_id = 0
+        # -- epoch ledger ------------------------------------------------
+        #: (phase, step_id) -> exclusive seconds in the CURRENT epoch.
+        self._ledger: Dict[Tuple[str, str], float] = {}
+        #: Ledger snapshot taken at close start, for the close-window
+        #: breakdown (phases accrued during the close itself).
+        self._ledger_pre_close: Optional[Dict[Tuple[str, str], float]] = None
+        #: Phase intervals (phase, step, t0_monotonic, gross_s, lane)
+        #: for the Perfetto dump; collected only when trace_dir is set.
+        self._spans: List[Tuple[str, str, float, float, int]] = []
+        #: Nested-phase accounting: each frame accumulates the gross
+        #: seconds of phases recorded while it was open, so the parent
+        #: records exclusive time.
+        self._phase_stack: List[List[float]] = []
+        #: Max pending tasks observed at each step's pipeline drain.
+        self._flush_depth: Dict[str, int] = {}
+        #: (step_id, kind) -> latest source-lag sample in seconds.
+        self._lag: Dict[Tuple[str, str], float] = {}
+        #: Lifetime per-phase totals (rescale hint, bench fractions).
+        self.phase_totals: Dict[str, float] = {}
+        #: Latest sealed per-epoch ledger record (also what the
+        #: epoch-close gsync piggyback ships).
+        self.last_ledger: Optional[Dict[str, Any]] = None
+        self._ledgers: deque = deque(maxlen=_LEDGER_BUF)
+        self._epoch_t0 = time.monotonic()
+        self.trace_dir = (
+            os.environ.get("BYTEWAX_TPU_TRACE_DIR", "").strip() or None
+        )
 
     def activate(self, on: bool) -> None:
         self.active = bool(on)
+        # Re-read at run start so a supervised restart (same process,
+        # fresh driver) honors env changes the same way the ring does.
+        self.trace_dir = (
+            os.environ.get("BYTEWAX_TPU_TRACE_DIR", "").strip() or None
+        )
+        # Fresh per-epoch accumulators: a supervised restart must not
+        # seal the crashed generation's partial epoch (already in the
+        # postmortem) into the new generation's first record, and the
+        # first record's wall clock starts at run start, not import.
+        # Lifetime state (phase_totals, sealed records, counters)
+        # deliberately survives.
+        self._ledger = {}
+        self._ledger_pre_close = None
+        self._spans = []
+        self._phase_stack = []
+        self._flush_depth = {}
+        self._lag = {}
+        self._epoch_t0 = time.monotonic()
 
     # -- hot-path writers --------------------------------------------------
 
@@ -112,6 +192,181 @@ class FlightRecorder:
             return
         self._ring.append((time.time(), kind, attrs))
 
+    # -- epoch ledger ------------------------------------------------------
+
+    def phase_push(self) -> None:
+        """Open a parent phase frame: nested phases recorded before
+        the matching :meth:`phase_pop` add their gross time here, so
+        the parent can record exclusive (self) time."""
+        self._phase_stack.append([0.0])
+
+    def phase_pop(self) -> float:
+        """Close the innermost parent frame; returns the gross
+        seconds of the phases nested inside it."""
+        return self._phase_stack.pop()[0]
+
+    def ledger_add(
+        self,
+        phase: str,
+        step_id: str,
+        seconds: float,
+        gross: Optional[float] = None,
+        t0: Optional[float] = None,
+        lane: int = 0,
+    ) -> None:
+        """Accumulate ``seconds`` (exclusive time) into the current
+        epoch's ledger.  ``gross`` (default: ``seconds``) is the whole
+        interval including nested phases — charged to the enclosing
+        phase frame so parents record self time only.  ``lane`` 0 is
+        the main thread; other lanes (the pipeline worker) overlap it
+        and never charge a parent frame."""
+        key = (phase, step_id)
+        self._ledger[key] = self._ledger.get(key, 0.0) + seconds
+        if gross is None:
+            gross = seconds
+        if lane == 0 and self._phase_stack:
+            self._phase_stack[-1][0] += gross
+        if (
+            self.trace_dir
+            and t0 is not None
+            and len(self._spans) < _SPAN_CAP
+        ):
+            self._spans.append((phase, step_id, t0, gross, lane))
+
+    def mark_close(self) -> None:
+        """Driver hook at the start of an epoch close: phases accrued
+        from here to the seal form the close-window breakdown."""
+        self._ledger_pre_close = dict(self._ledger)
+
+    @staticmethod
+    def _nested(
+        ledger: Dict[Tuple[str, str], float],
+    ) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        for (phase, step), s in ledger.items():
+            out.setdefault(phase, {})[step] = round(s, 6)
+        return out
+
+    def ledger_lag(self) -> Dict[str, float]:
+        # Read by the API server thread mid-run: copy-with-retry like
+        # every other cross-thread dict read here.
+        lag = self._copied(lambda: dict(self._lag), {})
+        return {
+            f"{kind}[{step}]": round(v, 6)
+            for (step, kind), v in lag.items()
+        }
+
+    def _seal_ledger(
+        self, epoch: int, close_s: float
+    ) -> Dict[str, Any]:
+        """Turn the accumulating ledger into this epoch's sealed
+        record, roll the phase totals, dump the Perfetto trace when
+        armed, and reset for the next epoch."""
+        now = time.monotonic()
+        pre = self._ledger_pre_close or {}
+        close_phases: Dict[str, float] = {}
+        for (phase, step), s in self._ledger.items():
+            if phase in _OFF_THREAD_PHASES:
+                continue
+            d = s - pre.get((phase, step), 0.0)
+            if d > 0:
+                close_phases[phase] = close_phases.get(phase, 0.0) + d
+        record: Dict[str, Any] = {
+            "epoch": epoch,
+            "wall_s": round(now - self._epoch_t0, 6),
+            "close_s": round(close_s, 6),
+            "phases": self._nested(self._ledger),
+            "close": {
+                k: round(v, 6) for k, v in close_phases.items()
+            },
+            "lag": self.ledger_lag(),
+            "queue_depth_at_drain": dict(self._flush_depth),
+        }
+        for (phase, _step), s in self._ledger.items():
+            self.phase_totals[phase] = (
+                self.phase_totals.get(phase, 0.0) + s
+            )
+        self.last_ledger = record
+        self._ledgers.append(record)
+        if self.trace_dir:
+            self._dump_trace(epoch, self._epoch_t0, now)
+        self._ledger = {}
+        self._ledger_pre_close = None
+        self._spans = []
+        self._flush_depth = {}
+        self._epoch_t0 = now
+        return record
+
+    def _dump_trace(
+        self, epoch: int, epoch_t0: float, now: float
+    ) -> None:
+        """Write this epoch's phase intervals as Chrome/Perfetto
+        ``trace_event`` JSON (one file per completed epoch; open in
+        ui.perfetto.dev).  Best-effort: a full disk must never fail
+        an epoch close."""
+        pid = os.getpid()
+        events: List[Dict[str, Any]] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {
+                    "name": f"bytewax_tpu proc {self.proc_id}"
+                },
+            },
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 1,
+                "args": {"name": "driver (host)"},
+            },
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 2,
+                "args": {"name": "device pipeline"},
+            },
+            {
+                "name": f"epoch {epoch}",
+                "cat": "epoch",
+                "ph": "X",
+                "ts": epoch_t0 * 1e6,
+                "dur": (now - epoch_t0) * 1e6,
+                "pid": pid,
+                "tid": 1,
+            },
+        ]
+        for phase, step, t0, gross, lane in self._spans:
+            events.append(
+                {
+                    "name": phase,
+                    "cat": phase,
+                    "ph": "X",
+                    "ts": t0 * 1e6,
+                    "dur": gross * 1e6,
+                    "pid": pid,
+                    "tid": 1 + lane,
+                    "args": {"step_id": step},
+                }
+            )
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        try:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            path = os.path.join(
+                self.trace_dir,
+                f"epoch-p{self.proc_id:02d}-{epoch:08d}.json",
+            )
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        except OSError:
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "could not write Perfetto trace for epoch %d", epoch
+            )
+
     def note_epoch_close(self, epoch: int, seconds: float) -> None:
         self.count("epoch_close_count")
         self.count("epoch_close_seconds", seconds)
@@ -120,6 +375,7 @@ class FlightRecorder:
         # percentiles without turning on ring recording — which would
         # perturb the very hot loops being measured.
         self._close_s.append(seconds)
+        self._seal_ledger(epoch, seconds)
         self.record(
             "epoch_close", epoch=epoch, seconds=round(seconds, 6)
         )
@@ -168,6 +424,10 @@ class FlightRecorder:
             for t, kind, attrs in events[-n:]
         ]
 
+    def ledgers(self, n: int = _LEDGER_BUF) -> list:
+        """The most recent sealed per-epoch ledger records."""
+        return self._copied(lambda: list(self._ledgers), [])[-n:]
+
     def snapshot(self) -> Dict[str, Any]:
         """Full local view for ``GET /status``."""
         out: Dict[str, Any] = {
@@ -183,12 +443,15 @@ class FlightRecorder:
                 "p99": round(p99 * 1e3, 3),
                 "count": n,
             }
+        if self.last_ledger is not None:
+            out["ledger"] = self.last_ledger
         return out
 
     def summary(self, epoch: int) -> Dict[str, Any]:
         """Compact per-process summary for the epoch-close gsync
-        piggyback — counters and close percentiles only (control-plane
-        sized: no ring events)."""
+        piggyback — counters, close percentiles, and the latest
+        sealed epoch ledger (control-plane sized: no ring events; the
+        ledger is a bounded handful of phase/step floats)."""
         out: Dict[str, Any] = {
             "epoch": epoch,
             "counters": self._copied(lambda: dict(self.counters), {}),
@@ -201,6 +464,8 @@ class FlightRecorder:
                 "p99": round(p99 * 1e3, 3),
                 "count": n,
             }
+        if self.last_ledger is not None:
+            out["ledger"] = self.last_ledger
         return out
 
 
@@ -357,6 +622,9 @@ def note_residency_restore(step_id: str, n: int, seconds: float) -> None:
     RECORDER.record(
         "restore", step=step_id, keys=n, seconds=round(seconds, 6)
     )
+    note_phase(
+        "restore", step_id, seconds, t0=time.monotonic() - seconds
+    )
 
 
 def note_spill(step_id: str, nbytes: int) -> None:
@@ -406,6 +674,9 @@ def note_pipeline_stall(step_id: str, seconds: float) -> None:
     child.inc(seconds)
     RECORDER.count("pipeline_flush_stall_seconds", seconds)
     RECORDER.count("pipeline_flush_stall_count")
+    note_phase(
+        "flush", step_id, seconds, t0=time.monotonic() - seconds
+    )
 
 
 def note_barrier(seconds: float) -> None:
@@ -417,6 +688,150 @@ def note_barrier(seconds: float) -> None:
     RECORDER.count("barrier_count")
     RECORDER.count("barrier_wait_seconds", seconds)
     RECORDER.record("barrier_exit", seconds=round(seconds, 6))
+    note_phase(
+        "barrier", "*", seconds, t0=time.monotonic() - seconds
+    )
+
+
+# -- epoch-ledger writers ------------------------------------------------
+
+_phase_children: Dict[Tuple[str, str], Any] = {}
+_lag_children: Dict[Tuple[str, str], Any] = {}
+
+
+def note_phase(
+    phase: str,
+    step_id: str,
+    seconds: float,
+    gross: Optional[float] = None,
+    t0: Optional[float] = None,
+    lane: int = 0,
+) -> None:
+    """Attribute ``seconds`` of *exclusive* time to one epoch-ledger
+    phase of one step (``step_id`` ``*`` = process-wide).  ``gross``
+    is the whole interval including nested phases (charged to the
+    enclosing phase frame); ``t0`` (monotonic) keys the Perfetto
+    interval; ``lane`` 1 marks off-main-thread time (the pipeline
+    worker) that must not charge the enclosing main-thread frame."""
+    key = (phase, step_id)
+    child = _phase_children.get(key)
+    if child is None:
+        from bytewax_tpu._metrics import epoch_phase_seconds
+
+        with _lock:
+            child = _phase_children.setdefault(
+                key, epoch_phase_seconds.labels(phase, step_id)
+            )
+    child.inc(seconds)
+    RECORDER.ledger_add(
+        phase, step_id, seconds, gross=gross, t0=t0, lane=lane
+    )
+
+
+def note_source_lag(step_id: str, kind: str, seconds: float) -> None:
+    """One source-lag sample: ``kind`` ``event_time`` is wall-clock
+    now minus the freshest event timestamp a source batch carried at
+    ingest; ``processing`` is a delivery's ingest→emit latency
+    through a device-tier step's dispatch pipeline."""
+    key = (step_id, kind)
+    child = _lag_children.get(key)
+    if child is None:
+        from bytewax_tpu._metrics import source_lag_seconds
+
+        with _lock:
+            child = _lag_children.setdefault(
+                key, source_lag_seconds.labels(step_id, kind)
+            )
+    child.set(seconds)
+    RECORDER._lag[key] = seconds
+
+
+def note_flush_depth(step_id: str, depth: int) -> None:
+    """Pending-task queue depth observed at a pipeline drain point
+    (per-epoch max, sealed into the ledger record)."""
+    cur = RECORDER._flush_depth
+    if depth > cur.get(step_id, 0):
+        cur[step_id] = depth
+
+
+#: Ledger phases folded into each reported fraction bucket.
+_FRACTION_BUCKETS = {
+    "host": ("ingest", "host", "readback"),
+    "device": ("device",),
+    "flush": ("flush", "close_flush"),
+    "barrier": ("barrier",),
+    "gsync": ("gsync", "collective"),
+    "snapshot": ("snapshot", "commit"),
+    "residency": ("restore", "evict"),
+}
+
+
+def ledger_fractions(
+    totals: Optional[Dict[str, float]] = None,
+) -> Optional[Dict[str, float]]:
+    """Fold the lifetime per-phase totals into the coarse
+    host/device/flush/barrier/gsync/snapshot/residency buckets and
+    normalize to fractions of the attributed time; None before any
+    phase was recorded.  Feeds ``bench.py``'s
+    ``epoch_phase_fractions`` and the attribution-backed rescale
+    hint."""
+    if totals is None:
+        totals = RECORDER.phase_totals
+    buckets = {
+        name: sum(totals.get(p, 0.0) for p in phases)
+        for name, phases in _FRACTION_BUCKETS.items()
+    }
+    denom = sum(buckets.values())
+    if denom <= 0:
+        return None
+    return {k: round(v / denom, 4) for k, v in buckets.items()}
+
+
+def write_postmortem(
+    proc_id: int, generation: int, cause: str, detail: str = ""
+) -> Optional[str]:
+    """Crash post-mortem: dump the flight ring tail, counters, and
+    the in-flight epoch's ledger to
+    ``BYTEWAX_TPU_POSTMORTEM_DIR/postmortem-<proc>-<gen>.json``
+    (best-effort; returns the path, or None when the dir is unset or
+    the write failed).  Called by the restart supervisor on a
+    restartable fault, before the backoff sleep."""
+    pm_dir = os.environ.get(
+        "BYTEWAX_TPU_POSTMORTEM_DIR", ""
+    ).strip()
+    if not pm_dir:
+        return None
+    rec = RECORDER
+    doc = {
+        "proc_id": proc_id,
+        "generation": generation,
+        "cause": cause,
+        "detail": detail[:2000],
+        "written_at": time.time(),
+        "counters": rec._copied(lambda: dict(rec.counters), {}),
+        "tail": rec.tail(),
+        "ledger": {
+            "in_flight": rec._nested(dict(rec._ledger)),
+            "last_sealed": rec.last_ledger,
+        },
+        "lag": rec.ledger_lag(),
+        "queue_depth_at_drain": dict(rec._flush_depth),
+    }
+    try:
+        os.makedirs(pm_dir, exist_ok=True)
+        path = os.path.join(
+            pm_dir, f"postmortem-{proc_id}-{generation}.json"
+        )
+        with open(path, "w") as f:
+            json.dump(doc, f, default=str)
+    except OSError as ex:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "could not write postmortem to %s: %s", pm_dir, ex
+        )
+        return None
+    return path
 
 
 _compile_listener_on = False

@@ -91,7 +91,7 @@ class DevicePipeline:
     def __init__(self, step_id: str, depth: Optional[int] = None):
         self.depth = pipeline_depth() if depth is None else max(1, depth)
         self.step_id = step_id
-        #: (future, finalize) in submission order.
+        #: (future, finalize, submit_monotonic) in submission order.
         self._pending: deque = deque()
         self._pool: Optional[ThreadPoolExecutor] = None
 
@@ -138,11 +138,32 @@ class DevicePipeline:
         Makes room first, so the depth bound holds even for
         multi-entry deliveries that push several phases."""
         if self.depth <= 1:
-            finalize(task())
+            t0 = time.monotonic()
+            result = task()
+            dur = time.monotonic() - t0
+            # Inline (lock-step) mode folds ON the main thread: lane 0,
+            # so the seconds charge the enclosing host frame instead of
+            # double-counting against it as overlapped worker time.
+            _flight.note_phase(
+                "device", self.step_id, dur, t0=t0, lane=0
+            )
+            finalize(result)
+            _flight.note_source_lag(
+                self.step_id, "processing", time.monotonic() - t0
+            )
             return
         self.make_room()
-        fut = self._ensure_pool().submit(task)
-        self._pending.append((fut, finalize))
+        fut = self._ensure_pool().submit(self._timed, task)
+        self._pending.append((fut, finalize, time.monotonic()))
+
+    @staticmethod
+    def _timed(task: Callable[[], Any]) -> Tuple[float, float, Any]:
+        """Worker-side wrapper: stamp the device phase's wall
+        interval so the ledger's ``device`` lane is recorded (on the
+        main thread, at finalize) with the worker's real timing."""
+        t0 = time.monotonic()
+        result = task()
+        return t0, time.monotonic() - t0, result
 
     #: ``make_room()`` + append, under one name for direct callers.
     submit = push
@@ -150,15 +171,30 @@ class DevicePipeline:
     # -- draining ----------------------------------------------------------
 
     def _finalize_oldest(self) -> None:
-        fut, finalize = self._pending.popleft()
+        fut, finalize, t_submit = self._pending.popleft()
         t0 = time.monotonic()
         try:
-            result = fut.result()
+            dev_t0, dev_dur, result = fut.result()
         finally:
             stalled = time.monotonic() - t0
             if stalled > 0.0005:
                 _flight.note_pipeline_stall(self.step_id, stalled)
+        # Ledger: the device phase's wall interval (worker lane — it
+        # overlaps host time and never charges the enclosing phase),
+        # then the host-side finalize (emission routing, touched-key
+        # absorption: the readback surfacing point).
+        _flight.note_phase(
+            "device", self.step_id, dev_dur, t0=dev_t0, lane=1
+        )
+        tf = time.monotonic()
         finalize(result)
+        now = time.monotonic()
+        _flight.note_phase("readback", self.step_id, now - tf, t0=tf)
+        # Ingest→emit latency of this delivery through the pipeline
+        # (submit to finalized emissions).
+        _flight.note_source_lag(
+            self.step_id, "processing", now - t_submit
+        )
 
     def finalize_ready(self) -> None:
         """Finalize completed tasks without blocking on running ones —
@@ -177,19 +213,20 @@ class DevicePipeline:
         """
         if not self._pending:
             return
+        _flight.note_flush_depth(self.step_id, len(self._pending))
         _flight.RECORDER.record(
             "pipeline_flush", step=self.step_id, pending=len(self._pending)
         )
         while self._pending:
             self._finalize_oldest()
 
-    def drop_pending(self) -> List[Tuple[Future, Callable]]:
+    def drop_pending(self) -> List[Tuple[Future, Callable, float]]:
         """Abandon pending tasks (after a fault already propagated):
         waits for the worker to go quiet but runs no finalizers;
         returns what was dropped so callers can count it."""
         dropped = list(self._pending)
         self._pending.clear()
-        for fut, _fin in dropped:
+        for fut, _fin, _t in dropped:
             # Unstarted tasks skip entirely; a running one is waited
             # for (CancelledError/task errors are already surfaced or
             # moot on this teardown path).

@@ -493,6 +493,7 @@ class ResidentKeyState:
         in-flight delivery's own) are never victims."""
         if excess <= 0:
             return
+        t0 = time.monotonic()
         inner = self._inner
         resident = self._resident_map()
         victims: List[str] = []
@@ -540,6 +541,11 @@ class ResidentKeyState:
         self.evictions += len(victims)
         _flight.note_eviction(self.step_id, len(victims), "host")
         self._spill_overflow(epoch)
+        # Ledger: eviction is a drain-point host readback — the
+        # extract + host-cache insert + any disk spill it triggered.
+        _flight.note_phase(
+            "evict", self.step_id, time.monotonic() - t0, t0=t0
+        )
 
     def _spill_overflow(self, epoch: int) -> None:
         if self._spill is None or self.host_budget is None:

@@ -9,10 +9,19 @@ Reference parity (``/root/reference/src/webserver/mod.rs``): when
   metrics share one Python registry here, so no merge step is
   needed), and
 - ``GET /status`` — a live JSON snapshot of the engine (current
-  epoch, per-step queue depths, the flight-recorder tail, and — in
-  clustered runs — the per-process summaries collected by the
-  epoch-close gsync piggyback, so any process's ``/status`` shows the
-  whole cluster).
+  epoch, per-step queue depths, the epoch ledger, the flight-recorder
+  tail, and — in clustered runs — the per-process summaries collected
+  by the epoch-close gsync piggyback, so any process's ``/status``
+  shows the whole cluster),
+- ``GET /healthz`` — liveness (the server answering at all) +
+  readiness (HTTP 200 once run startup — mesh handshake, the "fcfg"
+  agreement round, any rescale migration, runtime builds — finished;
+  503 before that; connection refused while starting up or sleeping
+  out a restart backoff).  Wire it to k8s liveness/readiness probes
+  (docs/deployment.md), and
+- ``GET /stacks`` — a ``faulthandler``-style plain-text dump of every
+  thread's current Python stack (main loop, pipeline workers, comm),
+  for diagnosing a hung barrier without attaching py-spy.
 
 Bind host comes from ``BYTEWAX_DATAFLOW_API_HOST`` (default
 ``127.0.0.1`` — the status plane is operational introspection, not a
@@ -26,11 +35,13 @@ host deployments keep the configured port on every pod.
 import json
 import logging
 import os
+import sys
 import threading
+import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
-__all__ = ["maybe_start_server"]
+__all__ = ["maybe_start_server", "thread_stacks"]
 
 logger = logging.getLogger("bytewax_tpu")
 
@@ -38,11 +49,27 @@ _DEFAULT_PORT = 3030
 _DEFAULT_HOST = "127.0.0.1"
 
 
+def thread_stacks() -> str:
+    """A ``faulthandler``-style dump of every thread's current Python
+    stack — the main run loop, pipeline workers, the comm layer —
+    so a hung barrier is diagnosable over HTTP without py-spy."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        out.append(
+            f"Thread {names.get(tid, '<unknown>')} (ident {tid}):\n"
+            + "".join(traceback.format_stack(frame))
+        )
+    return "\n".join(out)
+
+
 class _Handler(BaseHTTPRequestHandler):
     flow_json: str = "{}"
     status_fn: Optional[Callable[[], dict]] = None
+    health_fn: Optional[Callable[[], dict]] = None
 
     def do_GET(self) -> None:  # noqa: N802
+        code = 200
         if self.path == "/dataflow":
             body = self.flow_json.encode()
             ctype = "application/json"
@@ -59,11 +86,28 @@ class _Handler(BaseHTTPRequestHandler):
                 status = {"error": str(ex)}
             body = json.dumps(status).encode()
             ctype = "application/json"
+        elif self.path == "/healthz":
+            fn = type(self).health_fn
+            try:
+                health = fn() if fn is not None else {"ready": True}
+            except Exception as ex:  # noqa: BLE001 - never 500 the plane
+                health = {"ready": False, "error": str(ex)}
+            health = {"live": True, **health}
+            # k8s readiness probes read the status code, not the body.
+            code = 200 if health.get("ready") else 503
+            body = json.dumps(health).encode()
+            ctype = "application/json"
+        elif self.path == "/stacks":
+            try:
+                body = thread_stacks().encode()
+            except Exception as ex:  # noqa: BLE001 - never 500 the plane
+                body = f"could not collect stacks: {ex}".encode()
+            ctype = "text/plain; charset=utf-8"
         else:
             self.send_response(404)
             self.end_headers()
             return
-        self.send_response(200)
+        self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -88,13 +132,16 @@ def maybe_start_server(
     flow,
     status_fn: Optional[Callable[[], dict]] = None,
     port_offset: int = 0,
+    health_fn: Optional[Callable[[], dict]] = None,
 ) -> Optional[_ApiServer]:
     """Start the API server if ``BYTEWAX_DATAFLOW_API_ENABLED`` is
     set (to anything but ``0``); returns a handle to shut it down,
     else ``None``.
 
     ``status_fn`` is a zero-arg callable (supplied by the engine
-    driver) returning the live ``/status`` document; ``port_offset``
+    driver) returning the live ``/status`` document; ``health_fn``
+    returns the ``/healthz`` readiness payload (at minimum a
+    ``ready`` bool — absent means always-ready); ``port_offset``
     is this process's rank among co-located cluster processes."""
     from bytewax_tpu.engine.flight import _truthy
 
@@ -127,7 +174,11 @@ def maybe_start_server(
     handler = type(
         "_BoundHandler",
         (_Handler,),
-        {"flow_json": flow_json, "status_fn": staticmethod(status_fn)},
+        {
+            "flow_json": flow_json,
+            "status_fn": staticmethod(status_fn),
+            "health_fn": staticmethod(health_fn),
+        },
     )
     try:
         server = ThreadingHTTPServer((host, port), handler)

@@ -214,6 +214,18 @@ def test_status_endpoint(entry_point, monkeypatch, tmp_path):
     assert hint["advice"] in ("grow", "shrink", "hold")
     assert isinstance(hint["reasons"], list)
     assert hint["signals"]["worker_count"] == status["worker_count"]
+    # The epoch-ledger section (docs/observability.md) is always
+    # present; its records fill in as epochs seal.
+    ledger = status["ledger"]
+    assert set(ledger) >= {
+        "last",
+        "recent",
+        "phase_totals",
+        "phase_fractions",
+        "lag",
+    }
+    assert isinstance(ledger["recent"], list)
+    assert isinstance(ledger["phase_totals"], dict)
 
 
 def test_status_cluster_gsync_piggyback(tmp_path):
