@@ -148,6 +148,94 @@ def _run_itemized(n_rows: int, batch_rows: int) -> float:
     return n_rows / dt
 
 
+def _run_ingest_columnar(n_rows: int) -> float:
+    """End-to-end columnar ingest (docs/performance.md "Columnar
+    ingest"): a 1BRC-shaped line file read in raw chunks by
+    ``FileSource(columnar=True)``, split and parsed in vectorized
+    passes (ops/text), folded on the device tier — no per-row Python
+    anywhere on the path.  The result is asserted against a
+    host-built numpy oracle, so the rate only counts correct runs."""
+    import tempfile
+
+    import numpy as np
+
+    import bytewax_tpu.operators as op
+    from bytewax_tpu import xla
+    from bytewax_tpu.connectors.files import FileSource
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine.arrays import ArrayBatch
+    from bytewax_tpu.ops.text import split_fields
+    from bytewax_tpu.testing import TestingSink, run_main
+
+    n_stations = 413
+    rng = np.random.RandomState(7)
+    station_ids = rng.randint(0, n_stations, size=n_rows)
+    deci = np.clip(
+        np.round(rng.randn(n_rows) * 100 + 120), -999, 999
+    ).astype(np.int64)
+    stations = np.array([f"station_{i:04d}" for i in range(n_stations)])
+    temps = deci / 10.0
+    lines = np.char.add(
+        np.char.add(stations[station_ids], ";"),
+        np.char.mod("%.1f", temps),
+    )
+
+    # Host oracle: per-station min/mean/max, rounded like the flow.
+    mins = np.full(n_stations, np.inf)
+    maxs = np.full(n_stations, -np.inf)
+    np.minimum.at(mins, station_ids, temps)
+    np.maximum.at(maxs, station_ids, temps)
+    sums = np.bincount(station_ids, weights=temps, minlength=n_stations)
+    counts = np.bincount(station_ids, minlength=n_stations)
+    oracle = {
+        str(stations[i]): (
+            round(float(mins[i]), 1),
+            round(float(sums[i] / counts[i]), 1),
+            round(float(maxs[i]), 1),
+        )
+        for i in range(n_stations)
+        if counts[i]
+    }
+
+    def parse(batch):
+        cols = split_fields(batch.cols["line"], 2, ";")
+        return ArrayBatch(
+            {"key": cols[0], "value": cols[1].astype(np.float64)}
+        )
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "measurements.txt")
+        with open(path, "w") as f:
+            f.write("\n".join(lines.tolist()))
+            f.write("\n")
+        out = []
+        flow = Dataflow("ingest_columnar")
+        s = op.input(
+            "inp", flow, FileSource(path, columnar=True, chunk_bytes=1 << 20)
+        )
+        parsed = op.flat_map_batch("parse", s, parse)
+        stats = xla.stats_final("stats", parsed)
+        rounded = op.map_value(
+            "round",
+            stats,
+            lambda s4: (round(s4[0], 1), round(s4[1], 1), round(s4[2], 1)),
+        )
+        op.output("out", rounded, TestingSink(out))
+        t0 = time.perf_counter()
+        run_main(flow)
+        dt = time.perf_counter() - t0
+    got = dict(out)
+    assert len(got) == len(oracle), (
+        f"expected {len(oracle)} stations, got {len(got)}"
+    )
+    for k, want in oracle.items():
+        have = got[k]
+        assert all(
+            abs(h - w) <= 0.1 + 1e-9 for h, w in zip(have, want)
+        ), f"station {k}: columnar ingest {have} != oracle {want}"
+    return n_rows / dt
+
+
 def _run_host(n_rows: int, batch_rows: int) -> float:
     from bytewax_tpu.models.brc import (
         ArrayBatchSource,
@@ -1052,6 +1140,11 @@ def main() -> None:
     item_rate = max(
         _run_itemized(item_rows, batch_rows) for _ in range(2)
     )
+    ingest_rows = int(os.environ.get("BENCH_INGEST_ROWS", 2_000_000))
+    _run_ingest_columnar(1 << 18)  # warm the parse + fold shapes
+    ingest_rate = max(
+        _run_ingest_columnar(ingest_rows) for _ in range(2)
+    )
     host_rate = _run_host(host_rows, batch_rows)
 
     win_ref = _run_windowing_host(100_000, 10)  # the reference shape
@@ -1132,6 +1225,7 @@ def main() -> None:
         ),
         "brc_itemized_events_per_sec": round(item_rate),
         "brc_itemized_vs_columnar": round(item_rate / xla_rate, 2),
+        "ingest_columnar_events_per_sec": round(ingest_rate),
         "host_events_per_sec": round(host_rate),
     }
     if sharded_ms is not None:

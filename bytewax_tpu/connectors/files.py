@@ -3,15 +3,31 @@
 Reference parity: ``/root/reference/pysrc/bytewax/connectors/files.py``;
 implementation is our own.  Line files resume by byte offset; sinks
 truncate on resume for exactly-once output.
+
+Batch-native mode (docs/performance.md "Columnar ingest"): the line
+and CSV sources take ``columnar=True`` to read fixed-size byte chunks
+and split/parse them in vectorized passes (:mod:`bytewax_tpu.ops.text`)
+instead of decoding per row in Python, emitting
+:class:`~bytewax_tpu.inputs.ColumnarBatch` record batches.  Resume
+snapshots stay plain int byte offsets in both modes (always a line
+boundary), so a store written by one mode resumes under the other.
 """
 
 import csv
+import io
 import os
 from pathlib import Path
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Union
 from zlib import adler32
 
-from bytewax_tpu.inputs import FixedPartitionedSource, StatefulSourcePartition, batch
+import numpy as np
+
+from bytewax_tpu.inputs import (
+    ColumnarBatch,
+    FixedPartitionedSource,
+    StatefulSourcePartition,
+    batch,
+)
 from bytewax_tpu.outputs import FixedPartitionedSink, StatefulSinkPartition
 
 __all__ = [
@@ -45,6 +61,54 @@ class _FileSourcePartition(StatefulSourcePartition[str, int]):
         self._f.close()
 
 
+class _ChunkedLinePartition(
+    StatefulSourcePartition[ColumnarBatch, int]
+):
+    """Batch-native line reader: raw chunks in, vectorized-split
+    ``ColumnarBatch({"line": ...})`` out (see ops/text.py).  The
+    snapshot is the byte offset of the first line NOT yet emitted
+    (the trailing partial line carried across a chunk boundary is
+    re-read on resume), interchangeable with the itemized reader's
+    ``tell()`` snapshots."""
+
+    def __init__(
+        self,
+        path: Path,
+        chunk_bytes: int,
+        resume_state: Optional[int],
+        encoding: Optional[str] = "utf-8",
+    ):
+        from bytewax_tpu.ops.text import LineBatcher
+
+        self._f = open(path, "rb")
+        self._read = resume_state if resume_state is not None else 0
+        if self._read:
+            self._f.seek(self._read)
+        self._chunk_bytes = chunk_bytes
+        self._lines = LineBatcher(encoding)
+        self._done = False
+
+    def next_batch(self) -> Union[ColumnarBatch, List[str]]:
+        if self._done:
+            raise StopIteration()
+        raw = self._f.read(self._chunk_bytes)
+        if not raw:
+            self._done = True
+            final = self._lines.flush()
+            if final is None:
+                raise StopIteration()
+            return final
+        self._read += len(raw)
+        out = self._lines.feed(raw)
+        return out if out is not None else []
+
+    def snapshot(self) -> int:
+        return self._read - self._lines.pending
+
+    def close(self) -> None:
+        self._f.close()
+
+
 class FileSource(FixedPartitionedSource[str, int]):
     """Read a single file line-by-line; resumes exactly at the
     snapshotted byte offset.
@@ -71,15 +135,29 @@ class FileSource(FixedPartitionedSource[str, int]):
         path: Path,
         batch_size: int = 1000,
         get_fs_id: Callable[[Path], str] = _get_path_dev,
+        columnar: bool = False,
+        chunk_bytes: int = 1 << 20,
+        encoding: Optional[str] = "utf-8",
     ):
         """:arg path: Path to file.
-        :arg batch_size: Lines per batch (default 1000).
+        :arg batch_size: Lines per batch (default 1000; itemized mode).
         :arg get_fs_id: Returns a consistent unique id for the
             filesystem holding the file, used to deduplicate reads
-            across workers; return a constant for shared mounts."""
+            across workers; return a constant for shared mounts.
+        :arg columnar: Batch-native mode — read ``chunk_bytes`` raw
+            chunks and emit vectorized-split
+            :class:`~bytewax_tpu.inputs.ColumnarBatch` line batches
+            (no per-row Python decode; docs/performance.md).  Resume
+            offsets stay interchangeable with itemized mode.
+        :arg chunk_bytes: Bytes per read in columnar mode.
+        :arg encoding: Text encoding in columnar mode; ``None`` emits
+            raw byte lines."""
         path = Path(path)
         self._path = path
         self._batch_size = batch_size
+        self._columnar = columnar
+        self._chunk_bytes = chunk_bytes
+        self._encoding = encoding
         self._fs_id = get_fs_id(path.parent) if path.parent.exists() else "0"
         if "::" in self._fs_id:
             msg = (
@@ -96,11 +174,15 @@ class FileSource(FixedPartitionedSource[str, int]):
 
     def build_part(
         self, step_id: str, for_part: str, resume_state: Optional[int]
-    ) -> _FileSourcePartition:
+    ) -> StatefulSourcePartition:
         _fs_id, path = for_part.split("::", 1)
         if path != str(self._path):
             msg = "can't resume reading from different file"
             raise ValueError(msg)
+        if self._columnar:
+            return _ChunkedLinePartition(
+                self._path, self._chunk_bytes, resume_state, self._encoding
+            )
         return _FileSourcePartition(self._path, self._batch_size, resume_state)
 
 
@@ -131,7 +213,13 @@ class DirSource(FixedPartitionedSource[str, int]):
         glob_pat: str = "*",
         batch_size: int = 1000,
         get_fs_id: Callable[[Path], str] = _get_path_dev,
+        columnar: bool = False,
+        chunk_bytes: int = 1 << 20,
+        encoding: Optional[str] = "utf-8",
     ):
+        """``columnar=True`` reads each file in raw chunks and emits
+        vectorized-split :class:`~bytewax_tpu.inputs.ColumnarBatch`
+        line batches (see :class:`FileSource`)."""
         dir_path = Path(dir_path)
         if not dir_path.exists():
             msg = f"no such input directory: {dir_path}"
@@ -142,6 +230,9 @@ class DirSource(FixedPartitionedSource[str, int]):
         self._dir_path = dir_path
         self._glob_pat = glob_pat
         self._batch_size = batch_size
+        self._columnar = columnar
+        self._chunk_bytes = chunk_bytes
+        self._encoding = encoding
         self._fs_id = get_fs_id(dir_path)
         if "::" in self._fs_id:
             msg = (
@@ -160,8 +251,15 @@ class DirSource(FixedPartitionedSource[str, int]):
 
     def build_part(
         self, step_id: str, for_part: str, resume_state: Optional[int]
-    ) -> _FileSourcePartition:
+    ) -> StatefulSourcePartition:
         _fs_id, rel = for_part.split("::", 1)
+        if self._columnar:
+            return _ChunkedLinePartition(
+                self._dir_path / rel,
+                self._chunk_bytes,
+                resume_state,
+                self._encoding,
+            )
         return _FileSourcePartition(
             self._dir_path / rel, self._batch_size, resume_state
         )
@@ -204,6 +302,174 @@ class _CSVPartition(StatefulSourcePartition[Dict[str, str], int]):
         self._f.close()
 
 
+class _ColumnarCSVPartition(StatefulSourcePartition[Any, int]):
+    """Batch-native CSV reader: chunked line split + one vectorized
+    field split per column (ops/text.py), numeric columns cast in one
+    C pass.  Rows the fast path can't take (quoting, ragged rows)
+    fall back to ``csv.DictReader`` for that batch only — emitted
+    itemized, which the batch-native protocol allows."""
+
+    def __init__(
+        self,
+        path: Path,
+        chunk_bytes: int,
+        resume_state: Optional[int],
+        fmtparams: Dict[str, Any],
+    ):
+        self._delim = fmtparams.get("delimiter", ",")
+        self._quote = fmtparams.get("quotechar") or '"'
+        # Quote PARITY (count of quotechars mod 2) is how the chunked
+        # reader detects a quoted field left open at a batch/header
+        # boundary (embedded newlines).  Parity only delimits fields
+        # when quotes are self-escaping: doublequote ("" counts 2)
+        # keeps it, escapechar dialects break it, and QUOTE_NONE has
+        # no quoted fields at all (rows == lines, chunking trivially
+        # safe).  A dialect where multi-line fields are possible but
+        # parity is unsound can't be chunked without corrupting rows
+        # that span a boundary — refuse it up front.
+        multiline_fields = (
+            fmtparams.get("quoting", csv.QUOTE_MINIMAL) != csv.QUOTE_NONE
+        )
+        parity_sound = (
+            fmtparams.get("doublequote", True)
+            and fmtparams.get("escapechar") is None
+        )
+        if multiline_fields and not parity_sound:
+            msg = (
+                "CSVSource(columnar=True) can't chunk a dialect whose "
+                "quote parity doesn't delimit fields (escapechar / "
+                "doublequote=False): a quoted field spanning a chunk "
+                "boundary would be cut mid-row.  Use itemized mode "
+                "for this dialect."
+            )
+            raise ValueError(msg)
+        self._stitch = multiline_fields
+        reader_params = {
+            k: v
+            for k, v in fmtparams.items()
+            if k not in ("restkey", "restval")
+        }
+        # Header is always re-read so field names survive resume
+        # (same contract as the itemized reader) — and a quoted header
+        # field may itself contain newlines, so keep reading while its
+        # quote is open.
+        quote_b = self._quote.encode("utf-8")
+        with open(path, "rb") as f:
+            header = f.readline()
+            while self._stitch and header.count(quote_b) % 2:
+                more = f.readline()
+                if not more:
+                    break
+                header += more
+            body_start = f.tell()
+        self._fields = next(
+            csv.reader(io.StringIO(header.decode("utf-8")), **reader_params)
+        )
+        self._fmtparams = fmtparams
+        #: Only plain-delimiter dialects take the vectorized path; any
+        #: other fmtparam routes every batch through csv.DictReader.
+        self._simple = set(fmtparams) <= {"delimiter"}
+        #: Numeric-cast decision per column, made ONCE on the first
+        #: fast-path batch and held for the run: where later chunk
+        #: boundaries fall must not flip a column between float64 and
+        #: str (see _apply_sticky_casts).
+        self._numeric: Optional[frozenset] = None
+        self._inner = _ChunkedLinePartition(
+            path,
+            chunk_bytes,
+            resume_state if resume_state is not None else body_start,
+        )
+
+    @staticmethod
+    def _count_quotes(lines: np.ndarray, quote: str) -> int:
+        if not len(lines):
+            return 0
+        if lines.dtype.kind in "US":
+            return int(np.char.count(lines, quote).sum())
+        # Ragged chunks degrade to object-dtype line arrays (see
+        # ops/text._split_units); they're rare, so a Python count is
+        # fine here.
+        return sum(ln.count(quote) for ln in lines.tolist())
+
+    def _apply_sticky_casts(
+        self, cols: List[np.ndarray]
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Numeric casts with a per-run sticky decision: the first
+        fast-path batch decides which columns are float64, every later
+        batch honors it.  Returns ``None`` when a later batch has a
+        non-castable cell in a sticky-numeric column — that batch
+        falls back itemized like any other the fast path can't take."""
+        from bytewax_tpu.ops.text import maybe_numeric
+
+        if self._numeric is None:
+            casted = {
+                name: maybe_numeric(col)
+                for name, col in zip(self._fields, cols)
+            }
+            self._numeric = frozenset(
+                name
+                for name, col in casted.items()
+                if col.dtype == np.float64
+            )
+            return casted
+        out: Dict[str, np.ndarray] = {}
+        for name, col in zip(self._fields, cols):
+            if name in self._numeric:
+                try:
+                    col = col.astype(np.float64)
+                except ValueError:
+                    return None
+            out[name] = col
+        return out
+
+    def next_batch(self) -> Any:
+        from bytewax_tpu.ops.text import split_fields
+
+        out = self._inner.next_batch()
+        if not isinstance(out, ColumnarBatch):
+            return out
+        lines = out.cols["line"]
+        n_quotes = self._count_quotes(lines, self._quote)
+        cols = None
+        if self._simple and not n_quotes:
+            cols = split_fields(lines, len(self._fields), self._delim)
+        casted = (
+            self._apply_sticky_casts(cols) if cols is not None else None
+        )
+        if casted is not None:
+            return ColumnarBatch(casted)
+        rows = list(lines.tolist())
+        # A quoted field may span lines: the chunk splitter cut it at
+        # every newline.  csv reassembles multi-line fields when the
+        # terminators are present, so the fallback feeds TERMINATED
+        # lines — and when the batch ends inside an open quote (odd
+        # quote parity; sound for every dialect __init__ admits), it
+        # pulls further chunks until the row closes, so every emitted
+        # row is complete and the byte-offset snapshot (taken between
+        # deliveries) stays on a row boundary.
+        while self._stitch and n_quotes % 2:
+            try:
+                nxt = self._inner.next_batch()
+            except StopIteration:
+                break  # unterminated quote at EOF: parse what's there
+            if isinstance(nxt, ColumnarBatch) and len(nxt):
+                more = nxt.cols["line"]
+                n_quotes += self._count_quotes(more, self._quote)
+                rows.extend(more.tolist())
+        reader = csv.DictReader(
+            (ln + "\n" for ln in rows),
+            fieldnames=self._fields,
+            **self._fmtparams,
+        )
+        return list(reader)
+
+    def snapshot(self) -> int:
+        return self._inner.snapshot()
+
+    def close(self) -> None:
+        self._inner.close()
+
+
 class CSVSource(FixedPartitionedSource[Dict[str, str], int]):
     """Read a CSV file row-by-row as keyed-by-header dicts.
 
@@ -232,9 +498,25 @@ class CSVSource(FixedPartitionedSource[Dict[str, str], int]):
         path: Path,
         batch_size: int = 1000,
         get_fs_id: Callable[[Path], str] = _get_path_dev,
+        columnar: bool = False,
+        chunk_bytes: int = 1 << 20,
         **fmtparams: Any,
     ):
+        """``columnar=True`` reads raw chunks and emits
+        :class:`~bytewax_tpu.inputs.ColumnarBatch` record batches with
+        one column per CSV field, numeric columns cast to float64
+        (vectorized; the cast decision is made on the first batch and
+        held for the run, so chunk boundaries never flip a column's
+        dtype; docs/performance.md).  Batches the fast path can't take
+        (quoted fields, ragged rows, exotic dialects) fall back to
+        ``csv.DictReader`` per batch and arrive itemized — quoted
+        fields may span lines and chunks.  Dialects whose quote parity
+        doesn't delimit fields (``escapechar``, ``doublequote=False``)
+        are refused in columnar mode (a quoted field spanning a chunk
+        boundary couldn't be stitched); use itemized mode for those."""
         self._file_source = FileSource(path, batch_size, get_fs_id)
+        self._columnar = columnar
+        self._chunk_bytes = chunk_bytes
         self._fmtparams = fmtparams
 
     def list_parts(self) -> List[str]:
@@ -242,11 +524,18 @@ class CSVSource(FixedPartitionedSource[Dict[str, str], int]):
 
     def build_part(
         self, step_id: str, for_part: str, resume_state: Optional[int]
-    ) -> _CSVPartition:
+    ) -> StatefulSourcePartition:
         _fs_id, path = for_part.split("::", 1)
         if path != str(self._file_source._path):
             msg = "can't resume reading from different file"
             raise ValueError(msg)
+        if self._columnar:
+            return _ColumnarCSVPartition(
+                self._file_source._path,
+                self._chunk_bytes,
+                resume_state,
+                self._fmtparams,
+            )
         return _CSVPartition(
             self._file_source._path,
             self._file_source._batch_size,

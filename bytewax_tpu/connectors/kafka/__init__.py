@@ -15,6 +15,7 @@ for error-split streams and (de)serialization.
 import json
 from dataclasses import dataclass, field
 from typing import (
+    Any,
     Dict,
     Generic,
     Iterable,
@@ -25,9 +26,14 @@ from typing import (
     Union,
 )
 
+import numpy as np
 from prometheus_client import Gauge
 
-from bytewax_tpu.inputs import FixedPartitionedSource, StatefulSourcePartition
+from bytewax_tpu.inputs import (
+    ColumnarBatch,
+    FixedPartitionedSource,
+    StatefulSourcePartition,
+)
 from bytewax_tpu.outputs import DynamicSink, StatelessSinkPartition
 
 K = TypeVar("K")
@@ -216,6 +222,7 @@ class _KafkaSourcePartition(
         resume_state: Optional[int],
         batch_size: int,
         raise_on_errors: bool,
+        columnar: bool = False,
     ):
         ck = _require_confluent()
         self._offset = starting_offset if resume_state is None else resume_state
@@ -230,6 +237,7 @@ class _KafkaSourcePartition(
         self._batch_size = batch_size
         self._eof = False
         self._raise_on_errors = raise_on_errors
+        self._columnar = columnar
         self._partition_eof_code = ck.KafkaError._PARTITION_EOF
         self._lag_gauge = _CONSUMER_LAG_GAUGE.labels(
             step_id, topic, str(part_idx)
@@ -246,10 +254,61 @@ class _KafkaSourcePartition(
         if part is not None and self._offset > 0:
             self._lag_gauge.set(part["ls_offset"] - self._offset)
 
-    def next_batch(self) -> List[_RawSourceItem]:
+    def _columnar_batch(self, msgs) -> Optional[Any]:
+        """One ``ColumnarBatch`` from a clean poll — raw ``key``/
+        ``value`` byte columns plus an int64 ``ts`` column of broker
+        timestamps in microseconds since epoch (the engine's numeric-
+        ts convention, so source-lag accounting and event-time clocks
+        read it directly) — or ``None`` when any message carries an
+        error, a null key/value, or a key/value ending in a NUL byte:
+        those polls take the itemized path unchanged (error routing
+        and ``None`` fields are per-row concerns the columnar format
+        can't represent losslessly, and numpy ``S`` columns strip
+        trailing NULs — silently corrupting e.g. fixed-width binary
+        payloads — so NUL-tailed bytes stay itemized too)."""
+        cut = None
+        for i, msg in enumerate(msgs):
+            error = msg.error()
+            if error is not None:
+                if error.code() == self._partition_eof_code:
+                    cut = i
+                    break
+                return None
+            key, value = msg.key(), msg.value()
+            if key is None or value is None:
+                return None
+            if key[-1:] == b"\x00" or value[-1:] == b"\x00":
+                return None
+        if cut is not None:
+            # Emit the rows before the EOF marker; StopIteration on
+            # the next poll (same ordering as the itemized path).
+            self._eof = True
+            msgs = msgs[:cut]
+        if not msgs:
+            return []
+        cols: Dict[str, Any] = {
+            "key": np.array([m.key() for m in msgs]),
+            "value": np.array([m.value() for m in msgs]),
+        }
+        stamps = [m.timestamp() for m in msgs]
+        if all(s is not None and s[0] != 0 for s in stamps):
+            # Timestamp type 0 = TIMESTAMP_NOT_AVAILABLE; a batch
+            # without trustworthy stamps just omits the column (lag
+            # accounting skips it).
+            cols["ts"] = np.array(
+                [s[1] for s in stamps], dtype=np.int64
+            ) * np.int64(1000)
+        self._offset = msgs[-1].offset() + 1
+        return ColumnarBatch(cols)
+
+    def next_batch(self) -> Any:
         if self._eof:
             raise StopIteration()
         msgs = self._consumer.consume(self._batch_size, 0.001)
+        if self._columnar:
+            out = self._columnar_batch(msgs)
+            if out is not None:
+                return out
         batch: List[_RawSourceItem] = []
         last_offset = None
         for msg in msgs:
@@ -299,6 +358,20 @@ class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
     snapshotted into the recovery system (exactly-once capable).
     Messages enter the dataflow as :class:`KafkaSourceMessage` (or
     :class:`KafkaError` when ``raise_on_errors=False``).
+
+    ``columnar=True`` is the batch-native mode (docs/performance.md
+    "Columnar ingest"): each clean poll enters the dataflow as one
+    :class:`~bytewax_tpu.inputs.ColumnarBatch` with raw ``key``/
+    ``value`` byte columns and an int64 ``ts`` column (broker
+    timestamps, microseconds since epoch) instead of per-message
+    dataclasses — no per-row Python on the hot path, and source-lag
+    accounting reads the ``ts`` column directly.  Polls carrying
+    errors or null keys/values fall back to itemized
+    :class:`KafkaSourceMessage`/:class:`KafkaError` batches (the
+    protocol allows mixing), so error routing is unchanged; resume
+    offsets are identical in both modes.  The
+    :mod:`~bytewax_tpu.connectors.kafka.operators` namespace
+    deserializes per message and therefore uses itemized mode.
     """
 
     def __init__(
@@ -310,6 +383,7 @@ class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
         add_config: Optional[Dict[str, str]] = None,
         batch_size: int = 1000,
         raise_on_errors: bool = True,
+        columnar: bool = False,
     ):
         if isinstance(brokers, str):
             msg = "pass brokers as a list of addresses, not a single string"
@@ -325,6 +399,7 @@ class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
         self._add_config = dict(add_config or {})
         self._batch_size = batch_size
         self._raise_on_errors = raise_on_errors
+        self._columnar = columnar
 
     def list_parts(self) -> List[str]:
         """Each Kafka partition of each topic is an input partition."""
@@ -370,6 +445,7 @@ class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
             resume_state,
             self._batch_size,
             self._raise_on_errors,
+            self._columnar,
         )
 
 

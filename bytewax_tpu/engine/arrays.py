@@ -510,17 +510,20 @@ class ArrayBatch:
             # datetime values in columnar flows (see `column_ts`).
             return list(zip(self._key_strings(), self._ts_datetimes()))
         if names in ({"key", "ts", "value"}, {"key_id", "ts", "value"}):
-            # Numeric windowed-fold batches degrade to (key, TsValue)
-            # items: the payload folds as a plain float and carries
-            # the row's timestamp for `column_ts` getters.
-            stamps = self._ts_datetimes()
             values = self._scaled_values()
-            return [
-                (k, TsValue(v, t))
-                for k, v, t in zip(
-                    self._key_strings(), values.tolist(), stamps
-                )
-            ]
+            if np.issubdtype(values.dtype, np.number):
+                # Numeric windowed-fold batches degrade to (key,
+                # TsValue) items: the payload folds as a plain float
+                # and carries the row's timestamp for `column_ts`
+                # getters.  Non-numeric values (e.g. raw Kafka bytes)
+                # fall through to per-row dicts — TsValue is a float.
+                stamps = self._ts_datetimes()
+                return [
+                    (k, TsValue(v, t))
+                    for k, v, t in zip(
+                        self._key_strings(), values.tolist(), stamps
+                    )
+                ]
         if names == {"key_id", "value"}:
             return list(
                 zip(self._key_strings(), self._scaled_values().tolist())

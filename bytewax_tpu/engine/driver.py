@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from bytewax_tpu.dataflow import Dataflow, Operator
+from bytewax_tpu.engine import batching as _batching
 from bytewax_tpu.engine import faults as _faults
 from bytewax_tpu.engine import flight as _flight
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
@@ -106,19 +107,32 @@ def _batch_event_lag_s(items: Any, now: datetime) -> Optional[float]:
             if col is None:
                 return None
             arr = np.asarray(col)
-            if not len(arr) or not np.issubdtype(
-                arr.dtype, np.datetime64
+            if not len(arr):
+                return None
+            if np.issubdtype(arr.dtype, np.datetime64):
+                latest = arr.max().astype("datetime64[us]")
+                if np.isnat(latest):
+                    # A NaT (missing timestamp) propagates through
+                    # max() and would turn the lag into NaN — which
+                    # json.dumps renders as a bare token no
+                    # spec-compliant parser accepts, poisoning
+                    # /status cluster-wide.
+                    return None
+                now64 = np.datetime64(now.replace(tzinfo=None), "us")
+                return float((now64 - latest) / np.timedelta64(1, "s"))
+            if np.issubdtype(arr.dtype, np.integer) or np.issubdtype(
+                arr.dtype, np.floating
             ):
-                return None
-            latest = arr.max().astype("datetime64[us]")
-            if np.isnat(latest):
-                # A NaT (missing timestamp) propagates through max()
-                # and would turn the lag into NaN — which json.dumps
-                # renders as a bare token no spec-compliant parser
-                # accepts, poisoning /status cluster-wide.
-                return None
-            now64 = np.datetime64(now.replace(tzinfo=None), "us")
-            return float((now64 - latest) / np.timedelta64(1, "s"))
+                # Numeric ts columns are microseconds since epoch —
+                # the ArrayBatch convention (_ts_datetimes) the
+                # batch-native connectors emit.  NaN propagates
+                # through max() like NaT would; reject it the same
+                # way.
+                latest_us = float(arr.max())
+                if latest_us != latest_us:  # NaN
+                    return None
+                return now.timestamp() - latest_us / 1e6
+            return None
         last = items[-1]
     except (TypeError, IndexError, KeyError, ValueError):
         return None
@@ -592,6 +606,22 @@ class _InputRt(_OpRt):
         self.part_worker: Dict[str, int] = {}
         self.next_awake: Dict[str, Optional[datetime]] = {}
         self.pending_snaps: List[Tuple[str, Any]] = []
+        # Adaptive micro-batch coalescing (engine/batching.py): keep
+        # polling a ready partition within ONE poll pass until the
+        # accumulated delivery reaches the target row count, merging
+        # compatible consecutive batches.  Armed by default only when
+        # the plan routes this input to a device-tier step (the
+        # flatten pass's _accel_bound annotation); 0 = off.  Never
+        # crosses a poll boundary, so snapshots still cover every
+        # emitted row and an idle source ships immediately.
+        self.coalesce_rows = _batching.coalesce_target(
+            bool(op.conf.get("_accel_bound")) and driver.accel
+        )
+        #: Exceptions raised by a coalescing (non-first) next_batch
+        #: call, re-raised at this partition's NEXT poll — the rows
+        #: accumulated before it must flow (and be processed) first,
+        #: exactly as they would have without coalescing.
+        self._deferred: Dict[str, BaseException] = {}
         if isinstance(source, FixedPartitionedSource):
             # All processes see the same sorted name set, so the
             # partition→worker assignment is globally consistent;
@@ -636,6 +666,48 @@ class _InputRt(_OpRt):
     def process(self, port: str, entries: List[Entry]) -> None:
         raise AssertionError("input ops have no upstreams")
 
+    def _coalesce(self, name: str, part: Any, first: Any, now: datetime):
+        """Keep polling one ready partition until the accumulated
+        delivery reaches the coalescing target (or the source goes
+        quiet), grouping consecutive compatible batches; returns the
+        ordered list of (merged) batches to emit.  An exception from
+        a non-first call is deferred to the partition's next poll so
+        the rows gathered before it flow first."""
+        groups: List[List[Any]] = [[first]]
+        rows = len(first)
+        target = self.coalesce_rows
+        polls = 0
+        timer = self._timer(
+            "inp_part_next_batch", self.part_worker.get(name)
+        )
+        while rows < target and polls < _batching.COALESCE_MAX_POLLS:
+            na = part.next_awake()
+            if na is not None and na > now:
+                break
+            polls += 1
+            try:
+                with timer.time():
+                    nxt = part.next_batch()
+                if not isinstance(nxt, (list, ArrayBatch)):
+                    nxt = list(nxt)
+            except BaseException as ex:  # noqa: BLE001
+                # Includes StopIteration (EOF) and AbortExecution:
+                # both re-raise at the next poll, after this pass's
+                # rows were processed — matching the uncoalesced
+                # engine's ordering exactly.
+                self._deferred[name] = ex
+                break
+            if not len(nxt):
+                break
+            if _batching.can_merge(groups[-1][-1], nxt):
+                groups[-1].append(nxt)
+            else:
+                groups.append([nxt])
+            rows += len(nxt)
+        if polls:
+            _flight.RECORDER.count("ingest_coalesced_polls", polls)
+        return [_batching.merge_batches(g) for g in groups]
+
     def poll(self, now: datetime) -> bool:
         progressed = False
         polled = False
@@ -647,6 +719,20 @@ class _InputRt(_OpRt):
                 if na is not None and na > now:
                     continue
                 polled = True
+                deferred = self._deferred.pop(name, None)
+                if deferred is not None:
+                    if isinstance(deferred, StopIteration):
+                        if self.stateful:
+                            self.pending_snaps.append(
+                                (name, part.snapshot())
+                            )
+                        part.close()
+                        del self.parts[name]
+                        progressed = True
+                        continue
+                    if isinstance(deferred, AbortExecution):
+                        raise _Abort() from None
+                    _reraise(self.op.step_id, "`next_batch`", deferred)
                 try:
                     with self._timer(
                         "inp_part_next_batch", self.part_worker.get(name)
@@ -665,19 +751,36 @@ class _InputRt(_OpRt):
                     raise _Abort() from None
                 except BaseException as ex:  # noqa: BLE001
                     _reraise(self.op.step_id, "`next_batch`", ex)
-                if batch:
-                    self.emit(
-                        "down", (self.part_worker[name], batch)
-                    )
+                emitted = len(batch) > 0
+                if emitted:
+                    if self.coalesce_rows > 1 and len(batch) < (
+                        self.coalesce_rows
+                    ):
+                        batches = self._coalesce(name, part, batch, now)
+                    else:
+                        batches = [batch]
+                    w = self.part_worker[name]
+                    for b in batches:
+                        self.emit("down", (w, b))
+                        _flight.RECORDER.count(
+                            "ingest_rows_columnar"
+                            if isinstance(b, ArrayBatch)
+                            else "ingest_rows_itemized",
+                            len(b),
+                        )
                     progressed = True
-                    lag = _batch_event_lag_s(batch, now)
+                    lag = _batch_event_lag_s(batches[-1], now)
                     if lag is not None:
                         _flight.note_source_lag(
                             self.op.step_id, "event_time", lag
                         )
-                part_na = part.next_awake()
-                if part_na is None and not batch:
-                    part_na = now + _EMPTY_COOLDOWN
+                if name in self._deferred:
+                    # Deliver the deferred raise promptly.
+                    part_na: Optional[datetime] = None
+                else:
+                    part_na = part.next_awake()
+                    if part_na is None and not emitted:
+                        part_na = now + _EMPTY_COOLDOWN
                 self.next_awake[name] = part_na
         finally:
             if polled:
@@ -3115,6 +3218,16 @@ class _Driver:
                         waits.append(interval_s - elapsed)
                     wait = min(waits) if waits else 0.001
                     wait = min(max(wait, 0.0), 0.05)
+                    if wait > 0.001 and any(
+                        isinstance(rt, _StatefulBatchRt)
+                        and rt._pipe_pending()
+                        for rt in self.rts
+                    ):
+                        # An in-flight device phase finalizes on the
+                        # next drain pass; idling the full backoff
+                        # here would add up to 50ms of emission
+                        # latency per pipelined delivery.
+                        wait = 0.001
                     if clustered:
                         if wait > 0 and self._pending_close is None:
                             self._pump(timeout=wait)

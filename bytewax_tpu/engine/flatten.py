@@ -238,6 +238,62 @@ def _index(plan: Plan) -> None:
             plan.producer[s.stream_id] = idx
 
 
+#: Core ops a columnar batch passes through (possibly transformed but
+#: still batch-granular) on its way to a device-tier consumer.  Used
+#: by the ingest reachability pass below; branch/inspect itemize but
+#: still forward, so they stay transparent for reachability.
+_BATCH_TRANSPARENT = frozenset(
+    {
+        "_noop",
+        "branch",
+        "flat_map_batch",
+        "inspect_debug",
+        "merge",
+        "redistribute",
+    }
+)
+
+
+def _annotate_accel_bound(plan: Plan) -> None:
+    """Ingest-plumbing pass: mark each core ``input`` op whose stream
+    reaches a device-annotated ``stateful_batch`` through batch-
+    transparent ops with ``_accel_bound``.  The driver arms adaptive
+    micro-batch coalescing (engine/batching.py) for those inputs by
+    default — re-batching trickle sources into device-sized
+    micro-batches pays exactly when a dispatch is being amortized.
+    Deterministic (plan order), so every cluster process agrees."""
+    for op in plan.ops:
+        if op.name != "input":
+            continue
+        seen: set = set()
+        frontier = [s.stream_id for s in op.down_streams()]
+        bound = False
+        while frontier and not bound:
+            sid = frontier.pop()
+            if sid in seen:
+                continue
+            seen.add(sid)
+            for ci, _port in plan.consumers.get(sid, []):
+                consumer = plan.ops[ci]
+                spec = (
+                    consumer.conf.get("_accel")
+                    if consumer.name == "stateful_batch"
+                    else None
+                )
+                if spec is not None:
+                    # Session windows merge by inter-batch arrival
+                    # grouping, so re-batching would change their
+                    # window metadata — they never arm coalescing.
+                    if type(spec).__name__ != "SessionAccelSpec":
+                        bound = True
+                        break
+                if consumer.name in _BATCH_TRANSPARENT:
+                    frontier.extend(
+                        s.stream_id for s in consumer.down_streams()
+                    )
+        op.conf["_accel_bound"] = bound
+
+
 def _prune_dead_taps(plan: Plan) -> None:
     """Drop core steps marked ``_prunable`` (pure internal shims —
     the window operator's unwrap taps) whose output streams have no
@@ -270,6 +326,7 @@ def flatten(flow: Dataflow) -> Plan:
         _walk(op, plan)
     _index(plan)
     _prune_dead_taps(plan)
+    _annotate_accel_bound(plan)
     names = {op.name for op in plan.ops}
     if "input" not in names:
         msg = (

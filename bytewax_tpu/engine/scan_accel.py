@@ -24,13 +24,13 @@ shares this module's update surface (:class:`ScanUpdates`) and
 snapshot format.
 """
 
-import math
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from bytewax_tpu.engine import flight as _flight
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
+from bytewax_tpu.engine.batching import pad_len
 from bytewax_tpu.engine.xla import NonNumericValues
 from bytewax_tpu.ops.scan import ScanKind
 
@@ -252,10 +252,10 @@ class DeviceScanState(ScanUpdates):
         import jax
 
         n = len(values)
-        # Pad to the next power of two so XLA sees few distinct
-        # shapes; padding rows target the scratch slot (the max slot
-        # id, so the trailing pad is its own segment).
-        padded = 1 << max(5, math.ceil(math.log2(max(n, 1))))
+        # Bucketed padding (engine/batching.py) so XLA sees few
+        # distinct shapes; padding rows target the scratch slot (the
+        # max slot id, so the trailing pad is its own segment).
+        padded = pad_len(n)
         slots_p = np.full(padded, self.capacity - 1, dtype=np.int32)
         slots_p[:n] = row_slots
         vals_p = np.zeros(padded, dtype=np.float32)

@@ -8,7 +8,6 @@ the slots awoken in the closing epoch, preserving the recovery
 contract of the host tier (states are interchangeable between tiers).
 """
 
-import math
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -17,6 +16,7 @@ import numpy as np
 
 from bytewax_tpu.engine import flight as _flight
 from bytewax_tpu.engine.arrays import ArrayBatch, KeyEncoder, VocabMap
+from bytewax_tpu.engine.batching import pad_len
 from bytewax_tpu.ops.segment import (
     AGG_KINDS,
     init_fields,
@@ -216,10 +216,10 @@ class DeviceAggState:
             return
         if not self._pending_reset:
             return
-        # Pad to a power of two (repeating the first slot — set is
+        # Pad to a bucket (repeating the first slot — set is
         # idempotent) so XLA sees few distinct shapes.
         n = len(self._pending_reset)
-        padded = 1 << max(3, math.ceil(math.log2(n)))
+        padded = pad_len(n, floor_pow=3)
         slots_np = np.full(padded, self._pending_reset[0], dtype=np.int32)
         slots_np[:n] = self._pending_reset
         slots = jnp.asarray(slots_np)
@@ -359,9 +359,10 @@ class DeviceAggState:
 
     def _scatter(self, slot_ids: np.ndarray, values: np.ndarray) -> None:
         n = len(values)
-        # Pad to the next power of two so XLA sees few distinct
-        # shapes; padding rows target the scratch slot (capacity - 1).
-        padded = 1 << max(5, math.ceil(math.log2(max(n, 1))))
+        # Bucketed padding (engine/batching.py) so XLA sees few
+        # distinct shapes; padding rows target the scratch slot
+        # (capacity - 1).
+        padded = pad_len(n)
         slots_p = np.full(padded, self.capacity - 1, dtype=np.int32)
         slots_p[:n] = slot_ids
         vals_p = np.zeros(padded, dtype=np.dtype(self.dtype))
@@ -434,7 +435,7 @@ class DeviceAggState:
             self._ensure_fields()
             n = len(values)
             sentinel = len(self._vocab.table)
-            padded = 1 << max(5, math.ceil(math.log2(max(n, 1))))
+            padded = pad_len(n)
             if quantized and sentinel < 2**15:
                 # Fixed-point fast path: one int16 [2, n] transfer.
                 packed = np.full((2, padded), sentinel, dtype=np.int16)

@@ -5,6 +5,16 @@ If you want pre-built connectors, see :mod:`bytewax_tpu.connectors`.
 API parity with the reference (``/root/reference/pysrc/bytewax/inputs.py``);
 implementation is our own.  Sources are driven host-side by the engine; the
 engine batches their output into device micro-batches.
+
+Batch-native sources (docs/performance.md "Columnar ingest"):
+``next_batch`` may return a :class:`ColumnarBatch` — a record batch of
+equal-length NumPy column arrays, optionally with ``key``/``key_id``,
+``ts``, and ``value`` columns — instead of (or interleaved with) item
+lists.  A columnar batch flows intact through routing, the cluster
+exchange, and the device tier with zero per-row Python work; host-tier
+steps that genuinely need Python objects itemize it on contact.  The
+protocol is strictly additive: itemized sources work unchanged, and
+one partition may mix itemized and columnar batches freely.
 """
 
 import asyncio
@@ -23,12 +33,15 @@ from typing import (
     TypeVar,
 )
 
+from bytewax_tpu.engine.arrays import ArrayBatch as ColumnarBatch
+
 X = TypeVar("X")
 S = TypeVar("S")
 Sn = TypeVar("Sn")
 
 __all__ = [
     "AbortExecution",
+    "ColumnarBatch",
     "DynamicSource",
     "FixedPartitionedSource",
     "SimplePollingSource",
@@ -89,6 +102,11 @@ class StatefulSourcePartition(ABC, Generic[X, S]):
     @abstractmethod
     def next_batch(self) -> Iterable[X]:
         """Attempt to get the next batch of input items, non-blocking.
+
+        May return a :class:`ColumnarBatch` instead of an item list
+        (batch-native protocol — the batch rides the engine's columnar
+        fast path without itemizing); itemized and columnar batches
+        may be mixed freely across calls.
 
         Raise :class:`StopIteration` when complete (EOF).
         """
@@ -180,6 +198,9 @@ class StatelessSourcePartition(ABC, Generic[X]):
     @abstractmethod
     def next_batch(self) -> Iterable[X]:
         """Attempt to get the next batch of input items, non-blocking.
+
+        May return a :class:`ColumnarBatch` instead of an item list
+        (see :class:`StatefulSourcePartition.next_batch`).
 
         Raise :class:`StopIteration` when complete (EOF).
         """

@@ -532,6 +532,25 @@ def stateful(
 # --------------------------------------------------------------------------
 
 
+def _per_item(shim: Callable[[List[X]], Iterable[Y]]) -> Callable:
+    """Mark a ``flat_map_batch`` shim as genuinely per-item: a
+    columnar ``ArrayBatch`` reaching it itemizes (``to_pylist``)
+    before the shim runs.  This is the host-tier contact point the
+    batch-native ingest protocol itemizes at — batch-level shims that
+    can consume columns directly (e.g. ``count_final``'s keying) pass
+    themselves unwrapped instead."""
+
+    def per_item_shim(xs: Any) -> Iterable[Y]:
+        from bytewax_tpu.engine.arrays import ArrayBatch as _AB
+
+        if isinstance(xs, _AB):
+            xs = xs.to_pylist()
+        return shim(xs)
+
+    per_item_shim.__wrapped__ = shim
+    return per_item_shim
+
+
 @operator
 def flat_map(
     step_id: str,
@@ -559,7 +578,7 @@ def flat_map(
         return itertools.chain.from_iterable(mapper(x) for x in xs)
 
     shim_mapper.__wrapped__ = mapper
-    return flat_map_batch("flat_map_batch", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_mapper))
 
 
 @operator
@@ -594,7 +613,7 @@ def flat_map_value(
         return out
 
     shim_mapper.__wrapped__ = mapper
-    return flat_map_batch("flat_map_batch", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_mapper))
 
 
 @operator
@@ -631,7 +650,7 @@ def flatten(
             out.extend(x)
         return out
 
-    return flat_map_batch("flat_map_batch", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_mapper))
 
 
 @operator
@@ -673,7 +692,7 @@ def filter(  # noqa: A001
         return out
 
     shim_mapper.__wrapped__ = predicate
-    return flat_map_batch("flat_map_batch", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_mapper))
 
 
 @operator
@@ -716,7 +735,7 @@ def filter_value(
         return out
 
     shim_mapper.__wrapped__ = predicate
-    return flat_map_batch("flat_map_batch", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_mapper))
 
 
 @operator
@@ -751,7 +770,7 @@ def filter_map(
         return out
 
     shim_mapper.__wrapped__ = mapper
-    return flat_map_batch("flat_map_batch", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_mapper))
 
 
 @operator
@@ -787,7 +806,7 @@ def filter_map_value(
         return out
 
     shim_mapper.__wrapped__ = mapper
-    return flat_map_batch("flat_map_batch", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_mapper))
 
 
 @operator
@@ -856,7 +875,7 @@ def key_on(step_id: str, up: Stream[X], key: Callable[[X], str]) -> KeyedStream[
         return out
 
     shim_mapper.__wrapped__ = key
-    return flat_map_batch("flat_map_batch", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_mapper))
 
 
 @operator
@@ -881,7 +900,7 @@ def key_rm(step_id: str, up: KeyedStream[X]) -> Stream[X]:
     def shim_batch(k_vs: List[Tuple[str, X]]) -> List[X]:
         return [v for _k, v in k_vs]
 
-    return flat_map_batch("flat_map_batch", up, shim_batch)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_batch))
 
 
 @operator
@@ -911,7 +930,7 @@ def map(  # noqa: A001
         return [mapper(x) for x in xs]
 
     shim_mapper.__wrapped__ = mapper
-    return flat_map_batch("flat_map_batch", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_mapper))
 
 
 @operator
@@ -952,7 +971,7 @@ def map_value(
         return [shim_mapper(k_v) for k_v in k_vs]
 
     shim_batch.__wrapped__ = mapper
-    return flat_map_batch("flat_map_batch", up, shim_batch)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_batch))
 
 
 @operator
@@ -1412,7 +1431,7 @@ def enrich_cached(
         now = _now_getter()
         return [mapper(cache, x) for x in xs]
 
-    return flat_map_batch("flat_map_batch", up, shim_mapper)
+    return flat_map_batch("flat_map_batch", up, _per_item(shim_mapper))
 
 
 # --------------------------------------------------------------------------

@@ -7,14 +7,23 @@ downstream keyed count rides the device scatter path without ever
 materializing per-word Python strings.
 """
 
+import os
 import re
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
 from bytewax_tpu.engine.arrays import ArrayBatch
 
-__all__ = ["TOKEN_RE", "WordTokenizer", "native_tokenizer_available"]
+__all__ = [
+    "LineBatcher",
+    "TOKEN_RE",
+    "WordTokenizer",
+    "maybe_numeric",
+    "native_tokenizer_available",
+    "split_fields",
+    "split_lines",
+]
 
 #: The canonical word-separator set (reference:
 #: ``examples/wordcount.py``).  The native tokenizer's stop table in
@@ -29,6 +38,219 @@ def native_tokenizer_available() -> bool:
     from bytewax_tpu.native import is_available
 
     return is_available()
+
+
+# -- vectorized line/field decode (the columnar ingest fast path) -----------
+#
+# Line-oriented connectors (files, stdio) read raw CHUNKS and split
+# them here in O(chunk) vectorized passes — no per-row Python strings
+# until (unless) a host-tier step itemizes.  The heavy op is one
+# fancy-index gather of the padded line matrix; with
+# BYTEWAX_TPU_TEXT_DEVICE=1 that gather runs through jax on the
+# configured backend (the "device-side decode" path — worthwhile on
+# real accelerators where the columns are device-bound anyway; the
+# numpy path is fastest on CPU-fallback hosts).
+
+
+def _gather_pad(
+    buf: np.ndarray, starts: np.ndarray, lens: np.ndarray, width: int
+) -> np.ndarray:
+    """[n_lines, width] padded code-unit matrix from a flat buffer:
+    row i is ``buf[starts[i] : starts[i] + lens[i]]`` zero-padded to
+    ``width``.  One gather + one mask, no per-line Python."""
+    offs = np.arange(width, dtype=starts.dtype)
+    idx = starts[:, None] + offs[None, :]
+    np.clip(idx, 0, len(buf) - 1, out=idx)
+    mask = offs[None, :] < lens[:, None]
+    if os.environ.get("BYTEWAX_TPU_TEXT_DEVICE") == "1":
+        import jax.numpy as jnp
+
+        return np.asarray(
+            jnp.where(
+                jnp.asarray(mask),
+                jnp.asarray(buf)[jnp.asarray(idx)],
+                0,
+            )
+        )
+    return np.where(mask, buf[idx], 0)
+
+
+def _split_units(buf: np.ndarray, kind: str) -> np.ndarray:
+    """Split a newline-terminated flat code-unit buffer (uint8 for
+    bytes/``S``, uint32 for text/``U``) into a fixed-width line array.
+    CR before LF is stripped (CRLF files decode like LF files)."""
+    ends = np.flatnonzero(buf == 0x0A)
+    starts = np.empty_like(ends)
+    if len(ends):
+        starts[0] = 0
+        starts[1:] = ends[:-1] + 1
+    lens = ends - starts
+    if len(ends):
+        crlf = (lens > 0) & (buf[np.maximum(ends - 1, 0)] == 0x0D)
+        lens = lens - crlf
+    width = max(int(lens.max()) if len(lens) else 0, 1)
+    n = len(ends)
+    if n * width > 8 * len(buf) and n * width * buf.itemsize > (1 << 22):
+        # Fixed-width line arrays pad EVERY row to the longest line's
+        # width: one pathological 200KB line sharing a chunk with 16k
+        # short lines would turn a 1MB read into a multi-GB array.
+        # Such ragged chunks take a per-line object-dtype split
+        # instead (O(chunk) memory; vectorization resumes on the next
+        # chunk, and consumers fall back on the dtype).
+        if kind == "S":
+            data = buf.tobytes()
+        else:
+            data = buf.astype("<u4").tobytes().decode("utf-32-le")
+        return np.array(
+            [
+                data[s : s + ln]
+                for s, ln in zip(starts.tolist(), lens.tolist())
+            ],
+            dtype=object,
+        )
+    mat = _gather_pad(buf, starts, lens, width)
+    if kind == "S":
+        return (
+            np.ascontiguousarray(mat.astype(np.uint8))
+            .view(f"S{width}")
+            .ravel()
+        )
+    return (
+        np.ascontiguousarray(mat.astype(np.uint32))
+        .view(f"U{width}")
+        .ravel()
+    )
+
+
+def split_lines(
+    body: bytes, encoding: Optional[str] = "utf-8"
+) -> np.ndarray:
+    """Split a newline-terminated byte chunk into a line array in
+    O(chunk) vectorized passes (``U``-dtype text lines, or ``S``-dtype
+    raw byte lines with ``encoding=None``).  ``body`` must end with
+    ``\\n`` — callers carry the trailing partial line themselves (see
+    :class:`LineBatcher`).
+
+    >>> from bytewax_tpu.ops.text import split_lines
+    >>> split_lines(b"one\\ntwo\\n").tolist()
+    ['one', 'two']
+    """
+    if not body:
+        return np.empty(0, dtype="U1")
+    if encoding is None:
+        return _split_units(np.frombuffer(body, np.uint8), "S")
+    text = body.decode(encoding)
+    buf = np.frombuffer(text.encode("utf-32-le"), np.uint32)
+    return _split_units(buf, "U")
+
+
+class LineBatcher:
+    """Chunk→line-batch decoder with exact resume offsets.
+
+    Feed raw byte chunks in read order; each feed returns the
+    ``ColumnarBatch({"line": ...})`` of every line completed by that
+    chunk (or ``None``) and internally carries the trailing partial
+    line — :attr:`pending` is its byte length, so a partition's
+    resume offset is ``bytes_read - batcher.pending`` at any point
+    (always a line boundary; the recovery snapshot format stays a
+    plain int byte offset).  :meth:`flush` emits the final
+    unterminated line at EOF.
+    """
+
+    __slots__ = ("_carry", "_encoding")
+
+    def __init__(self, encoding: Optional[str] = "utf-8"):
+        self._carry = b""
+        self._encoding = encoding
+
+    @property
+    def pending(self) -> int:
+        """Bytes held back as a trailing partial line."""
+        return len(self._carry)
+
+    def feed(self, raw: bytes) -> Optional[ArrayBatch]:
+        data = self._carry + raw
+        cut = data.rfind(b"\n") + 1
+        if cut == 0:
+            self._carry = data
+            return None
+        self._carry = data[cut:]
+        lines = split_lines(data[:cut], self._encoding)
+        return ArrayBatch({"line": lines})
+
+    def flush(self) -> Optional[ArrayBatch]:
+        """EOF: the carried bytes are the (unterminated) last line."""
+        if not self._carry:
+            return None
+        body, self._carry = self._carry + b"\n", b""
+        return ArrayBatch({"line": split_lines(body, self._encoding)})
+
+
+def split_fields(
+    lines: np.ndarray, n_fields: int, delimiter: str = ","
+) -> Optional[List[np.ndarray]]:
+    """Split a ``U``-dtype line array into exactly ``n_fields`` field
+    columns with O(fields) vectorized passes (``np.char.partition``
+    per field).  Returns ``None`` when any row has the wrong
+    delimiter count — the caller falls back to a real CSV parser for
+    that batch (quoting, ragged rows).
+
+    >>> import numpy as np
+    >>> from bytewax_tpu.ops.text import split_fields
+    >>> [c.tolist() for c in split_fields(np.array(["a,1", "b,2"]), 2)]
+    [['a', 'b'], ['1', '2']]
+    """
+    if lines.dtype.kind not in "US":
+        # Ragged chunks degrade to object-dtype line arrays (see
+        # _split_units); np.char needs fixed-width strings, so those
+        # batches take the caller's fallback parser.
+        return None
+    delim: Any = delimiter
+    if lines.dtype.kind == "S" and isinstance(delimiter, str):
+        # Raw byte lines (split_lines with encoding=None): np.char
+        # needs the operand in the array's own flavor.
+        delim = delimiter.encode("ascii")
+    counts = np.char.count(lines, delim)
+    if len(counts) and (
+        counts.min() != n_fields - 1 or counts.max() != n_fields - 1
+    ):
+        return None
+    cols: List[np.ndarray] = []
+    rest = lines
+    for _ in range(n_fields - 1):
+        parts = np.char.partition(rest, delim)
+        cols.append(np.ascontiguousarray(parts[:, 0]))
+        rest = np.ascontiguousarray(parts[:, 2])
+    cols.append(rest)
+    return cols
+
+
+def maybe_numeric(col: np.ndarray) -> np.ndarray:
+    """Cast a string column to float64 when every cell parses (one
+    C-level pass); otherwise (including empty cells) return it
+    unchanged.
+
+    Cells that parse but don't round-trip keep the column as strings:
+    ``nan``/``inf`` tokens, and leading-zero identifiers (``"00501"``
+    zip codes would silently become ``501.0``)."""
+    if not len(col) or col.dtype.kind not in "US":
+        return col
+    try:
+        cast = col.astype(np.float64)
+    except ValueError:
+        return col
+    if not np.isfinite(cast).all():
+        return col
+    raw = col.dtype.kind == "S"
+    stripped = np.char.lstrip(col, b"+-" if raw else "+-")
+    zero_led = (
+        np.char.startswith(stripped, b"0" if raw else "0")
+        & (np.char.str_len(stripped) > 1)
+        & ~np.char.startswith(stripped, b"0." if raw else "0.")
+    )
+    if zero_led.any():
+        return col
+    return cast
 
 
 class WordTokenizer:

@@ -290,8 +290,11 @@ class TestingSink(DynamicSink[X]):
 
 def poll_next_batch(
     part: StatefulSourcePartition, timeout: timedelta = timedelta(seconds=5)
-) -> List:
+) -> Any:
     """Repeatedly poll a partition until it returns a batch.
+
+    A batch-native partition's :class:`~bytewax_tpu.inputs.ColumnarBatch`
+    is returned as-is; item batches come back as lists.
 
     >>> from bytewax_tpu.testing import TestingSource, poll_next_batch
     >>> src = TestingSource([1, 2], batch_size=2)
@@ -299,12 +302,16 @@ def poll_next_batch(
     >>> poll_next_batch(part)
     [1, 2]
     """
-    batch: List = []
+    from bytewax_tpu.inputs import ColumnarBatch
+
+    batch: Any = []
     start = datetime.now(timezone.utc)
     while len(batch) <= 0:
         if datetime.now(timezone.utc) - start > timeout:
             raise TimeoutError()
-        batch = list(part.next_batch())
+        batch = part.next_batch()
+        if not isinstance(batch, ColumnarBatch):
+            batch = list(batch)
     return batch
 
 

@@ -143,6 +143,9 @@ def test_device_demotion_after_k_faults(monkeypatch):
     monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "device_dispatch:error:2+")
     monkeypatch.setenv("BYTEWAX_TPU_DEMOTE_AFTER", "3")
     monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    # The epoch-2+ fault schedule needs deliveries spread across
+    # epochs; keep ingest at source batch granularity.
+    monkeypatch.setenv("BYTEWAX_TPU_INGEST_TARGET_ROWS", "0")
 
     n = 40
     inp = [(f"k{i % 4}", 1.0) for i in range(n)]
@@ -183,6 +186,8 @@ def test_device_demotion_windowed_state_continuity(monkeypatch):
 
     monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "device_dispatch:error:2+")
     monkeypatch.setenv("BYTEWAX_TPU_DEMOTE_AFTER", "2")
+    # Epoch-timed faults need deliveries spread across epochs.
+    monkeypatch.setenv("BYTEWAX_TPU_INGEST_TARGET_ROWS", "0")
     monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
 
     align = datetime(2022, 1, 1, tzinfo=timezone.utc)
@@ -234,6 +239,8 @@ def test_device_demotion_scan_state_continuity(monkeypatch):
 
     monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "device_dispatch:error:2+")
     monkeypatch.setenv("BYTEWAX_TPU_DEMOTE_AFTER", "2")
+    # Epoch-timed faults need deliveries spread across epochs.
+    monkeypatch.setenv("BYTEWAX_TPU_INGEST_TARGET_ROWS", "0")
     monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
     demoted = []
     run_main(build(demoted), epoch_interval=ZERO_TD)

@@ -177,3 +177,34 @@ def test_allowlist_is_not_stale():
         for call in pre_close.calls
         for t in call.targets
     ), "call graph lost the pre_close -> global flush edge"
+
+
+def test_ingest_batching_is_process_local():
+    """The columnar-ingest PR pin: batch-native sources, coalescing,
+    and bucketed padding (engine/batching.py + the connectors) are
+    process-local — the frame-kind inventory above is byte-identical,
+    no allowlist grew to admit them, and none of their functions call
+    a raw send primitive, a ship method, or a sync round."""
+    ingest_modules = {"bytewax_tpu.engine.batching"}
+    allowlisted = (
+        set().union(*contracts.SEND_ALLOWED.values())
+        | contracts.GSYNC_CALLER_MODULES
+    )
+    assert not (ingest_modules & allowlisted)
+    assert not any(m.startswith("bytewax_tpu.connectors") for m in allowlisted)
+
+    project = _project()
+    assert "bytewax_tpu.engine.batching" in project.modules
+    forbidden = (
+        contracts.RAW_SEND_METHODS
+        | contracts.SHIP_METHODS
+        | contracts.GSYNC_PRIMITIVES
+    )
+    checked = 0
+    for qual, fn in project.functions.items():
+        mod = qual.split(":", 1)[0]
+        if mod in ingest_modules or mod.startswith("bytewax_tpu.connectors"):
+            checked += 1
+            comm_calls = [c.name for c in fn.calls if c.name in forbidden]
+            assert not comm_calls, f"{qual} calls {comm_calls}"
+    assert checked > 10  # the scan really covered the ingest surface

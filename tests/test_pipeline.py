@@ -211,6 +211,9 @@ def test_mid_pipeline_device_fault_retries_then_demotes(monkeypatch):
     monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "device_dispatch:error:2+")
     monkeypatch.setenv("BYTEWAX_TPU_DEMOTE_AFTER", "3")
     monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    # The epoch-2+ fault schedule needs deliveries spread across
+    # epochs; keep ingest at source batch granularity.
+    monkeypatch.setenv("BYTEWAX_TPU_INGEST_TARGET_ROWS", "0")
 
     n = 48
     inp = [(f"k{i % 4}", 1.0) for i in range(n)]
