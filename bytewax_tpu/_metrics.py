@@ -22,13 +22,16 @@ __all__ = [
     "comm_fenced_frames",
     "comm_frames",
     "device_transfer_bytes",
+    "dlq_records_count",
     "epoch_close_duration_seconds",
     "epoch_phase_seconds",
     "fault_injected_count",
     "generate_python_metrics",
     "gsync_round_count",
+    "io_retries_count",
     "item_inp_count",
     "item_out_count",
+    "quarantined_partitions",
     "pipeline_depth",
     "pipeline_flush_stall_seconds",
     "rescale_duration_seconds",
@@ -252,6 +255,39 @@ step_demotion_count = Counter(
     "bytewax_step_demotion_count",
     "Stateful steps demoted from the device tier to the host tier "
     "after consecutive device faults",
+    ["step_id"],
+)
+
+
+# -- connector-edge resilience families ---------------------------------
+#
+# Fed by the I/O retry ladder, the dead-letter queue, and partition
+# quarantine in ``engine/driver.py`` (docs/recovery.md
+# "Connector-edge resilience").
+
+io_retries_count = Counter(
+    "bytewax_io_retries_count",
+    "Transient connector-edge I/O failures retried in place "
+    "(kind=source: a source partition's next_batch re-polled after "
+    "backoff; kind=sink: a sink partition's write_batch re-invoked "
+    "before the epoch commit)",
+    ["step_id", "kind"],
+)
+
+dlq_records_count = Counter(
+    "bytewax_dlq_records_count",
+    "Poison records captured into the dead-letter queue instead of "
+    "killing the run (connectors with on_error='dlq'; persisted "
+    "under BYTEWAX_TPU_DLQ_DIR)",
+    ["step_id"],
+)
+
+quarantined_partitions = Gauge(
+    "bytewax_quarantined_partitions",
+    "Source partitions currently parked by quarantine "
+    "(BYTEWAX_TPU_QUARANTINE=1: retry budget exhausted; frozen at "
+    "the last good offset and re-probed on a backoff schedule while "
+    "the rest of the dataflow keeps flowing)",
     ["step_id"],
 )
 

@@ -197,11 +197,21 @@ FAULTS_MODULE = "bytewax_tpu.engine.faults"
 #: (``recovery_store.RecoveryStore.rescale``): fired inside the
 #: all-partition transaction before any row moves, legal only at run
 #: startup — the one globally-ordered re-entry point.
+#: ``source_poll``/``sink_write`` are the connector-edge sites
+#: (docs/recovery.md "Connector-edge resilience"): fired in the
+#: driver immediately before a source partition's ``next_batch`` / a
+#: sink partition's ``write_batch``, before any offset advances or
+#: byte lands, so an injected transient error is retry-safe; their
+#: ``kind=error`` raises the typed transient I/O errors the retry
+#: ladder absorbs.  Both are process-local — no comm frames, no new
+#: send surface.
 FAULT_SITES = (
     "comm.send",
     "comm.recv",
     "device_dispatch",
     "residency_restore",
+    "source_poll",
+    "sink_write",
     "snapshot.write",
     "snapshot.commit",
     "rescale_migrate",

@@ -11,6 +11,12 @@ instead of decoding per row in Python, emitting
 :class:`~bytewax_tpu.inputs.ColumnarBatch` record batches.  Resume
 snapshots stay plain int byte offsets in both modes (always a line
 boundary), so a store written by one mode resumes under the other.
+
+Connector-edge resilience (docs/recovery.md): transient ``OSError``s
+from reads/writes are retried by the engine at the poll/write
+boundary, and the sources take ``on_error="dlq"`` to dead-letter
+poison rows (undecodable lines, parser-rejected CSV rows) with
+provenance instead of killing the run.
 """
 
 import csv
@@ -69,7 +75,11 @@ class _ChunkedLinePartition(
     snapshot is the byte offset of the first line NOT yet emitted
     (the trailing partial line carried across a chunk boundary is
     re-read on resume), interchangeable with the itemized reader's
-    ``tell()`` snapshots."""
+    ``tell()`` snapshots.
+
+    ``on_error="dlq"`` dead-letters undecodable lines (the engine
+    drains :meth:`drain_dead_letters` into the dead-letter queue)
+    instead of killing the run on one poison byte."""
 
     def __init__(
         self,
@@ -77,6 +87,7 @@ class _ChunkedLinePartition(
         chunk_bytes: int,
         resume_state: Optional[int],
         encoding: Optional[str] = "utf-8",
+        on_error: str = "raise",
     ):
         from bytewax_tpu.ops.text import LineBatcher
 
@@ -85,7 +96,7 @@ class _ChunkedLinePartition(
         if self._read:
             self._f.seek(self._read)
         self._chunk_bytes = chunk_bytes
-        self._lines = LineBatcher(encoding)
+        self._lines = LineBatcher(encoding, on_error=on_error)
         self._done = False
 
     def next_batch(self) -> Union[ColumnarBatch, List[str]]:
@@ -101,6 +112,10 @@ class _ChunkedLinePartition(
         self._read += len(raw)
         out = self._lines.feed(raw)
         return out if out is not None else []
+
+    def drain_dead_letters(self) -> List[dict]:
+        dead, self._lines.dead = self._lines.dead, []
+        return dead
 
     def snapshot(self) -> int:
         return self._read - self._lines.pending
@@ -138,6 +153,7 @@ class FileSource(FixedPartitionedSource[str, int]):
         columnar: bool = False,
         chunk_bytes: int = 1 << 20,
         encoding: Optional[str] = "utf-8",
+        on_error: str = "raise",
     ):
         """:arg path: Path to file.
         :arg batch_size: Lines per batch (default 1000; itemized mode).
@@ -151,13 +167,32 @@ class FileSource(FixedPartitionedSource[str, int]):
             offsets stay interchangeable with itemized mode.
         :arg chunk_bytes: Bytes per read in columnar mode.
         :arg encoding: Text encoding in columnar mode; ``None`` emits
-            raw byte lines."""
+            raw byte lines.
+        :arg on_error: ``"dlq"`` dead-letters undecodable lines (the
+            columnar decode path) into the engine's dead-letter queue
+            with provenance instead of killing the run
+            (docs/recovery.md "Connector-edge resilience").
+            Columnar-mode only — the itemized reader decodes through
+            Python's text layer, which cannot isolate a poison line,
+            so the combination is refused rather than silently
+            ignored."""
+        if on_error not in ("raise", "dlq"):
+            msg = f"on_error must be 'raise' or 'dlq'; got {on_error!r}"
+            raise ValueError(msg)
+        if on_error == "dlq" and not columnar:
+            msg = (
+                "on_error='dlq' requires columnar=True here (the "
+                "itemized line reader can't isolate a poison line); "
+                "use CSVSource for itemized dead-lettering"
+            )
+            raise ValueError(msg)
         path = Path(path)
         self._path = path
         self._batch_size = batch_size
         self._columnar = columnar
         self._chunk_bytes = chunk_bytes
         self._encoding = encoding
+        self._on_error = on_error
         self._fs_id = get_fs_id(path.parent) if path.parent.exists() else "0"
         if "::" in self._fs_id:
             msg = (
@@ -181,7 +216,11 @@ class FileSource(FixedPartitionedSource[str, int]):
             raise ValueError(msg)
         if self._columnar:
             return _ChunkedLinePartition(
-                self._path, self._chunk_bytes, resume_state, self._encoding
+                self._path,
+                self._chunk_bytes,
+                resume_state,
+                self._encoding,
+                on_error=self._on_error,
             )
         return _FileSourcePartition(self._path, self._batch_size, resume_state)
 
@@ -216,10 +255,23 @@ class DirSource(FixedPartitionedSource[str, int]):
         columnar: bool = False,
         chunk_bytes: int = 1 << 20,
         encoding: Optional[str] = "utf-8",
+        on_error: str = "raise",
     ):
         """``columnar=True`` reads each file in raw chunks and emits
         vectorized-split :class:`~bytewax_tpu.inputs.ColumnarBatch`
-        line batches (see :class:`FileSource`)."""
+        line batches; ``on_error="dlq"`` (columnar-mode only)
+        dead-letters undecodable lines instead of killing the run
+        (see :class:`FileSource`)."""
+        if on_error not in ("raise", "dlq"):
+            msg = f"on_error must be 'raise' or 'dlq'; got {on_error!r}"
+            raise ValueError(msg)
+        if on_error == "dlq" and not columnar:
+            msg = (
+                "on_error='dlq' requires columnar=True here (the "
+                "itemized line reader can't isolate a poison line); "
+                "use CSVSource for itemized dead-lettering"
+            )
+            raise ValueError(msg)
         dir_path = Path(dir_path)
         if not dir_path.exists():
             msg = f"no such input directory: {dir_path}"
@@ -233,6 +285,7 @@ class DirSource(FixedPartitionedSource[str, int]):
         self._columnar = columnar
         self._chunk_bytes = chunk_bytes
         self._encoding = encoding
+        self._on_error = on_error
         self._fs_id = get_fs_id(dir_path)
         if "::" in self._fs_id:
             msg = (
@@ -259,10 +312,55 @@ class DirSource(FixedPartitionedSource[str, int]):
                 self._chunk_bytes,
                 resume_state,
                 self._encoding,
+                on_error=self._on_error,
             )
         return _FileSourcePartition(
             self._dir_path / rel, self._batch_size, resume_state
         )
+
+
+class _LineTap:
+    """Pass-through line iterator remembering the last line handed
+    out — when ``csv`` raises mid-parse, the remembered line is the
+    poison payload for the dead-letter record."""
+
+    __slots__ = ("_lines", "last")
+
+    def __init__(self, lines):
+        self._lines = lines
+        self.last: Optional[str] = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self.last = next(self._lines)
+        return self.last
+
+
+def _read_rows_dlq(
+    reader, tap: _LineTap, dead: List[dict], limit: Optional[int] = None
+):
+    """Pull up to ``limit`` rows (None = all) off a csv reader,
+    dead-lettering parser-rejected rows — with the line the parse
+    died on, via ``tap`` — into ``dead`` instead of raising.
+    Returns ``(rows, captured_count)``."""
+    out: List[Dict[str, str]] = []
+    captured = 0
+    while limit is None or len(out) < limit:
+        try:
+            out.append(next(reader))
+        except StopIteration:
+            break
+        except csv.Error as ex:
+            captured += 1
+            dead.append(
+                {
+                    "error": f"{type(ex).__name__}: {ex}",
+                    "payload": tap.last,
+                }
+            )
+    return out, captured
 
 
 class _CSVPartition(StatefulSourcePartition[Dict[str, str], int]):
@@ -272,6 +370,7 @@ class _CSVPartition(StatefulSourcePartition[Dict[str, str], int]):
         batch_size: int,
         resume_state: Optional[int],
         fmtparams: Dict[str, Any],
+        on_error: str = "raise",
     ):
         self._f = open(path, "rt", newline="")
         # Feed csv via readline (not file iteration): iterating a
@@ -289,11 +388,33 @@ class _CSVPartition(StatefulSourcePartition[Dict[str, str], int]):
         self._fields = next(header_reader)
         if resume_state is not None:
             self._f.seek(resume_state)
-        reader = csv.DictReader(lines, fieldnames=self._fields, **fmtparams)
-        self._batcher = batch(reader, batch_size)
+        self._on_error = on_error
+        self._batch_size = batch_size
+        self._tap = _LineTap(lines)
+        self._reader = csv.DictReader(
+            self._tap, fieldnames=self._fields, **fmtparams
+        )
+        self._batcher = batch(self._reader, batch_size)
+        self._dead: List[dict] = []
 
     def next_batch(self) -> List[Dict[str, str]]:
-        return next(self._batcher)
+        if self._on_error != "dlq":
+            return next(self._batcher)
+        # Dead-letter mode: rows the parser rejects (embedded NULs,
+        # oversized fields) are captured with their raw line instead
+        # of killing the run; the file offset has moved past them, so
+        # the resume snapshot treats them as consumed — exactly the
+        # contract the engine's DLQ epoch pairing needs.
+        out, captured = _read_rows_dlq(
+            self._reader, self._tap, self._dead, self._batch_size
+        )
+        if not out and not captured:
+            raise StopIteration()
+        return out
+
+    def drain_dead_letters(self) -> List[dict]:
+        dead, self._dead = self._dead, []
+        return dead
 
     def snapshot(self) -> int:
         return self._f.tell()
@@ -315,7 +436,10 @@ class _ColumnarCSVPartition(StatefulSourcePartition[Any, int]):
         chunk_bytes: int,
         resume_state: Optional[int],
         fmtparams: Dict[str, Any],
+        on_error: str = "raise",
     ):
+        self._on_error = on_error
+        self._dead: List[dict] = []
         self._delim = fmtparams.get("delimiter", ",")
         self._quote = fmtparams.get("quotechar") or '"'
         # Quote PARITY (count of quotechars mod 2) is how the chunked
@@ -378,6 +502,7 @@ class _ColumnarCSVPartition(StatefulSourcePartition[Any, int]):
             path,
             chunk_bytes,
             resume_state if resume_state is not None else body_start,
+            on_error=on_error,
         )
 
     @staticmethod
@@ -456,12 +581,24 @@ class _ColumnarCSVPartition(StatefulSourcePartition[Any, int]):
                 more = nxt.cols["line"]
                 n_quotes += self._count_quotes(more, self._quote)
                 rows.extend(more.tolist())
+        tap = _LineTap(ln + "\n" for ln in rows)
         reader = csv.DictReader(
-            (ln + "\n" for ln in rows),
+            tap,
             fieldnames=self._fields,
             **self._fmtparams,
         )
-        return list(reader)
+        if self._on_error != "dlq":
+            return list(reader)
+        # Dead-letter mode: parser-rejected rows in a fallback batch
+        # are captured (with the line the parse died on) and the rest
+        # of the batch still flows.
+        out, _captured = _read_rows_dlq(reader, tap, self._dead)
+        return out
+
+    def drain_dead_letters(self) -> List[dict]:
+        dead = self._dead + self._inner.drain_dead_letters()
+        self._dead = []
+        return dead
 
     def snapshot(self) -> int:
         return self._inner.snapshot()
@@ -500,6 +637,7 @@ class CSVSource(FixedPartitionedSource[Dict[str, str], int]):
         get_fs_id: Callable[[Path], str] = _get_path_dev,
         columnar: bool = False,
         chunk_bytes: int = 1 << 20,
+        on_error: str = "raise",
         **fmtparams: Any,
     ):
         """``columnar=True`` reads raw chunks and emits
@@ -513,10 +651,20 @@ class CSVSource(FixedPartitionedSource[Dict[str, str], int]):
         fields may span lines and chunks.  Dialects whose quote parity
         doesn't delimit fields (``escapechar``, ``doublequote=False``)
         are refused in columnar mode (a quoted field spanning a chunk
-        boundary couldn't be stitched); use itemized mode for those."""
+        boundary couldn't be stitched); use itemized mode for those.
+
+        ``on_error="dlq"`` (both modes) dead-letters poison rows —
+        lines the CSV parser rejects (embedded NULs, oversized
+        fields) and, in columnar mode, undecodable lines — into the
+        engine's dead-letter queue with provenance instead of killing
+        the run (docs/recovery.md "Connector-edge resilience")."""
+        if on_error not in ("raise", "dlq"):
+            msg = f"on_error must be 'raise' or 'dlq'; got {on_error!r}"
+            raise ValueError(msg)
         self._file_source = FileSource(path, batch_size, get_fs_id)
         self._columnar = columnar
         self._chunk_bytes = chunk_bytes
+        self._on_error = on_error
         self._fmtparams = fmtparams
 
     def list_parts(self) -> List[str]:
@@ -535,12 +683,14 @@ class CSVSource(FixedPartitionedSource[Dict[str, str], int]):
                 self._chunk_bytes,
                 resume_state,
                 self._fmtparams,
+                on_error=self._on_error,
             )
         return _CSVPartition(
             self._file_source._path,
             self._file_source._batch_size,
             resume_state,
             self._fmtparams,
+            on_error=self._on_error,
         )
 
 

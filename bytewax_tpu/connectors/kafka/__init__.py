@@ -29,6 +29,7 @@ from typing import (
 import numpy as np
 from prometheus_client import Gauge
 
+from bytewax_tpu.errors import TransientSinkError, TransientSourceError
 from bytewax_tpu.inputs import (
     ColumnarBatch,
     FixedPartitionedSource,
@@ -47,6 +48,8 @@ __all__ = [
     "KafkaSinkMessage",
     "KafkaSource",
     "KafkaSourceMessage",
+    "TRANSIENT_KAFKA_CODES",
+    "is_transient_kafka_error",
 ]
 
 #: Start from the beginning of the topic (mirror of
@@ -54,6 +57,64 @@ __all__ = [
 OFFSET_BEGINNING = -2
 #: Start from the end of the topic.
 OFFSET_END = -1
+
+#: librdkafka error codes classified transient by default: transport
+#: hiccups, broker/coordinator timeouts and elections — the failures
+#: a healthy cluster recovers from in seconds.  A poll/produce error
+#: with one of these codes raises a typed
+#: :class:`~bytewax_tpu.errors.TransientSourceError` /
+#: :class:`~bytewax_tpu.errors.TransientSinkError` that the engine
+#: retries at the poll/write boundary (docs/recovery.md
+#: "Connector-edge resilience") instead of unwinding the execution.
+#: Negative codes are librdkafka-internal (``_TRANSPORT`` et al.);
+#: positive ones are broker protocol errors.
+TRANSIENT_KAFKA_CODES = frozenset(
+    {
+        -195,  # _TRANSPORT: broker transport failure
+        -187,  # _ALL_BROKERS_DOWN
+        -185,  # _TIMED_OUT: operation timed out
+        -192,  # _MSG_TIMED_OUT: local message timeout
+        -180,  # _WAIT_COORD: waiting for coordinator
+        -168,  # _RETRY: retry operation
+        5,  # LEADER_NOT_AVAILABLE
+        6,  # NOT_LEADER_FOR_PARTITION
+        7,  # REQUEST_TIMED_OUT
+        13,  # NETWORK_EXCEPTION
+        14,  # COORDINATOR_LOAD_IN_PROGRESS
+        15,  # COORDINATOR_NOT_AVAILABLE
+        16,  # NOT_COORDINATOR
+        19,  # NOT_ENOUGH_REPLICAS
+        20,  # NOT_ENOUGH_REPLICAS_AFTER_APPEND
+    }
+)
+
+
+def is_transient_kafka_error(error: Any) -> bool:
+    """Whether a ``confluent_kafka.KafkaError`` is worth retrying at
+    the connector edge.  Prefers librdkafka's own ``retriable()``
+    verdict when the client exposes it, falling back to the pinned
+    :data:`TRANSIENT_KAFKA_CODES`."""
+    if error is None:
+        return False
+    retriable = getattr(error, "retriable", None)
+    if callable(retriable):
+        try:
+            if retriable():
+                return True
+        except Exception:  # noqa: BLE001 - stub/partial mocks
+            pass
+    code = getattr(error, "code", None)
+    try:
+        return callable(code) and code() in TRANSIENT_KAFKA_CODES
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _kafka_error_of(ex: BaseException) -> Any:
+    """The ``KafkaError`` carried by a ``KafkaException`` (its first
+    arg, per the confluent_kafka convention), or None."""
+    args = getattr(ex, "args", ())
+    return args[0] if args else None
 
 _CONSUMER_LAG_GAUGE = Gauge(
     "bytewax_kafka_consumer_lag",
@@ -221,7 +282,7 @@ class _KafkaSourcePartition(
         starting_offset: int,
         resume_state: Optional[int],
         batch_size: int,
-        raise_on_errors: bool,
+        on_error: str,
         columnar: bool = False,
     ):
         ck = _require_confluent()
@@ -236,12 +297,29 @@ class _KafkaSourcePartition(
         self._part_idx = part_idx
         self._batch_size = batch_size
         self._eof = False
-        self._raise_on_errors = raise_on_errors
+        #: Error policy: ``raise`` (transient codes become typed
+        #: TransientSourceError the engine retries, the rest raise),
+        #: ``route`` (KafkaError items flow downstream), ``dlq``
+        #: (error frames become dead letters the engine drains).
+        self._on_error = on_error
         self._columnar = columnar
         self._partition_eof_code = ck.KafkaError._PARTITION_EOF
         self._lag_gauge = _CONSUMER_LAG_GAUGE.labels(
             step_id, topic, str(part_idx)
         )
+        #: Dead letters captured under ``on_error="dlq"``; drained by
+        #: the engine after every poll (``drain_dead_letters``).
+        self._dead: List[dict] = []
+        #: A transient error deferred to the NEXT poll so the rows
+        #: consumed before it in the same poll flow (and their
+        #: offsets snapshot) first — the same ordering trick as the
+        #: partition-EOF marker.
+        self._pending_error: Optional[BaseException] = None
+        #: Messages consumed in the same poll AFTER a deferred
+        #: transient error: the consumer's position already moved
+        #: past them, so they re-enter via the retry poll instead of
+        #: being lost.
+        self._pending_msgs: List[Any] = []
 
     def _process_stats(self, json_stats: str) -> None:
         stats = json.loads(json_stats)
@@ -302,47 +380,136 @@ class _KafkaSourcePartition(
         return ColumnarBatch(cols)
 
     def next_batch(self) -> Any:
+        if self._pending_error is not None:
+            # The rows polled alongside this error already flowed
+            # (and their offsets snapshot); now the engine's retry
+            # ladder sees the failure at a clean poll boundary.
+            ex, self._pending_error = self._pending_error, None
+            raise ex
         if self._eof:
             raise StopIteration()
-        msgs = self._consumer.consume(self._batch_size, 0.001)
+        if self._pending_msgs:
+            msgs, self._pending_msgs = self._pending_msgs, []
+        else:
+            try:
+                msgs = self._consumer.consume(self._batch_size, 0.001)
+            except Exception as ex:  # noqa: BLE001
+                if is_transient_kafka_error(_kafka_error_of(ex)):
+                    msg = (
+                        f"transient Kafka poll failure on "
+                        f"{self._topic}[{self._part_idx}]: {ex}"
+                    )
+                    raise TransientSourceError(msg) from ex
+                raise
         if self._columnar:
             out = self._columnar_batch(msgs)
             if out is not None:
                 return out
         batch: List[_RawSourceItem] = []
         last_offset = None
-        for msg in msgs:
+        for i, msg in enumerate(msgs):
             error = msg.error()
             if error is not None:
                 if error.code() == self._partition_eof_code:
                     # Emit this batch first; EOF on the next poll.
                     self._eof = True
                     break
-                if self._raise_on_errors:
+                if self._on_error != "route" and (
+                    is_transient_kafka_error(error)
+                ):
+                    # Transient codes take the retry ladder under BOTH
+                    # the raise and dlq policies: a down broker is a
+                    # condition to back off from (and eventually
+                    # quarantine/escalate), not a poison record — a
+                    # dlq'd transport failure would flood the DLQ with
+                    # unactionable rows while io_retries_count never
+                    # moved.  ("route" keeps its legacy contract:
+                    # every error frame flows as a KafkaError item.)
+                    err = (
+                        f"error consuming from Kafka topic "
+                        f"{self._topic!r}: {error}"
+                    )
+                    # With rows gathered before the error, the raise
+                    # defers to the NEXT poll so they flow (and their
+                    # offsets snapshot) first; an empty-handed poll
+                    # raises NOW — returning [] would read as a
+                    # healthy probe and reset the engine's
+                    # consecutive-failure ladder, so a persistently-
+                    # down broker could never reach quarantine or
+                    # escalation.  Messages the consumer already
+                    # handed over after the error re-enter via the
+                    # retry poll.
+                    tse = TransientSourceError(err)
+                    self._pending_msgs = list(msgs[i + 1 :])
+                    if batch:
+                        self._pending_error = tse
+                        break
+                    raise tse
+                if self._on_error == "dlq":
+                    # Dead-letter the (non-transient) error frame with
+                    # provenance and keep the partition flowing; the
+                    # engine drains these right after the poll, into
+                    # the epoch whose snapshots cover this poll's
+                    # offsets.
+                    self._dead.append(
+                        {
+                            "error": str(error),
+                            "code": error.code(),
+                            "topic": msg.topic() or self._topic,
+                            "partition": msg.partition(),
+                            "offset": msg.offset(),
+                            "payload": None,
+                        }
+                    )
+                elif self._on_error == "raise":
                     err = (
                         f"error consuming from Kafka topic "
                         f"{self._topic!r}: {error}"
                     )
                     raise RuntimeError(err)
-            kafka_msg = KafkaSourceMessage(
-                key=msg.key(),
-                value=msg.value(),
-                topic=msg.topic(),
-                headers=msg.headers() or [],
-                latency=msg.latency(),
-                offset=msg.offset(),
-                partition=msg.partition(),
-                timestamp=msg.timestamp(),
+                else:  # "route": KafkaError items flow downstream
+                    batch.append(
+                        KafkaError(
+                            error,
+                            KafkaSourceMessage(
+                                key=msg.key(),
+                                value=msg.value(),
+                                topic=msg.topic(),
+                                headers=msg.headers() or [],
+                                latency=msg.latency(),
+                                offset=msg.offset(),
+                                partition=msg.partition(),
+                                timestamp=msg.timestamp(),
+                            ),
+                        )
+                    )
+                off = msg.offset()
+                if off is not None and off >= 0:
+                    last_offset = off
+                continue
+            batch.append(
+                KafkaSourceMessage(
+                    key=msg.key(),
+                    value=msg.value(),
+                    topic=msg.topic(),
+                    headers=msg.headers() or [],
+                    latency=msg.latency(),
+                    offset=msg.offset(),
+                    partition=msg.partition(),
+                    timestamp=msg.timestamp(),
+                )
             )
-            if error is None:
-                batch.append(kafka_msg)
-            else:
-                batch.append(KafkaError(error, kafka_msg))
             last_offset = msg.offset()
         if last_offset is not None:
             # Resume from the message after the last one read.
             self._offset = last_offset + 1
         return batch
+
+    def drain_dead_letters(self) -> List[dict]:
+        """Poison records captured under ``on_error="dlq"`` since the
+        last drain (the engine calls this after every poll)."""
+        dead, self._dead = self._dead, []
+        return dead
 
     def snapshot(self) -> Optional[int]:
         return self._offset
@@ -372,6 +539,22 @@ class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
     offsets are identical in both modes.  The
     :mod:`~bytewax_tpu.connectors.kafka.operators` namespace
     deserializes per message and therefore uses itemized mode.
+
+    Connector-edge resilience (docs/recovery.md): transient
+    poll-error codes (:data:`TRANSIENT_KAFKA_CODES`, or librdkafka's
+    own ``retriable()`` verdict) raise a typed
+    :class:`~bytewax_tpu.errors.TransientSourceError` that the engine
+    retries at the poll boundary with backoff — and, under
+    ``BYTEWAX_TPU_QUARANTINE=1``, quarantines the one failing
+    partition after the retry budget while the others keep flowing.
+    ``on_error`` picks the non-transient error policy: ``"raise"``
+    (default), ``"route"`` (:class:`KafkaError` items flow
+    downstream, the legacy ``raise_on_errors=False`` — this mode
+    routes EVERY error frame, transient included, preserving the
+    legacy stream contract), or ``"dlq"`` (non-transient error
+    frames are captured into the engine's dead-letter queue with
+    topic/partition/offset provenance and the partition keeps
+    flowing; transient frames still take the retry ladder).
     """
 
     def __init__(
@@ -384,6 +567,7 @@ class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
         batch_size: int = 1000,
         raise_on_errors: bool = True,
         columnar: bool = False,
+        on_error: Optional[str] = None,
     ):
         if isinstance(brokers, str):
             msg = "pass brokers as a list of addresses, not a single string"
@@ -391,6 +575,12 @@ class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
         if isinstance(topics, str):
             msg = "pass topics as a list of names, not a single string"
             raise TypeError(msg)
+        if on_error not in (None, "raise", "route", "dlq"):
+            msg = (
+                f"on_error must be 'raise', 'route', or 'dlq'; "
+                f"got {on_error!r}"
+            )
+            raise ValueError(msg)
         _require_confluent()
         self._brokers = brokers
         self._topics = topics
@@ -398,7 +588,11 @@ class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
         self._starting_offset = starting_offset
         self._add_config = dict(add_config or {})
         self._batch_size = batch_size
-        self._raise_on_errors = raise_on_errors
+        # on_error supersedes the legacy raise_on_errors flag; absent,
+        # the flag maps onto the equivalent policy.
+        self._on_error = on_error or (
+            "raise" if raise_on_errors else "route"
+        )
         self._columnar = columnar
 
     def list_parts(self) -> List[str]:
@@ -444,7 +638,7 @@ class KafkaSource(FixedPartitionedSource[_RawSourceItem, Optional[int]]):
             self._starting_offset,
             resume_state,
             self._batch_size,
-            self._raise_on_errors,
+            self._on_error,
             self._columnar,
         )
 
@@ -464,12 +658,37 @@ class _KafkaSinkPartition(
             if topic is None:
                 msg = f"no topic to produce to for {item}"
                 raise RuntimeError(msg)
-            self._producer.produce(
-                topic,
-                item.value,
-                item.key,
-                headers=item.headers,
-            )
+            try:
+                self._producer.produce(
+                    topic,
+                    item.value,
+                    item.key,
+                    headers=item.headers,
+                )
+            except BufferError:
+                # librdkafka's local produce queue is full: drain
+                # deliveries once, then retry this item; a second
+                # refusal is a transient sink fault the engine
+                # retries at the write boundary with backoff.
+                self._producer.poll(0.1)
+                try:
+                    self._producer.produce(
+                        topic,
+                        item.value,
+                        item.key,
+                        headers=item.headers,
+                    )
+                except BufferError as ex:
+                    msg = (
+                        "Kafka produce queue stayed full after a "
+                        "delivery drain (broker slow or down)"
+                    )
+                    raise TransientSinkError(msg) from ex
+            except Exception as ex:  # noqa: BLE001
+                if is_transient_kafka_error(_kafka_error_of(ex)):
+                    msg = f"transient Kafka produce failure: {ex}"
+                    raise TransientSinkError(msg) from ex
+                raise
             self._producer.poll(0)
         self._producer.flush()
 
@@ -482,7 +701,16 @@ class KafkaSink(
 ):
     """Use a single Kafka topic as an output sink; workers are the
     unit of parallelism.  At-least-once: messages from the resume
-    epoch are duplicated right after resume."""
+    epoch are duplicated right after resume.
+
+    Transient produce failures (a full local queue that a delivery
+    drain doesn't clear, or a retriable broker code —
+    :func:`is_transient_kafka_error`) raise
+    :class:`~bytewax_tpu.errors.TransientSinkError`, which the engine
+    retries at the write boundary before the epoch commit
+    (docs/recovery.md "Connector-edge resilience"); a retried batch
+    may re-produce its head, consistent with the sink's
+    at-least-once contract."""
 
     def __init__(
         self,

@@ -22,6 +22,7 @@ from typing import Any, List, Optional, Tuple
 
 from bytewax_tpu.engine import faults as _faults
 from bytewax_tpu.engine import flight as _flight
+from bytewax_tpu.engine.backoff import backoff_delay, seeded_rng
 from bytewax_tpu.errors import ClusterPeerDead
 
 __all__ = ["Comm"]
@@ -143,8 +144,14 @@ class Comm:
             os.environ.get("BYTEWAX_TPU_DIAL_TIMEOUT_S", _DIAL_TIMEOUT_S)
         )
         deadline = time.monotonic() + dial_timeout
+        # The shared backoff helper (engine/backoff.py) paces redials:
+        # jittered per proc so a whole restarted cluster doesn't
+        # re-dial in lockstep, capped low (the handshake budget is
+        # seconds, not minutes) and reset per peer.
+        dial_rng = seeded_rng("dial", proc_id)
         for peer in range(proc_id + 1, self.proc_count):
             phost, _, pport = addresses[peer].rpartition(":")
+            attempt = 0
             while True:
                 # A fresh socket per attempt: a socket whose connect()
                 # failed (peer not listening yet) is left in an error
@@ -160,7 +167,12 @@ class Comm:
                     if time.monotonic() > deadline:
                         msg = f"could not dial cluster peer {addresses[peer]!r}"
                         raise ConnectionError(msg) from None
-                    time.sleep(0.05)
+                    attempt += 1
+                    time.sleep(
+                        backoff_delay(
+                            0.05, attempt, rng=dial_rng, cap=0.5
+                        )
+                    )
             # Introduce (proc id, restart generation); the acceptor
             # answers with its own generation, pinning what each side
             # expects on every subsequent frame.

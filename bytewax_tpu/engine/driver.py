@@ -28,11 +28,21 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from bytewax_tpu.dataflow import Dataflow, Operator
+from bytewax_tpu.engine import backoff as _backoff
 from bytewax_tpu.engine import batching as _batching
 from bytewax_tpu.engine import faults as _faults
 from bytewax_tpu.engine import flight as _flight
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
-from bytewax_tpu.errors import DeviceFault, EpochStalled, note_context
+from bytewax_tpu.engine.dlq import DeadLetterQueue
+from bytewax_tpu.errors import (
+    DeviceFault,
+    EpochStalled,
+    TransientIOError,
+    TransientSinkError,
+    TransientSourceError,
+    is_transient_io_error,
+    note_context,
+)
 from bytewax_tpu.engine.flatten import Plan, flatten
 from bytewax_tpu.engine.recovery_store import RecoveryStore, ResumeFrom
 from bytewax_tpu.engine.residency import ResidentKeyState, maybe_wrap
@@ -174,13 +184,17 @@ class _Abort(Exception):
 #: Faults the supervisor may heal by restarting the worker from the
 #: last committed epoch: peer death / torn mesh (ClusterPeerDead is a
 #: ConnectionError), a wedged epoch protocol, injected chaos faults,
-#: and device faults that escaped demotion (the collective global-
-#: exchange tier cannot demote per-process).
+#: device faults that escaped demotion (the collective global-
+#: exchange tier cannot demote per-process), and connector-edge
+#: transient I/O faults that exhausted the in-place retry budget
+#: (docs/recovery.md "Connector-edge resilience" — whole-cluster
+#: restart is the escalation path, not the first response).
 _RESTARTABLE = (
     ConnectionError,
     EpochStalled,
     _faults.InjectedFault,
     DeviceFault,
+    TransientIOError,
 )
 
 
@@ -347,16 +361,17 @@ def derive_rescale_hint(
 def _backoff_delay(
     base: float, attempt: int, rng: random.Random
 ) -> float:
-    """Capped exponential restart backoff with per-process jitter.
+    """Capped exponential restart backoff with per-process jitter —
+    the supervisor's view of the shared helper
+    (:mod:`bytewax_tpu.engine.backoff`, also used by the comm dial
+    loop and the connector-edge I/O retry).
 
     The jitter factor is drawn uniformly from [0.5, 1.5) off a
     per-``proc_id``-seeded stream: without it, every process of a
     crashed cluster sleeps the *identical* deterministic delay and
     redials simultaneously — a thundering-herd handshake (and one
     dial-timeout round) on every generation bump."""
-    return min(base * (2 ** (attempt - 1)), 30.0) * (
-        0.5 + rng.random()
-    )
+    return _backoff.backoff_delay(base, attempt, rng=rng)
 
 
 def _supervised(
@@ -393,7 +408,7 @@ def _supervised(
     reset_s = float(
         os.environ.get("BYTEWAX_TPU_RESTART_RESET_S", "300") or 300
     )
-    rng = random.Random(f"bytewax-restart:{proc_id}")
+    rng = _backoff.seeded_rng("restart", proc_id)
     attempt = 0
     generation = 0
     while True:
@@ -622,6 +637,16 @@ class _InputRt(_OpRt):
         #: accumulated before it must flow (and be processed) first,
         #: exactly as they would have without coalescing.
         self._deferred: Dict[str, BaseException] = {}
+        # -- connector-edge resilience (docs/recovery.md) -----------------
+        #: Consecutive transient poll failures per partition (the I/O
+        #: retry ladder; reset by any successful poll).
+        self._io_fails: Dict[str, int] = {}
+        self._last_io_error: Dict[str, str] = {}
+        #: Partitions parked by quarantine: retry budget spent,
+        #: snapshot frozen at the last good offset, re-probed on a
+        #: capped backoff schedule while everything else keeps
+        #: flowing.  name -> {since, fails, last_error}.
+        self._quarantined: Dict[str, Dict[str, Any]] = {}
         if isinstance(source, FixedPartitionedSource):
             # All processes see the same sorted name set, so the
             # partition→worker assignment is globally consistent;
@@ -666,6 +691,140 @@ class _InputRt(_OpRt):
     def process(self, port: str, entries: List[Entry]) -> None:
         raise AssertionError("input ops have no upstreams")
 
+    def _absorb_poll_fault(
+        self, name: str, ex: BaseException, now: datetime
+    ) -> None:
+        """One transient ``next_batch`` failure on partition ``name``
+        (typed :class:`TransientSourceError` or the default
+        ``OSError``/timeout classification — see
+        :func:`bytewax_tpu.errors.is_transient_io_error`).
+
+        Inside the retry budget, schedules the re-poll via
+        ``next_awake`` after a capped jittered exponential backoff —
+        non-blocking, so every other partition and the rest of the
+        dataflow keep flowing.  Past the budget, either parks the
+        partition in quarantine (``BYTEWAX_TPU_QUARANTINE=1``:
+        snapshot frozen at the last good offset, re-probed on the
+        backoff schedule capped at
+        ``BYTEWAX_TPU_QUARANTINE_REPROBE_S``) or escalates a
+        restartable :class:`TransientSourceError` into the
+        supervisor path.
+        """
+        driver = self.driver
+        step_id = self.op.step_id
+        fails = self._io_fails.get(name, 0) + 1
+        self._io_fails[name] = fails
+        err = f"{type(ex).__name__}: {ex}"
+        self._last_io_error[name] = err
+        quarantined = name in self._quarantined
+        if fails <= driver.io_retries or quarantined:
+            cap = (
+                driver.quarantine_cap_s
+                if quarantined
+                else driver.io_backoff_cap_s
+            )
+            delay = _backoff.backoff_delay(
+                driver.io_backoff_s,
+                fails,
+                rng=driver._io_rng,
+                cap=cap,
+            )
+            if quarantined:
+                self._quarantined[name].update(
+                    fails=fails, last_error=err
+                )
+            _flight.note_io_retry(
+                step_id,
+                "source",
+                fails,
+                delay,
+                type(ex).__name__,
+                part=name,
+            )
+            self.next_awake[name] = now + timedelta(seconds=delay)
+            return
+        if driver.quarantine:
+            delay = _backoff.backoff_delay(
+                driver.io_backoff_s,
+                fails,
+                rng=driver._io_rng,
+                cap=driver.quarantine_cap_s,
+            )
+            self._quarantined[name] = {
+                "since": time.monotonic(),
+                "fails": fails,
+                "last_error": err,
+            }
+            _flight.note_quarantine(
+                step_id, name, len(self._quarantined), fails, err
+            )
+            self.next_awake[name] = now + timedelta(seconds=delay)
+            return
+        esc = TransientSourceError(
+            f"source partition {name!r} of step {step_id!r} failed "
+            f"{fails} consecutive polls (BYTEWAX_TPU_IO_RETRIES="
+            f"{driver.io_retries} exhausted); last error: {err}"
+        )
+        esc.__cause__ = ex
+        _reraise(step_id, "`next_batch`", esc)
+
+    def _io_heal(self, name: str) -> None:
+        """Any successful poll (even an empty batch) resets the
+        partition's retry ladder and lifts its quarantine."""
+        if name in self._io_fails:
+            del self._io_fails[name]
+            self._last_io_error.pop(name, None)
+        info = self._quarantined.pop(name, None)
+        if info is not None:
+            _flight.note_unquarantine(
+                self.op.step_id,
+                name,
+                len(self._quarantined),
+                time.monotonic() - info["since"],
+            )
+
+    def _drain_dead(self, name: str, part: Any) -> int:
+        """Forward connector-captured poison records (partitions with
+        a ``drain_dead_letters()`` hook — the ``on_error="dlq"``
+        policy) to the driver's dead-letter queue, stamped with the
+        CURRENT epoch: the same epoch whose source snapshots cover
+        the offsets consumed alongside them, so the DLQ flush/resume
+        truncation pairing keeps dead letters exactly-once."""
+        drain = getattr(part, "drain_dead_letters", None)
+        if drain is None:
+            return 0
+        dead = drain()
+        if dead:
+            self.driver.dlq.capture(
+                self.op.step_id, name, dead, self.driver.epoch
+            )
+        return len(dead)
+
+    def source_health(self) -> Dict[str, Any]:
+        """Per-partition connector health (the ``/status``
+        ``source_health`` section)."""
+        out: Dict[str, Any] = {}
+        for name in self.parts:
+            info = self._quarantined.get(name)
+            if info is not None:
+                out[name] = {
+                    "state": "quarantined",
+                    "consecutive_failures": info["fails"],
+                    "last_error": info["last_error"],
+                    "parked_s": round(
+                        time.monotonic() - info["since"], 3
+                    ),
+                }
+            elif self._io_fails.get(name):
+                out[name] = {
+                    "state": "retrying",
+                    "consecutive_failures": self._io_fails[name],
+                    "last_error": self._last_io_error.get(name),
+                }
+            else:
+                out[name] = {"state": "ok"}
+        return out
+
     def _coalesce(self, name: str, part: Any, first: Any, now: datetime):
         """Keep polling one ready partition until the accumulated
         delivery reaches the coalescing target (or the source goes
@@ -686,6 +845,14 @@ class _InputRt(_OpRt):
                 break
             polls += 1
             try:
+                # Every next_batch call is behind the pinned site —
+                # coalescing polls included, so chaos soaks cover the
+                # deferred-transient path too.  An injected error
+                # here defers like any coalescing-poll failure: the
+                # rows already gathered flow first.
+                _faults.fire(
+                    "source_poll", step=self.op.step_id, part=name
+                )
                 with timer.time():
                     nxt = part.next_batch()
                 if not isinstance(nxt, (list, ArrayBatch)):
@@ -722,6 +889,12 @@ class _InputRt(_OpRt):
                 deferred = self._deferred.pop(name, None)
                 if deferred is not None:
                     if isinstance(deferred, StopIteration):
+                        self._drain_dead(name, part)
+                        # An EOFing partition leaves the health map:
+                        # clear any retry/quarantine state so the
+                        # gauge doesn't report a phantom parked
+                        # partition forever.
+                        self._io_heal(name)
                         if self.stateful:
                             self.pending_snaps.append(
                                 (name, part.snapshot())
@@ -732,8 +905,21 @@ class _InputRt(_OpRt):
                         continue
                     if isinstance(deferred, AbortExecution):
                         raise _Abort() from None
+                    if is_transient_io_error(deferred):
+                        # A coalescing poll failed transiently after
+                        # its pass's rows flowed: same retry ladder
+                        # as a boundary-poll failure.
+                        self._absorb_poll_fault(name, deferred, now)
+                        continue
                     _reraise(self.op.step_id, "`next_batch`", deferred)
                 try:
+                    # The pinned connector-edge fault site: fired
+                    # before the poll touches the source, so an
+                    # injected transient error consumed nothing and
+                    # the retry is exact (docs/recovery.md).
+                    _faults.fire(
+                        "source_poll", step=self.op.step_id, part=name
+                    )
                     with self._timer(
                         "inp_part_next_batch", self.part_worker.get(name)
                     ).time():
@@ -741,6 +927,10 @@ class _InputRt(_OpRt):
                     if not isinstance(batch, (list, ArrayBatch)):
                         batch = list(batch)
                 except StopIteration:
+                    self._drain_dead(name, part)
+                    # Clear retry/quarantine state on the way out
+                    # (see the deferred-EOF branch above).
+                    self._io_heal(name)
                     if self.stateful:
                         self.pending_snaps.append((name, part.snapshot()))
                     part.close()
@@ -750,7 +940,11 @@ class _InputRt(_OpRt):
                 except AbortExecution:
                     raise _Abort() from None
                 except BaseException as ex:  # noqa: BLE001
+                    if is_transient_io_error(ex):
+                        self._absorb_poll_fault(name, ex, now)
+                        continue
                     _reraise(self.op.step_id, "`next_batch`", ex)
+                self._io_heal(name)
                 emitted = len(batch) > 0
                 if emitted:
                     if self.coalesce_rows > 1 and len(batch) < (
@@ -774,6 +968,11 @@ class _InputRt(_OpRt):
                         _flight.note_source_lag(
                             self.op.step_id, "event_time", lag
                         )
+                if self._drain_dead(name, part):
+                    # Poison records consumed offsets this pass; make
+                    # sure an epoch closes over them promptly so the
+                    # DLQ flush pairs with the covering snapshot.
+                    progressed = True
                 if name in self._deferred:
                     # Deliver the deferred raise promptly.
                     part_na: Optional[datetime] = None
@@ -2068,6 +2267,67 @@ class _OutputRt(_OpRt):
             )
             raise TypeError(msg)
 
+    def _write_retry(
+        self,
+        name: str,
+        worker: Optional[int],
+        write: Callable[[], None],
+    ) -> None:
+        """Run one sink ``write_batch`` through the connector-edge
+        retry ladder (docs/recovery.md): typed
+        :class:`TransientIOError` failures are retried in place with
+        capped jittered exponential backoff — strictly before this
+        epoch's snapshot commit, so exactly-once output is untouched.
+        ONLY the typed family retries here (unlike the source side's
+        broad ``OSError`` classification): a retried ``write_batch``
+        sees the same values again, and only a sink that raises the
+        typed error has opted into the nothing-durably-written /
+        deduplicating contract that makes the re-send safe — a plain
+        mid-batch ``OSError`` may have landed half the rows, so it
+        keeps unwinding to the supervisor and the truncating-sink
+        replay.  Exhaustion escalates a restartable
+        :class:`TransientSinkError` to the supervisor path; the
+        pinned ``sink_write`` fault site fires before every attempt.
+        """
+        driver = self.driver
+        step_id = self.op.step_id
+        ladder = _backoff.Backoff(
+            driver.io_backoff_s,
+            cap=driver.io_backoff_cap_s,
+            rng=driver._io_rng,
+        )
+        while True:
+            try:
+                _faults.fire("sink_write", step=step_id, part=name)
+                with self._timer(
+                    "out_part_write_batch", worker
+                ).time():
+                    write()
+                return
+            except BaseException as ex:  # noqa: BLE001
+                if not isinstance(ex, TransientIOError):
+                    _reraise(step_id, "`write_batch`", ex)
+                delay = ladder.next_delay()
+                if ladder.failures > driver.io_retries:
+                    esc = TransientSinkError(
+                        f"sink partition {name!r} of step "
+                        f"{step_id!r} failed {ladder.failures} "
+                        "consecutive writes (BYTEWAX_TPU_IO_RETRIES="
+                        f"{driver.io_retries} exhausted); last "
+                        f"error: {type(ex).__name__}: {ex}"
+                    )
+                    esc.__cause__ = ex
+                    _reraise(step_id, "`write_batch`", esc)
+                _flight.note_io_retry(
+                    step_id,
+                    "sink",
+                    ladder.failures,
+                    delay,
+                    type(ex).__name__,
+                    part=name,
+                )
+                time.sleep(delay)
+
     def process(self, port: str, entries: List[Entry]) -> None:
         if self.stateful:
             driver = self.driver
@@ -2114,28 +2374,30 @@ class _OutputRt(_OpRt):
                 for owner, group in ship.items():
                     driver.ship_deliver(self.idx, "up", (owner, group))
                 for name, values in buckets.items():
-                    try:
-                        with self._timer(
-                            "out_part_write_batch", self.part_owner[name]
-                        ).time():
-                            self.parts[name].write_batch(values)
-                    except BaseException as ex:  # noqa: BLE001
-                        _reraise(self.op.step_id, "`write_batch`", ex)
+                    self._write_retry(
+                        name,
+                        self.part_owner[name],
+                        lambda part=self.parts[name], values=values: (
+                            part.write_batch(values)
+                        ),
+                    )
         else:
             for w, items in entries:
                 part = self.parts[f"worker-{w}"]
-                try:
-                    with self._timer("out_part_write_batch", w).time():
-                        if isinstance(items, ArrayBatch):
-                            writer = getattr(part, "write_array_batch", None)
-                            if writer is not None:
-                                writer(items)
-                            else:
-                                part.write_batch(items.to_pylist())
+
+                def _write(part=part, items=items) -> None:
+                    if isinstance(items, ArrayBatch):
+                        writer = getattr(
+                            part, "write_array_batch", None
+                        )
+                        if writer is not None:
+                            writer(items)
                         else:
-                            part.write_batch(items)
-                except BaseException as ex:  # noqa: BLE001
-                    _reraise(self.op.step_id, "`write_batch`", ex)
+                            part.write_batch(items.to_pylist())
+                    else:
+                        part.write_batch(items)
+
+                self._write_retry(f"worker-{w}", w, _write)
 
     def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
         if not self.stateful:
@@ -2388,6 +2650,50 @@ class _Driver:
             os.environ.get("BYTEWAX_TPU_EPOCH_STALL_S", "0") or 0.0
         )
 
+        # -- connector-edge resilience (docs/recovery.md) -----------------
+        #: In-place retries per source-partition poll / sink write
+        #: before a transient I/O fault escalates to the restartable-
+        #: fault/supervisor path.
+        self.io_retries = max(
+            0, int(os.environ.get("BYTEWAX_TPU_IO_RETRIES", "3") or 3)
+        )
+        #: Base of the capped jittered exponential I/O retry backoff.
+        self.io_backoff_s = float(
+            os.environ.get("BYTEWAX_TPU_IO_BACKOFF_S", "0.05") or 0.05
+        )
+        #: Per-attempt retry delay ceiling (source retries schedule
+        #: the next poll; sink retries sleep in place, so the cap
+        #: also bounds the longest single stall before escalation).
+        self.io_backoff_cap_s = float(
+            os.environ.get("BYTEWAX_TPU_IO_BACKOFF_CAP_S", "5") or 5
+        )
+        #: Opt-in per-partition quarantine: after retry exhaustion on
+        #: one source partition, park it (snapshot frozen at the last
+        #: good offset) and re-probe on a capped backoff schedule
+        #: while the rest of the dataflow keeps flowing.
+        self.quarantine = os.environ.get(
+            "BYTEWAX_TPU_QUARANTINE", "0"
+        ) not in ("", "0")
+        #: Re-probe delay ceiling for quarantined partitions (the
+        #: retry ladder keeps climbing into quarantine, capped here).
+        self.quarantine_cap_s = float(
+            os.environ.get("BYTEWAX_TPU_QUARANTINE_REPROBE_S", "30")
+            or 30
+        )
+        #: One jitter stream for every connector-edge retry in this
+        #: process (deterministic per proc, desynchronized across the
+        #: cluster — same contract as the restart supervisor's).
+        self._io_rng = _backoff.seeded_rng("io", proc_id)
+        #: Dead-letter queue (engine/dlq.py): poison records from
+        #: connectors with ``on_error="dlq"``, epoch-buffered and
+        #: flushed at epoch close before the snapshot commit.  The
+        #: resume truncation mirrors the truncating-sink contract so
+        #: replayed epochs recapture instead of duplicating.
+        self.dlq = DeadLetterQueue(proc_id)
+        self.dlq.truncate_for_resume(
+            resume.resume_epoch, proc_count=self.proc_count
+        )
+
         self.rts: List[_OpRt] = []
         #: /healthz readiness: True once run startup (mesh handshake,
         #: agreement round, rescale migration, runtime builds) is done.
@@ -2515,6 +2821,13 @@ class _Driver:
         with self._ledger_phase("collective"):
             for rt in self.rts:
                 rt.pre_close()
+        # Dead-letter flush BEFORE the snapshot commit: the appended
+        # rows carry this epoch's stamp, and the resume truncation
+        # drops rows of any epoch that did not commit — so a crash in
+        # the commit window replays the epoch and recaptures them,
+        # never duplicating (docs/recovery.md "Connector-edge
+        # resilience").
+        self.dlq.flush()
         if self.store is not None:
             snaps: List[Tuple[str, str, Optional[bytes]]] = []
             with self._ledger_phase("snapshot"):
@@ -2908,6 +3221,16 @@ class _Driver:
             },
             "worker_count": self.worker_count,
             "workers": [self.local_lo, self.local_hi],
+            "source_health": {
+                rt.op.step_id: rt.source_health()
+                for rt in rts
+                if isinstance(rt, _InputRt)
+            },
+            "dlq": {
+                "dir": self.dlq.dir,
+                "captured": self.dlq.total,
+                "pending_flush": self.dlq.pending_count(),
+            },
             "rescale_hint": self._rescale_hint(),
             "epoch": self.epoch,
             "eof": bool(rts) and all(rt.eof for rt in rts),

@@ -11,14 +11,18 @@ Plan syntax — comma-separated specs::
     BYTEWAX_TPU_FAULTS="site:kind:epoch[:proc][:xN]"
 
 - ``site``: one of :data:`SITES` (``comm.send``, ``comm.recv``,
-  ``device_dispatch``, ``residency_restore``, ``snapshot.write``,
-  ``snapshot.commit``, ``rescale_migrate``, ``barrier``).
+  ``device_dispatch``, ``residency_restore``, ``source_poll``,
+  ``sink_write``, ``snapshot.write``, ``snapshot.commit``,
+  ``rescale_migrate``, ``barrier``).
 - ``kind``: ``delay`` (sleep ``BYTEWAX_TPU_FAULT_DELAY_S``, default
   0.05s), ``drop`` (suppress the frame — only meaningful at
   ``comm.send``; breaks the barrier's in-flight accounting on purpose,
   so the stall watchdog must heal it), ``error`` (raise
   :class:`bytewax_tpu.errors.DeviceFault` at ``device_dispatch`` and
   ``residency_restore`` — the retryable device-path sites —
+  :class:`~bytewax_tpu.errors.TransientSourceError` /
+  :class:`~bytewax_tpu.errors.TransientSinkError` at ``source_poll``
+  / ``sink_write`` — the connector-edge retry sites —
   :class:`InjectedFault` elsewhere), ``crash`` (raise
   :class:`InjectedCrash` — simulated sudden process death: the driver
   unwinds *without* an abort broadcast, so peers discover it exactly
@@ -35,7 +39,13 @@ Random soak mode::
     BYTEWAX_TPU_FAULTS_SEED=7        # deterministic per (seed, proc)
     BYTEWAX_TPU_FAULTS_RATE=0.01     # Bernoulli per fire() check
     BYTEWAX_TPU_FAULTS_KINDS=delay,crash  # optional kind pool
+    BYTEWAX_TPU_FAULTS_SITES=source_poll,sink_write  # optional site pool
     BYTEWAX_TPU_FAULTS_MIN_GAP_S=2   # wall-clock floor between fires
+
+``BYTEWAX_TPU_FAULTS_SITES`` restricts the random soak to a subset of
+:data:`SITES` (default: all of them) — e.g. a connector-edge soak
+fires only ``source_poll``/``sink_write`` so every drawn fault lands
+in the I/O retry ladder instead of the supervisor.
 
 The min-gap (default 1s) keeps chaos frequency a *wall-clock* rate:
 site check frequency varies by orders of magnitude with the epoch
@@ -69,11 +79,18 @@ __all__ = [
 #: ``rescale_migrate`` fires inside the rescale-on-resume store
 #: transaction, before any row moves, so a mid-migration fault rolls
 #: back whole and retries cleanly under the supervisor.
+#: ``source_poll``/``sink_write`` are the connector-edge sites
+#: (docs/recovery.md "Connector-edge resilience"): fired immediately
+#: before a source partition's ``next_batch`` / a sink partition's
+#: ``write_batch``, before any offset advances or byte lands, so an
+#: injected transient error is retry-safe by construction.
 SITES = (
     "comm.send",
     "comm.recv",
     "device_dispatch",
     "residency_restore",
+    "source_poll",
+    "sink_write",
     "snapshot.write",
     "snapshot.commit",
     "rescale_migrate",
@@ -85,6 +102,13 @@ SITES = (
 #: any device state mutates — the driver retries the delivery, then
 #: demotes) instead of a plain :class:`InjectedFault`.
 _DEVICE_SITES = ("device_dispatch", "residency_restore")
+
+#: Connector-edge sites: ``kind=error`` raises the matching typed
+#: transient error (retried by the driver's I/O retry ladder —
+#: exhaustion escalates to the supervisor) instead of a plain
+#: :class:`InjectedFault`; ``kind=crash`` stays an abrupt
+#: :class:`InjectedCrash` like everywhere else.
+_IO_SITES = ("source_poll", "sink_write")
 
 _KINDS = ("delay", "drop", "error", "crash")
 
@@ -169,6 +193,7 @@ class _Plan:
         self.rng: Optional[random.Random] = None
         self.rate = 0.0
         self.random_kinds = _RANDOM_DEFAULT_KINDS
+        self.random_sites: Optional[frozenset] = None
         self.min_gap_s = 0.0
         self.last_fire = 0.0
         if env.strip() == "random":
@@ -184,6 +209,19 @@ class _Plan:
                 self.random_kinds = tuple(
                     k.strip() for k in kinds.split(",") if k.strip()
                 )
+            sites = os.environ.get("BYTEWAX_TPU_FAULTS_SITES")
+            if sites:
+                picked = frozenset(
+                    s.strip() for s in sites.split(",") if s.strip()
+                )
+                unknown = picked - set(SITES)
+                if unknown:
+                    msg = (
+                        f"unknown fault site(s) {sorted(unknown)} in "
+                        f"BYTEWAX_TPU_FAULTS_SITES; known: {SITES}"
+                    )
+                    raise ValueError(msg)
+                self.random_sites = picked
             # Per-process stream so every process draws its own
             # deterministic fault schedule.  (A str seed: tuple seeds
             # raise TypeError on Python 3.11+.)
@@ -196,6 +234,11 @@ class _Plan:
     def pick(self, site: str, epoch: int) -> Optional[str]:
         """The kind to inject at this site right now, or None."""
         if self.rng is not None:
+            if (
+                self.random_sites is not None
+                and site not in self.random_sites
+            ):
+                return None
             now = time.monotonic()
             if now - self.last_fire < self.min_gap_s:
                 return None
@@ -227,6 +270,7 @@ def _fingerprint() -> str:
             "BYTEWAX_TPU_FAULTS_SEED",
             "BYTEWAX_TPU_FAULTS_RATE",
             "BYTEWAX_TPU_FAULTS_KINDS",
+            "BYTEWAX_TPU_FAULTS_SITES",
             "BYTEWAX_TPU_FAULTS_MIN_GAP_S",
         )
     )
@@ -299,5 +343,21 @@ def fire(site: str, **ctx: Any) -> Optional[str]:
         raise DeviceFault(
             f"injected device fault at {site!r}, epoch {_epoch} "
             f"(step {ctx.get('step')!r})"
+        )
+    if site in _IO_SITES:
+        from bytewax_tpu.errors import (
+            TransientSinkError,
+            TransientSourceError,
+        )
+
+        cls = (
+            TransientSourceError
+            if site == "source_poll"
+            else TransientSinkError
+        )
+        raise cls(
+            f"injected transient I/O fault at {site!r}, epoch "
+            f"{_epoch} (step {ctx.get('step')!r}, part "
+            f"{ctx.get('part')!r})"
         )
     raise InjectedFault(site, kind, _epoch)

@@ -61,14 +61,17 @@ __all__ = [
     "note_barrier",
     "note_comm",
     "note_demotion",
+    "note_dlq",
     "note_eviction",
     "note_fault",
     "note_fenced",
     "note_flush_depth",
     "note_gsync",
+    "note_io_retry",
     "note_phase",
     "note_pipeline_depth",
     "note_pipeline_stall",
+    "note_quarantine",
     "note_rescale",
     "note_resident",
     "note_residency_restore",
@@ -76,6 +79,7 @@ __all__ = [
     "note_source_lag",
     "note_spill",
     "note_transfer",
+    "note_unquarantine",
     "write_postmortem",
 ]
 
@@ -633,6 +637,102 @@ def note_spill(step_id: str, nbytes: int) -> None:
 
     state_spill_bytes.labels(step_id).inc(nbytes)
     RECORDER.count("state_spill_bytes", nbytes)
+
+
+_io_retry_children: Dict[Tuple[str, str], Any] = {}
+_quarantine_children: Dict[str, Any] = {}
+
+
+def note_io_retry(
+    step_id: str,
+    kind: str,
+    attempt: int,
+    delay_s: float,
+    error: str,
+    part: str = "",
+) -> None:
+    """One transient connector-edge I/O failure retried in place
+    (``kind`` ``source`` = next_batch re-poll after backoff, ``sink``
+    = write_batch re-invoked before the epoch commit)."""
+    key = (step_id, kind)
+    child = _io_retry_children.get(key)
+    if child is None:
+        from bytewax_tpu._metrics import io_retries_count
+
+        with _lock:
+            child = _io_retry_children.setdefault(
+                key, io_retries_count.labels(step_id, kind)
+            )
+    child.inc()
+    RECORDER.count("io_retries_count")
+    RECORDER.record(
+        "io_retry",
+        step=step_id,
+        io=kind,
+        part=part,
+        attempt=attempt,
+        delay_s=round(delay_s, 4),
+        error=error,
+    )
+
+
+def _quarantine_gauge(step_id: str) -> Any:
+    child = _quarantine_children.get(step_id)
+    if child is None:
+        from bytewax_tpu._metrics import quarantined_partitions
+
+        with _lock:
+            child = _quarantine_children.setdefault(
+                step_id, quarantined_partitions.labels(step_id)
+            )
+    return child
+
+
+def note_quarantine(
+    step_id: str, part: str, n_quarantined: int, fails: int, error: str
+) -> None:
+    """A source partition entered quarantine: retry budget exhausted,
+    parked at its last good offset; ``n_quarantined`` is the step's
+    resulting quarantined-partition count."""
+    _quarantine_gauge(step_id).set(n_quarantined)
+    RECORDER.count("quarantine_count")
+    RECORDER.counters[f"quarantined_partitions[{step_id}]"] = (
+        n_quarantined
+    )
+    RECORDER.record(
+        "quarantine",
+        step=step_id,
+        part=part,
+        fails=fails,
+        error=error,
+    )
+
+
+def note_unquarantine(
+    step_id: str, part: str, n_quarantined: int, parked_s: float
+) -> None:
+    """A quarantined partition's re-probe succeeded: it resumes
+    polling from the frozen offset."""
+    _quarantine_gauge(step_id).set(n_quarantined)
+    RECORDER.count("unquarantine_count")
+    RECORDER.counters[f"quarantined_partitions[{step_id}]"] = (
+        n_quarantined
+    )
+    RECORDER.record(
+        "unquarantine",
+        step=step_id,
+        part=part,
+        parked_s=round(parked_s, 3),
+    )
+
+
+def note_dlq(step_id: str, n: int) -> None:
+    """``n`` poison records captured into the dead-letter queue."""
+    from bytewax_tpu._metrics import dlq_records_count
+
+    dlq_records_count.labels(step_id).inc(n)
+    RECORDER.count("dlq_records_count", n)
+    RECORDER.record("dlq_capture", step=step_id, records=n)
 
 
 def note_demotion(step_id: str, reason: str, keys: int) -> None:

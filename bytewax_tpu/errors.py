@@ -11,6 +11,7 @@ callable's def site so operator errors name the lambda that raised,
 not just the step.
 """
 
+import errno as _errno
 import sys
 from typing import Callable, Optional
 
@@ -19,7 +20,11 @@ __all__ = [
     "ClusterPeerDead",
     "DeviceFault",
     "EpochStalled",
+    "TransientIOError",
+    "TransientSinkError",
+    "TransientSourceError",
     "callable_location",
+    "is_transient_io_error",
     "note_context",
 ]
 
@@ -75,6 +80,89 @@ class DeviceFault(BytewaxRuntimeError):
     retries the same delivery, so a partially-applied update would
     double-count.
     """
+
+
+class TransientIOError(BytewaxRuntimeError):
+    """A connector-edge I/O operation failed in a way that is worth
+    retrying in place (docs/recovery.md "Connector-edge resilience").
+
+    The driver retries the poll/write with capped jittered exponential
+    backoff (``BYTEWAX_TPU_IO_RETRIES`` / ``BYTEWAX_TPU_IO_BACKOFF_S``)
+    instead of unwinding the whole execution; exhaustion escalates to
+    the restartable-fault/supervisor path.  Raisers must guarantee the
+    failed call consumed/produced nothing — the engine re-invokes it
+    with the same position/values, so a partial effect would
+    double-count.
+    """
+
+
+class TransientSourceError(TransientIOError):
+    """A source partition's ``next_batch`` failed transiently (broker
+    hiccup, EAGAIN, timeout).  Raise it from ``next_batch`` *before*
+    advancing the read position: the driver re-polls the partition
+    after a backoff while the rest of the dataflow keeps flowing, and
+    — with ``BYTEWAX_TPU_QUARANTINE=1`` — parks the partition at its
+    last good offset after the retry budget is spent."""
+
+
+class TransientSinkError(TransientIOError):
+    """A sink partition's ``write_batch`` failed transiently.  Raise
+    it *before* any of the batch is durably written (or from a sink
+    that deduplicates): the driver retries the same batch in place —
+    strictly before the epoch's snapshot commit, so exactly-once
+    output is untouched — and escalates after the retry budget."""
+
+
+#: ``OSError`` errnos classified transient by default: interrupted /
+#: would-block reads, timeouts, and peer-reset style network failures
+#: — the shapes a flaky file descriptor or broker connection produces.
+#: Deliberately conservative: permission, missing-file, and
+#: out-of-space errors are NOT here (retrying them is a hot loop to
+#: nowhere).
+TRANSIENT_ERRNOS = frozenset(
+    {
+        _errno.EAGAIN,
+        _errno.EWOULDBLOCK,
+        _errno.EINTR,
+        _errno.EIO,
+        _errno.EBUSY,
+        _errno.ETIMEDOUT,
+        _errno.ECONNRESET,
+        _errno.ECONNABORTED,
+        _errno.ECONNREFUSED,
+        _errno.EPIPE,
+        _errno.ENETDOWN,
+        _errno.ENETUNREACH,
+        _errno.ENETRESET,
+        _errno.EHOSTDOWN,
+        _errno.EHOSTUNREACH,
+    }
+)
+
+
+def is_transient_io_error(ex: BaseException) -> bool:
+    """Whether the connector edge should retry ``ex`` in place.
+
+    True for the typed :class:`TransientIOError` family, for
+    ``TimeoutError``, and for any ``OSError`` whose errno is in
+    :data:`TRANSIENT_ERRNOS` — except :class:`ClusterPeerDead`, which
+    is mesh-liveness (a ``ConnectionError`` subclass), not connector
+    I/O, and must keep unwinding to the supervisor.
+
+    >>> from bytewax_tpu.errors import is_transient_io_error
+    >>> import errno, os
+    >>> is_transient_io_error(OSError(errno.EAGAIN, os.strerror(errno.EAGAIN)))
+    True
+    >>> is_transient_io_error(OSError(errno.ENOENT, "gone"))
+    False
+    """
+    if isinstance(ex, ClusterPeerDead):
+        return False
+    if isinstance(ex, (TransientIOError, TimeoutError)):
+        return True
+    return (
+        isinstance(ex, OSError) and ex.errno in TRANSIENT_ERRNOS
+    )
 
 
 def callable_location(f: Callable) -> Optional[str]:

@@ -97,6 +97,21 @@ class StatefulSourcePartition(ABC, Generic[X, S]):
 
     ``next_batch`` must never block: return an empty iterable if there
     are no items yet, and use :meth:`next_awake` to schedule polling.
+
+    Connector-edge resilience (docs/recovery.md): raise
+    :class:`bytewax_tpu.errors.TransientSourceError` from
+    ``next_batch`` — *before* advancing the read position — for
+    failures worth retrying in place; the engine re-polls with capped
+    jittered backoff (``BYTEWAX_TPU_IO_RETRIES``), quarantines the
+    partition after exhaustion when ``BYTEWAX_TPU_QUARANTINE=1``, and
+    otherwise escalates to the restartable-fault path.  Common
+    transient ``OSError``s/timeouts are classified automatically.  A
+    partition may additionally implement ``drain_dead_letters() ->
+    List[dict]`` (the ``on_error="dlq"`` policy on the built-in
+    connectors): the engine drains it after every poll and captures
+    the records — poison rows the partition consumed but could not
+    decode — into the dead-letter queue with provenance, in the epoch
+    whose snapshots cover the consumed offsets.
     """
 
     @abstractmethod

@@ -155,18 +155,61 @@ class LineBatcher:
     (always a line boundary; the recovery snapshot format stays a
     plain int byte offset).  :meth:`flush` emits the final
     unterminated line at EOF.
+
+    ``on_error="dlq"`` is the dead-letter decode policy
+    (docs/recovery.md "Connector-edge resilience"): a chunk whose
+    vectorized decode fails re-splits at the byte level and decodes
+    per line, collecting undecodable lines into :attr:`dead` (drained
+    by the engine into the dead-letter queue) while every clean line
+    still flows — one poison byte no longer kills the run.  The
+    default ``"raise"`` keeps the strict behavior.
     """
 
-    __slots__ = ("_carry", "_encoding")
+    __slots__ = ("_carry", "_encoding", "_on_error", "dead")
 
-    def __init__(self, encoding: Optional[str] = "utf-8"):
+    def __init__(
+        self,
+        encoding: Optional[str] = "utf-8",
+        on_error: str = "raise",
+    ):
+        if on_error not in ("raise", "dlq"):
+            msg = f"on_error must be 'raise' or 'dlq'; got {on_error!r}"
+            raise ValueError(msg)
         self._carry = b""
         self._encoding = encoding
+        self._on_error = on_error
+        #: Dead-lettered lines ({"error", "payload"}) under
+        #: ``on_error="dlq"``; the owning partition drains these.
+        self.dead: List[dict] = []
 
     @property
     def pending(self) -> int:
         """Bytes held back as a trailing partial line."""
         return len(self._carry)
+
+    def _split(self, body: bytes) -> np.ndarray:
+        if self._on_error != "dlq" or self._encoding is None:
+            return split_lines(body, self._encoding)
+        try:
+            return split_lines(body, self._encoding)
+        except UnicodeDecodeError:
+            # Poison bytes somewhere in the chunk: re-split at the
+            # byte level (always decodable) and decode per line, so
+            # only the offending line(s) dead-letter.
+            good: List[str] = []
+            for ln in split_lines(body, None).tolist():
+                try:
+                    good.append(ln.decode(self._encoding))
+                except UnicodeDecodeError as ex:
+                    self.dead.append(
+                        {
+                            "error": f"{type(ex).__name__}: {ex}",
+                            "payload": repr(ln),
+                        }
+                    )
+            if not good:
+                return np.empty(0, dtype="U1")
+            return np.array(good)
 
     def feed(self, raw: bytes) -> Optional[ArrayBatch]:
         data = self._carry + raw
@@ -175,7 +218,7 @@ class LineBatcher:
             self._carry = data
             return None
         self._carry = data[cut:]
-        lines = split_lines(data[:cut], self._encoding)
+        lines = self._split(data[:cut])
         return ArrayBatch({"line": lines})
 
     def flush(self) -> Optional[ArrayBatch]:
@@ -183,7 +226,7 @@ class LineBatcher:
         if not self._carry:
             return None
         body, self._carry = self._carry + b"\n", b""
-        return ArrayBatch({"line": split_lines(body, self._encoding)})
+        return ArrayBatch({"line": self._split(body)})
 
 
 def split_fields(

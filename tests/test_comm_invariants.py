@@ -113,11 +113,22 @@ def test_fault_site_inventory_is_pinned():
     # device site (a plain restartable InjectedFault, not a
     # DeviceFault), and the rescale mapping agreement added no
     # control-frame kinds — it rides existing startup gsync rounds.
+    # The connector-edge resilience PR added two: source_poll and
+    # sink_write, fired in the driver immediately before a source
+    # partition's next_batch / a sink partition's write_batch (before
+    # any offset advances or byte lands — retry-safe by
+    # construction).  kind=error at them raises the typed
+    # TransientSourceError/TransientSinkError absorbed by the I/O
+    # retry ladder; they are NOT device sites, and the whole layer is
+    # process-local (no new frame kinds, no send-surface growth —
+    # the inventories below are byte-identical).
     assert contracts.FAULT_SITES == (
         "comm.send",
         "comm.recv",
         "device_dispatch",
         "residency_restore",
+        "source_poll",
+        "sink_write",
         "snapshot.write",
         "snapshot.commit",
         "rescale_migrate",
@@ -177,6 +188,37 @@ def test_allowlist_is_not_stale():
         for call in pre_close.calls
         for t in call.targets
     ), "call graph lost the pre_close -> global flush edge"
+
+
+def test_connector_edge_resilience_is_process_local():
+    """The connector-edge resilience PR pin: the I/O retry ladder
+    (engine/backoff.py), the dead-letter queue (engine/dlq.py), and
+    partition quarantine are process-local — the frame-kind inventory
+    is byte-identical, no allowlist grew, and none of their functions
+    call a raw send primitive, a ship method, or a sync round (a
+    quarantined partition parks via next_awake scheduling; nothing
+    rides the mesh, so it can never early-exit a collective tier)."""
+    modules = {"bytewax_tpu.engine.backoff", "bytewax_tpu.engine.dlq"}
+    allowlisted = (
+        set().union(*contracts.SEND_ALLOWED.values())
+        | contracts.GSYNC_CALLER_MODULES
+    )
+    assert not (modules & allowlisted)
+
+    project = _project()
+    forbidden = (
+        contracts.RAW_SEND_METHODS
+        | contracts.SHIP_METHODS
+        | contracts.GSYNC_PRIMITIVES
+    )
+    checked = 0
+    for qual, fn in project.functions.items():
+        mod = qual.split(":", 1)[0]
+        if mod in modules:
+            checked += 1
+            comm_calls = [c.name for c in fn.calls if c.name in forbidden]
+            assert not comm_calls, f"{qual} calls {comm_calls}"
+    assert checked >= 8  # the scan really covered both modules
 
 
 def test_ingest_batching_is_process_local():

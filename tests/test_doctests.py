@@ -11,6 +11,7 @@ MODULES = [
     "bytewax_tpu.operators.helpers",
     "bytewax_tpu.operators.windowing",
     "bytewax_tpu.engine.arrays",
+    "bytewax_tpu.engine.backoff",
     "bytewax_tpu.inputs",
     "bytewax_tpu.outputs",
     "bytewax_tpu.xla",

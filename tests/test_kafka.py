@@ -362,15 +362,35 @@ def test_inmem_error_routing(fake_kafka):
     assert kinds == ["KafkaSourceMessage", "KafkaError", "KafkaSourceMessage"]
     assert "transport failure" in str(out[1].error)
 
-    # raise_on_errors=True (default): the step fails with the broker
-    # error.
+    # raise_on_errors=True (default): a TRANSIENT broker error
+    # (transport failure is in TRANSIENT_KAFKA_CODES) no longer kills
+    # the run — the typed TransientSourceError is retried at the poll
+    # boundary and every message still lands (docs/recovery.md
+    # "Connector-edge resilience").
+    out2 = []
     flow2 = Dataflow("strict")
     s2 = op.input(
         "inp2", flow2, KafkaSource(["inmem://err"], ["t"], tail=False)
     )
-    op.output("out", s2, TestingSink([]))
+    op.output("out", s2, TestingSink(out2))
+    run_main(flow2)
+    assert [m.value for m in out2] == [b"ok", b"after"]
+
+    # A NON-transient broker error keeps the strict behavior: the
+    # step fails with the broker error.
+    broker2 = fake_kafka.broker_for("inmem://err-fatal")
+    broker2.create_topic("t", partitions=1)
+    broker2.produce("t", value=b"ok", partition=0)
+    broker2.inject_error("t", 0, code=1, reason="offset out of range")
+    flow3 = Dataflow("strict_fatal")
+    s3 = op.input(
+        "inp3",
+        flow3,
+        KafkaSource(["inmem://err-fatal"], ["t"], tail=False),
+    )
+    op.output("out", s3, TestingSink([]))
     with pytest.raises(RuntimeError, match="error consuming"):
-        run_main(flow2)
+        run_main(flow3)
 
 
 def test_inmem_operators_input_split(fake_kafka):
