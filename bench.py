@@ -1402,15 +1402,30 @@ def main() -> None:
         extra["residency_stress_events_per_sec"] = None
         extra["residency_error"] = str(ex)[:200]
 
-    # Static contract enforcement status: rule count + clean/dirty,
-    # so the trajectory records enforcement growth round over round
-    # (pure AST — never touches jax; see docs/contracts.md).
+    # Static contract enforcement status: rule count, per-rule
+    # finding counts, clean/dirty, and the analyzer's own wall time —
+    # so the trajectory records enforcement growth AND analyzer
+    # regressions round over round (pure AST — never touches jax;
+    # see docs/contracts.md).
     try:
         from bytewax_tpu.analysis import ALL_RULES, analyze_tree
 
-        diags, _suppressed, _project = analyze_tree()
+        rule_timings = {}
+        t0 = time.perf_counter()
+        diags, _suppressed, _project = analyze_tree(
+            timings=rule_timings
+        )
+        extra["analysis_wall_s"] = round(time.perf_counter() - t0, 3)
         extra["contract_rules"] = len(ALL_RULES)
         extra["contract_findings"] = len(diags)
+        by_rule = {rid: 0 for rid in ALL_RULES}
+        for d in diags:
+            by_rule[d.rule] = by_rule.get(d.rule, 0) + 1
+        extra["contract_findings_by_rule"] = by_rule
+        extra["contract_rule_wall_s"] = {
+            rid: round(secs, 3)
+            for rid, secs in sorted(rule_timings.items())
+        }
         extra["contracts_clean"] = not diags
     except Exception as ex:  # noqa: BLE001 - bench must still report
         extra["contracts_error"] = str(ex)[:200]

@@ -31,6 +31,9 @@ _RULE_DOC = {
     "BTX-FAULT": "fault sites pinned; injector silent; fire before mutate",
     "BTX-SNAPSHOT": "device-tier states implement demotion_snapshots()",
     "BTX-BACKEND": "standalone scripts force a backend before jax init",
+    "BTX-DRAIN": "drain-only ops (evict/restore/flush/...) only at drain points",
+    "BTX-THREAD": "the pipeline worker lane never reaches main-only state",
+    "BTX-KNOB": "every BYTEWAX_TPU_* knob is cataloged + documented",
 }
 
 
@@ -62,7 +65,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="comma-separated rule ids to run (default: all)",
     )
     parser.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        metavar="ID",
+        help="run one rule (repeatable; merges with --rules)",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
+    )
+    parser.add_argument(
+        "--timings",
+        action="store_true",
+        help="report per-rule wall time on stderr (JSON line with "
+        "--json)",
     )
     parser.add_argument(
         "--baseline",
@@ -92,8 +108,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     rule_ids = None
+    wanted: List[str] = []
     if args.rules:
-        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        wanted.extend(
+            r.strip() for r in args.rules.split(",") if r.strip()
+        )
+    if args.rule:
+        wanted.extend(r.strip() for r in args.rule if r.strip())
+    if wanted:
+        rule_ids = list(dict.fromkeys(wanted))
         unknown = [r for r in rule_ids if r not in ALL_RULES]
         if unknown:
             print(
@@ -102,6 +125,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
             return 2
 
+    timings = {} if args.timings else None
     if args.paths:
         diags, suppressed, _project = api.analyze_paths(
             args.paths,
@@ -112,6 +136,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             baseline=None
             if (args.no_baseline or args.write_baseline)
             else args.baseline,
+            timings=timings,
         )
         baseline_path = args.baseline
     else:
@@ -124,7 +149,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             rule_ids=rule_ids,
             baseline=baseline_path,
             use_baseline=not (args.no_baseline or args.write_baseline),
+            timings=timings,
         )
+
+    # Timings report before any early return, so --timings composes
+    # with --write-baseline.
+    if timings is not None:
+        if args.json:
+            print(
+                json.dumps({"timings_s": {
+                    k: round(v, 4) for k, v in sorted(timings.items())
+                }}),
+                file=sys.stderr,
+            )
+        else:
+            for rid, secs in sorted(timings.items()):
+                print(f"{rid}\t{secs * 1e3:.1f} ms", file=sys.stderr)
 
     if args.write_baseline:
         if baseline_path is None:

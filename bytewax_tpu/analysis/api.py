@@ -71,16 +71,18 @@ def analyze_tree(
     rule_ids: Optional[Iterable[str]] = None,
     baseline: Optional[Path] = None,
     use_baseline: bool = True,
+    timings: Optional[Dict[str, float]] = None,
 ) -> Tuple[List[Diagnostic], int, Project]:
     """Analyze the installed package (+ examples).  Returns
     ``(diagnostics, n_baselined, project)`` after waiver and baseline
-    filtering."""
+    filtering.  Pass a dict as ``timings`` to collect per-rule wall
+    seconds (``bench.py`` feeds these into the perf trajectory)."""
     from bytewax_tpu.analysis.rules import run_rules
 
     pkg_dir, examples = default_roots()
     root = pkg_dir.parent
     project = _load(discover_files(pkg_dir, examples), root)
-    diags = run_rules(project, rule_ids)
+    diags = run_rules(project, rule_ids, timings=timings)
     diags = apply_waivers(diags, _waiver_map(project))
     suppressed = 0
     if use_baseline:
@@ -98,6 +100,7 @@ def analyze_paths(
     rule_ids: Optional[Iterable[str]] = None,
     baseline: Optional[Path] = None,
     rel_root: Optional[Path] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> Tuple[List[Diagnostic], int, Project]:
     """Analyze an explicit file set (fixtures, one-off checks).
 
@@ -124,7 +127,7 @@ def analyze_paths(
             used.add(name)
             files.append((name, path, scripts))
     project = _load(files, rel_root)
-    diags = run_rules(project, rule_ids)
+    diags = run_rules(project, rule_ids, timings=timings)
     diags = apply_waivers(diags, _waiver_map(project))
     suppressed = 0
     if baseline is not None:

@@ -301,6 +301,322 @@ RESIDENCY_EXTRACT = "extract_keys"
 RESIDENCY_INJECT = "inject_keys"
 
 # ---------------------------------------------------------------------------
+# BTX-DRAIN — drain-only operations happen only at drain points
+# ---------------------------------------------------------------------------
+
+#: The dispatch-pipeline class; constructing it (or holding it in an
+#: attribute) marks a receiver as pipeline-denoting for the drain and
+#: thread rules.
+PIPELINE_CLASS = "bytewax_tpu.engine.pipeline.DevicePipeline"
+
+#: Thread-submission surfaces on a pipeline-denoting receiver: the
+#: first argument is a callable that will run on the worker lane.
+PIPELINE_SUBMIT_METHODS = frozenset({"push", "submit"})
+
+#: Drain-only operations, by method name.  Calls to these are legal
+#: only from a pinned drain point: they read or hand off state the
+#: pipeline worker owns between submit and finalize (residency tier
+#: movement, demotion snapshots, residency-managed snapshot reads,
+#: pipeline drain/teardown wrappers, epoch-close entry).  Their own
+#: DEFINITIONS are drain machinery and are not descended into.
+DRAIN_ONLY_METHODS = frozenset(
+    {
+        # engine/residency.py tier movement (restore-before-dispatch
+        # and eviction both quiesce the pipeline first).
+        "evict_to_budget",
+        "prepare",
+        "prepare_entries",
+        "extract_keys",
+        "inject_keys",
+        # cross-tier demotion reads worker-owned fold structures.
+        "demotion_snapshots",
+        # the driver-side pipeline drain/teardown wrappers.
+        "pipeline_flush",
+        "pipeline_shutdown",
+        "_pipe_shutdown",
+        # epoch-close entry (snapshots + the close sync ladder).
+        "_close_epoch",
+        "_close_epoch_inner",
+    }
+)
+
+#: Calls with these names on a *pipeline-denoting receiver* are
+#: drain-only too (the raw DevicePipeline drain/teardown surface;
+#: name-only matching would over-fire on file/DLQ/global-tier
+#: ``flush``).
+PIPELINE_DRAIN_METHODS = frozenset({"flush", "shutdown", "drop_pending"})
+
+#: Drain-only names scoped to the residency manager: a call counts
+#: only when it may resolve into ``engine/residency.py`` (or does
+#: not resolve at all).  A device tier reading its OWN snapshots
+#: inside its deferred device phase (the windower's due-window
+#: fetch) is the pipeline worker's job, not a drain violation.
+DRAIN_RESIDENCY_SCOPED = frozenset({"snapshots_for"})
+RESIDENCY_MODULE = "bytewax_tpu.engine.residency"
+
+#: The pinned drain points (module, qualname): window close/notify,
+#: epoch close, snapshot, the EOF ladder, demotion, and the
+#: gsync-bearing startup paths.  The reachability walk from per-batch
+#: roots does not descend into these; a drain-only operation
+#: reachable OUTSIDE them is a finding.  ``pre_close`` /
+#: ``on_upstream_eof`` / ``epoch_snaps`` are drain points by name
+#: (see DRAIN_POINT_METHOD_NAMES) — operator hooks the close
+#: broadcast / EOF ladder serialize.
+DRAIN_POINTS: FrozenSet[Tuple[str, str]] = frozenset(
+    {
+        ("bytewax_tpu.engine.driver", "_StatefulBatchRt.advance"),
+        ("bytewax_tpu.engine.driver", "_StatefulBatchRt._demote"),
+        ("bytewax_tpu.engine.driver", "_Driver._close_epoch"),
+        ("bytewax_tpu.engine.driver", "_Driver._close_epoch_inner"),
+        ("bytewax_tpu.engine.driver", "_Driver._drain_pipelines"),
+        ("bytewax_tpu.engine.driver", "_Driver._apply_eof_step"),
+        ("bytewax_tpu.engine.driver", "_Driver._startup_rescale"),
+        ("bytewax_tpu.engine.driver", "_Driver.run"),
+    }
+)
+
+#: Method names that are drain points wherever they appear: operator
+#: hooks invoked only from the ordered close/EOF machinery, plus the
+#: window-close/notify hooks — the driver flushes the pipeline
+#: before every ``on_notify``/``on_eof`` pass (window close IS a
+#: drain point), so their snapshot reads are post-flush by
+#: construction.
+DRAIN_POINT_METHOD_NAMES = frozenset(
+    {
+        "pre_close",
+        "on_upstream_eof",
+        "epoch_snaps",
+        "on_notify",
+        "on_eof",
+    }
+)
+
+#: Functions whose direct gsync call is exempt from the
+#: flush-before-sync ordering check, with the reason pinned here:
+#: - GlobalAggState.flush: the collective tier never enters the
+#:   pipeline at all, and its only caller (pre_close) flushes every
+#:   pipeline first — the driver also drains all ops before the
+#:   pre_close pass at epoch close.
+#: - _Driver.run / _Driver._startup_rescale: run-startup rounds
+#:   ("fcfg", "rescaled") fire before any delivery has been
+#:   dispatched, so no pipeline can hold work yet.
+GSYNC_PREFLUSHED: FrozenSet[Tuple[str, str]] = frozenset(
+    {
+        ("bytewax_tpu.engine.sharded_state", "GlobalAggState.flush"),
+        ("bytewax_tpu.engine.driver", "_Driver.run"),
+        ("bytewax_tpu.engine.driver", "_Driver._startup_rescale"),
+    }
+)
+
+#: Call names that count as "flushes the pipelines" for the
+#: flush-before-sync component (directly, or via a call that
+#: transitively reaches one of them / a pipeline-receiver flush).
+PIPELINE_FLUSH_NAMES = frozenset(
+    {"pipeline_flush", "_drain_pipelines"}
+)
+
+#: Bound on the flush-before-sync reachability walk (a call lexically
+#: before a gsync must reach a pipeline flush within this many
+#: edges).
+DRAIN_REACH_DEPTH = 6
+
+# ---------------------------------------------------------------------------
+# BTX-THREAD — the pipeline worker lane never touches main-only state
+# ---------------------------------------------------------------------------
+
+#: Main-thread-only surfaces, by method/function name.  The worker
+#: lane (any callable submitted through ``DevicePipeline.push`` /
+#: ``submit``) must never transitively reach one: the send surface
+#: and sync rounds (cluster protocol ordering), downstream emission
+#: and the cluster routing/vocab split caches (stream order), the
+#: recovery store (snapshot consistency), residency tier movement and
+#: pipeline drains (the worker would race — or deadlock on — its own
+#: lane).
+MAIN_ONLY = frozenset(
+    {
+        # send surface / sync rounds
+        "ship_deliver",
+        "ship_route",
+        "send",
+        "broadcast",
+        "global_sync",
+        "next_gsync_tag",
+        # downstream emission + cluster routing / vocab split caches
+        "emit",
+        "route",
+        "_flush",
+        "_handle",
+        "_emit_window_events",
+        "_emit_scan",
+        "_split_remote",
+        "_split_remote_columnar",
+        "_batch_dests",
+        # recovery-store writes and resume reads
+        "write_epoch",
+        "write_ex_started",
+        "rescale",
+        "resume_state",
+        "iter_resume_states",
+        # residency tier movement + demotion
+        "evict_to_budget",
+        "prepare",
+        "prepare_entries",
+        "extract_keys",
+        "inject_keys",
+        "demotion_snapshots",
+        # pipeline drains (a worker task flushing its own pipeline
+        # deadlocks the lane) and epoch close
+        "pipeline_flush",
+        "pipeline_shutdown",
+        "_pipe_shutdown",
+        "flush",
+        "shutdown",
+        "drop_pending",
+        "make_room",
+        "push",
+        "submit",
+        "_close_epoch",
+        "_close_epoch_inner",
+    }
+)
+
+#: Modules whose functions are main-thread-only wholesale: reaching
+#: ANY function defined in one of these from the worker lane is a
+#: finding, whatever it is called.
+MAIN_ONLY_MODULES = frozenset(
+    {
+        "bytewax_tpu.engine.comm",
+        "bytewax_tpu.engine.recovery_store",
+        "bytewax_tpu.engine.residency",
+        "bytewax_tpu.engine.dlq",
+        "bytewax_tpu.engine.webserver",
+    }
+)
+
+#: Ubiquitous Python collection/stdlib method names: when the
+#: resolver's visible-name FALLBACK (unknown receiver) is the only
+#: thing binding one of these to a project method, the edge is far
+#: more likely a ``dict.get`` / ``list.append`` than the project
+#: method — the worker-lane walk drops such edges instead of
+#: reporting every ``self._cache.get(...)`` as a residency-module
+#: touch.  A RESOLVED receiver (typed local/attribute, ``self``)
+#: with one of these names still counts fully.
+FALLBACK_BENIGN_METHODS = frozenset(
+    {
+        "get",
+        "append",
+        "extend",
+        "pop",
+        "popleft",
+        "clear",
+        "add",
+        "discard",
+        "setdefault",
+        "keys",
+        "values",
+        "items",
+        "copy",
+        "close",
+        "time",
+        "tolist",
+        "astype",
+        "join",
+        "split",
+    }
+)
+
+#: Deliberately-shared append paths the worker lane MAY use: the
+#: flight-ring / ledger recording surface is lock-free-append by
+#: design (docs/observability.md) and the worker stamps its own
+#: device-phase timings.  These names are exempt from the MAIN_ONLY
+#: *name* check only — a call that resolves into a MAIN_ONLY_MODULES
+#: module is flagged regardless of its name, so a recovery-store or
+#: DLQ method named ``record``/``count`` can never hide behind the
+#: waiver.
+WORKER_SAFE = frozenset(
+    {
+        "note_phase",
+        "note_source_lag",
+        "note_pipeline_stall",
+        "note_flush_depth",
+        "record",
+        "count",
+    }
+)
+
+# ---------------------------------------------------------------------------
+# BTX-KNOB — the BYTEWAX_TPU_* environment-knob catalog
+# ---------------------------------------------------------------------------
+
+#: Every engine knob: name -> (default-as-the-code-reads-it, doc file
+#: under the repo root that describes it).  Every ``os.environ`` /
+#: ``os.getenv`` read of a ``BYTEWAX_TPU_*`` name must be a string
+#: literal found in this table (a computed name evades the catalog),
+#: every entry must still be read somewhere in the package (a
+#: removed knob must leave the catalog), and every entry's doc file
+#: must mention it (doc drift is an analyzer finding).
+#: ``docs/configuration.md`` is the generated-from-this-table
+#: reference and must list exactly these names.
+KNOBS: Dict[str, Tuple[str, str]] = {
+    "BYTEWAX_TPU_ACCEL": ("1", "docs/configuration.md"),
+    "BYTEWAX_TPU_COMPILE_CACHE": ("", "docs/performance.md"),
+    "BYTEWAX_TPU_COORDINATOR": ("", "docs/deployment.md"),
+    "BYTEWAX_TPU_DEMOTE_AFTER": ("3", "docs/recovery.md"),
+    "BYTEWAX_TPU_DIAL_TIMEOUT_S": ("30", "docs/deployment.md"),
+    "BYTEWAX_TPU_DISTRIBUTED": ("0", "docs/deployment.md"),
+    "BYTEWAX_TPU_DLQ_DIR": ("", "docs/recovery.md"),
+    "BYTEWAX_TPU_EPOCH_STALL_S": ("0", "docs/recovery.md"),
+    "BYTEWAX_TPU_FAULTS": ("", "docs/recovery.md"),
+    "BYTEWAX_TPU_FAULTS_KINDS": ("", "docs/configuration.md"),
+    "BYTEWAX_TPU_FAULTS_MIN_GAP_S": ("1.0", "docs/recovery.md"),
+    "BYTEWAX_TPU_FAULTS_RATE": ("0.01", "docs/recovery.md"),
+    "BYTEWAX_TPU_FAULTS_SEED": ("0", "docs/recovery.md"),
+    "BYTEWAX_TPU_FAULTS_SITES": ("", "docs/recovery.md"),
+    "BYTEWAX_TPU_FAULT_DELAY_S": ("0.05", "docs/configuration.md"),
+    "BYTEWAX_TPU_GC": ("epoch", "docs/configuration.md"),
+    "BYTEWAX_TPU_GLOBAL_EXCHANGE": ("1", "docs/xla-tier.md"),
+    "BYTEWAX_TPU_GLOBAL_EXCHANGE_DEBUG": (
+        "0",
+        "docs/configuration.md",
+    ),
+    "BYTEWAX_TPU_HB_S": ("0", "docs/recovery.md"),
+    "BYTEWAX_TPU_HEARTBEAT_S": ("30", "docs/profiling.md"),
+    "BYTEWAX_TPU_HOST_STATE_BUDGET": ("", "docs/state-residency.md"),
+    "BYTEWAX_TPU_INGEST_TARGET_ROWS": ("", "docs/performance.md"),
+    "BYTEWAX_TPU_IO_BACKOFF_CAP_S": ("5", "docs/recovery.md"),
+    "BYTEWAX_TPU_IO_BACKOFF_S": ("0.05", "docs/recovery.md"),
+    "BYTEWAX_TPU_IO_RETRIES": ("3", "docs/recovery.md"),
+    "BYTEWAX_TPU_MAX_RESTARTS": ("0", "docs/recovery.md"),
+    "BYTEWAX_TPU_PAD_MAX_POW": ("24", "docs/performance.md"),
+    "BYTEWAX_TPU_PAD_MIN_POW": ("5", "docs/performance.md"),
+    "BYTEWAX_TPU_PALLAS": ("0", "docs/configuration.md"),
+    "BYTEWAX_TPU_PIPELINE_DEPTH": ("2", "docs/performance.md"),
+    "BYTEWAX_TPU_PLATFORM": ("", "docs/profiling.md"),
+    "BYTEWAX_TPU_POSTMORTEM_DIR": ("", "docs/observability.md"),
+    "BYTEWAX_TPU_QUARANTINE": ("0", "docs/recovery.md"),
+    "BYTEWAX_TPU_QUARANTINE_REPROBE_S": ("30", "docs/recovery.md"),
+    "BYTEWAX_TPU_RESCALE": ("0", "docs/recovery.md"),
+    "BYTEWAX_TPU_RESTART_BACKOFF_S": ("0.5", "docs/recovery.md"),
+    "BYTEWAX_TPU_RESTART_RESET_S": ("300", "docs/recovery.md"),
+    "BYTEWAX_TPU_REUSEPORT": ("", "docs/configuration.md"),
+    "BYTEWAX_TPU_RX_BUFFER_CAP": ("67108864", "docs/deployment.md"),
+    "BYTEWAX_TPU_SHARD": ("auto", "docs/architecture.md"),
+    "BYTEWAX_TPU_SPILL_DIR": ("", "docs/state-residency.md"),
+    "BYTEWAX_TPU_STATE_BUDGET": ("", "docs/state-residency.md"),
+    "BYTEWAX_TPU_TEXT_DEVICE": ("0", "docs/performance.md"),
+    "BYTEWAX_TPU_TRACE_DIR": ("", "docs/observability.md"),
+}
+
+#: The knob name prefix the rule keys on.
+KNOB_PREFIX = "BYTEWAX_TPU_"
+
+#: Dotted paths that read the environment (resolved through module
+#: bindings, so ``from os import environ; environ.get(...)`` is
+#: seen).
+ENV_READ_CALLS = frozenset({"os.environ.get", "os.getenv"})
+ENV_MAPPING = "os.environ"
+
+# ---------------------------------------------------------------------------
 # BTX-BACKEND — standalone scripts must force a backend
 # ---------------------------------------------------------------------------
 

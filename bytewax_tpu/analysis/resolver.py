@@ -20,6 +20,18 @@ matching: every project method with that name whose defining module
 the caller imports (directly or via a member).  This deliberately
 over-approximates — a contract checker must fail loud on a possible
 edge, not stay quiet on a missed one.
+
+Nested functions and lambdas are indexed as their own
+:class:`FunctionInfo` entries (qualname ``outer.<locals>.name`` /
+``outer.<locals>.<lambda>``), carrying a ``parent`` pointer and the
+enclosing class for ``self`` binding.  This is what lets a rule trace
+callables handed to a thread-submission surface
+(``DevicePipeline.push(task, finalize)``) as roots of their own
+execution lane — see :meth:`Project.callable_targets`.  For backward
+compatibility the enclosing function still *sees* its nested bodies
+(``body_walk`` descends), so rules that iterate top-level functions
+only must skip ``fn.parent is not None`` entries to avoid double
+counting; ``iter_functions`` does so by default.
 """
 
 import ast
@@ -83,7 +95,15 @@ def _dotted_of(node: ast.AST) -> Optional[List[str]]:
 class CallSite:
     """One resolved call expression inside a function body."""
 
-    __slots__ = ("node", "lineno", "col", "name", "dotted", "targets")
+    __slots__ = (
+        "node",
+        "lineno",
+        "col",
+        "name",
+        "dotted",
+        "targets",
+        "fallback",
+    )
 
     def __init__(
         self,
@@ -91,6 +111,7 @@ class CallSite:
         name: str,
         dotted: Optional[str],
         targets: Set[str],
+        fallback: bool = False,
     ):
         self.node = node
         self.lineno = node.lineno
@@ -105,10 +126,26 @@ class CallSite:
         #: Project function ids (``module:qualname``) this call may
         #: invoke.
         self.targets = targets
+        #: True when ``targets`` came from the visible-name fallback
+        #: (unknown receiver): deliberately over-approximate edges a
+        #: rule may choose to treat with less confidence for
+        #: ubiquitous collection-method names.
+        self.fallback = fallback
 
 
 class FunctionInfo:
-    __slots__ = ("module", "qualname", "node", "cls", "calls")
+    __slots__ = (
+        "module",
+        "qualname",
+        "node",
+        "cls",
+        "calls",
+        "parent",
+        "local_defs",
+        "assigns",
+        "call_nodes",
+        "subscripts",
+    )
 
     def __init__(
         self,
@@ -116,12 +153,32 @@ class FunctionInfo:
         qualname: str,
         node: ast.AST,
         cls: Optional[str],
+        parent: Optional[str] = None,
     ):
         self.module = module
         self.qualname = qualname  # "Class.method" or "func"
         self.node = node
-        self.cls = cls  # owning class name or None
+        self.cls = cls  # owning (or enclosing, for nested) class name
         self.calls: List[CallSite] = []
+        #: Enclosing function id for nested defs/lambdas, else None.
+        self.parent = parent
+        #: bare name -> FunctionInfo of defs nested directly in this
+        #: function's scope (lambdas excluded: they have no name).
+        self.local_defs: Dict[str, "FunctionInfo"] = {}
+        #: ``(target exprs, value expr)`` for every Assign in the
+        #: body, collected by the one scan pass — alias and
+        #: attribute-type analyses read this instead of re-walking
+        #: the AST.
+        self.assigns: List[Tuple[Tuple[ast.expr, ...], ast.expr]] = []
+        #: Every ``ast.Call`` in the body (same scan pass).
+        self.call_nodes: List[ast.Call] = []
+        #: ``ast.Subscript`` loads whose base is a name/attribute
+        #: chain (environment-read detection and the like).
+        self.subscripts: List[ast.Subscript] = []
+
+    @property
+    def nested(self) -> bool:
+        return self.parent is not None
 
     @property
     def id(self) -> str:
@@ -162,6 +219,8 @@ class Module:
         "functions",
         "classes",
         "visible",
+        "lambda_map",
+        "scope_assigns",
     )
 
     def __init__(
@@ -183,6 +242,17 @@ class Module:
         #: Project modules this module imports (or imports members
         #: of); used to scope name-based method-edge fallbacks.
         self.visible: Set[str] = set()
+        #: (lineno, col) of a ``lambda`` expression -> its indexed
+        #: function id; lets callable-argument resolution name the
+        #: exact lambda at a call site.
+        self.lambda_map: Dict[Tuple[int, int], str] = {}
+        #: Class-body ``Assign`` statements (outside any function):
+        #: together with every function's ``assigns`` these cover all
+        #: assignments in the file, so fixpoint analyses never
+        #: re-walk the AST.
+        self.scope_assigns: List[
+            Tuple[Tuple[ast.expr, ...], ast.expr]
+        ] = []
 
 
 class Project:
@@ -231,11 +301,29 @@ class Project:
             proj._index_module(mod)
         for mod in proj.modules.values():
             proj._compute_visible(mod)
+        # ONE body walk per function collects assigns/calls/
+        # subscripts; everything downstream (attribute types, call
+        # resolution, the rules' alias analyses) consumes the cached
+        # lists instead of re-walking the AST.
+        for mod in proj.modules.values():
+            for fn in mod.functions.values():
+                proj._scan_body(fn)
         proj._build_attr_types()
         for mod in proj.modules.values():
             for fn in mod.functions.values():
                 proj._resolve_calls(mod, fn)
         return proj
+
+    def _scan_body(self, fn: FunctionInfo) -> None:
+        for node in body_walk(fn):
+            if isinstance(node, ast.Assign):
+                fn.assigns.append((tuple(node.targets), node.value))
+            elif isinstance(node, ast.Call):
+                fn.call_nodes.append(node)
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                fn.subscripts.append(node)
 
     # -- indexing ----------------------------------------------------------
 
@@ -263,16 +351,71 @@ class Project:
                     mod.bindings[local] = f"{base}.{alias.name}"
 
         def index_fn(
-            node: ast.AST, qual: str, cls: Optional[ClassInfo]
-        ) -> None:
+            node: ast.AST,
+            qual: str,
+            cls: Optional[ClassInfo],
+            parent: Optional[FunctionInfo] = None,
+        ) -> FunctionInfo:
             fn = FunctionInfo(
-                mod.name, qual, node, cls.name if cls else None
+                mod.name,
+                qual,
+                node,
+                cls.name if cls else None,
+                parent=parent.id if parent is not None else None,
             )
             mod.functions[qual] = fn
             self.functions[fn.id] = fn
-            self._by_method.setdefault(fn.name, set()).add(fn.id)
-            if cls is not None:
+            if not isinstance(node, ast.Lambda):
+                self._by_method.setdefault(fn.name, set()).add(fn.id)
+            if cls is not None and parent is None:
                 cls.methods[fn.name] = fn
+            return fn
+
+        def index_nested(owner: FunctionInfo, cls: Optional[ClassInfo]):
+            """Index defs/lambdas nested directly inside ``owner``
+            (recursively).  They keep the enclosing class for ``self``
+            binding (closures capture it) but are NOT registered as
+            class methods, and the name-fallback edge builder skips
+            them — only explicit references (a local call, a callable
+            argument) reach a nested function."""
+            scopes: List[ast.AST] = []
+            stack = list(ast.iter_child_nodes(owner.node))
+            while stack:
+                child = stack.pop()
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    scopes.append(child)
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    continue  # nested classes: out of scope
+                stack.extend(ast.iter_child_nodes(child))
+            scopes.sort(key=lambda n: (n.lineno, n.col_offset))
+            n_lambda = 0
+            for node in scopes:
+                if isinstance(node, ast.Lambda):
+                    n_lambda += 1
+                    leaf = (
+                        "<lambda>"
+                        if n_lambda == 1
+                        else f"<lambda:{n_lambda}>"
+                    )
+                else:
+                    leaf = node.name
+                sub = index_fn(
+                    node,
+                    f"{owner.qualname}.<locals>.{leaf}",
+                    cls,
+                    parent=owner,
+                )
+                if isinstance(node, ast.Lambda):
+                    mod.lambda_map[(node.lineno, node.col_offset)] = (
+                        sub.id
+                    )
+                else:
+                    owner.local_defs[node.name] = sub
+                index_nested(sub, cls)
 
         # Module-level statements as a pseudo-function: scripts
         # execute these, and rules need their call sites resolved.
@@ -280,7 +423,8 @@ class Project:
 
         for node in mod.tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                index_fn(node, node.name, None)
+                fn = index_fn(node, node.name, None)
+                index_nested(fn, None)
             elif isinstance(node, ast.ClassDef):
                 ci = ClassInfo(mod.name, node.name, node)
                 mod.classes[node.name] = ci
@@ -289,8 +433,12 @@ class Project:
                     if isinstance(
                         sub, (ast.FunctionDef, ast.AsyncFunctionDef)
                     ):
-                        index_fn(sub, f"{node.name}.{sub.name}", ci)
+                        fn = index_fn(sub, f"{node.name}.{sub.name}", ci)
+                        index_nested(fn, ci)
                     elif isinstance(sub, ast.Assign):
+                        mod.scope_assigns.append(
+                            (tuple(sub.targets), sub.value)
+                        )
                         for tgt in sub.targets:
                             if isinstance(tgt, ast.Name) and isinstance(
                                 sub.value, ast.Constant
@@ -423,15 +571,18 @@ class Project:
 
     def _build_attr_types(self) -> None:
         """``self.X = Ctor(...)`` / ``self.X = factory(...)`` across
-        the project -> attribute name X may hold those classes."""
+        the project -> attribute name X may hold those classes.
+        Nested functions are skipped (closures assign through the
+        same ``self``, and the enclosing function's scan already
+        covers their statements)."""
         for fn in self.functions.values():
+            if fn.nested:
+                continue
             mod = self.modules[fn.module]
-            for node in ast.walk(fn.node):
-                if not isinstance(node, ast.Assign):
+            for targets, value in fn.assigns:
+                if not isinstance(value, ast.Call):
                     continue
-                if not isinstance(node.value, ast.Call):
-                    continue
-                dotted = self.resolve_dotted(mod, node.value.func)
+                dotted = self.resolve_dotted(mod, value.func)
                 if dotted is None:
                     continue
                 ent = self.lookup(dotted)
@@ -445,7 +596,7 @@ class Project:
                     classes = self.returned_classes(ident)
                 if not classes:
                     continue
-                for tgt in node.targets:
+                for tgt in targets:
                     if (
                         isinstance(tgt, ast.Attribute)
                         and isinstance(tgt.value, ast.Name)
@@ -462,12 +613,10 @@ class Project:
     ) -> Dict[str, Set[str]]:
         """``x = Ctor(...)`` / ``x = factory(...)`` locals."""
         out: Dict[str, Set[str]] = {}
-        for node in body_walk(fn):
-            if not isinstance(node, ast.Assign):
+        for targets, value in fn.assigns:
+            if not isinstance(value, ast.Call):
                 continue
-            if not isinstance(node.value, ast.Call):
-                continue
-            dotted = self.resolve_dotted(mod, node.value.func)
+            dotted = self.resolve_dotted(mod, value.func)
             if dotted is None:
                 continue
             ent = self.lookup(dotted)
@@ -481,16 +630,14 @@ class Project:
                 classes = self.returned_classes(ident)
             if not classes:
                 continue
-            for tgt in node.targets:
+            for tgt in targets:
                 if isinstance(tgt, ast.Name):
                     out.setdefault(tgt.id, set()).update(classes)
         return out
 
     def _resolve_calls(self, mod: Module, fn: FunctionInfo) -> None:
         local_types = self._local_var_types(mod, fn)
-        for node in body_walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in fn.call_nodes:
             callee = node.func
             targets: Set[str] = set()
             dotted = self.resolve_dotted(mod, callee)
@@ -514,11 +661,36 @@ class Project:
                         init = self.class_method(ident, "__init__")
                         if init is not None:
                             targets.add(init.id)
+            fallback = False
             if not targets and isinstance(callee, ast.Attribute):
-                targets = self._method_targets(
+                targets, fallback = self._method_targets(
                     mod, fn, callee, local_types
                 )
-            fn.calls.append(CallSite(node, name, dotted, targets))
+            if not targets and isinstance(callee, ast.Name):
+                local = self._local_def(fn, callee.id)
+                if local is not None:
+                    targets = {local.id}
+            fn.calls.append(
+                CallSite(node, name, dotted, targets, fallback)
+            )
+
+    def _local_def(
+        self, fn: FunctionInfo, name: str
+    ) -> Optional[FunctionInfo]:
+        """A nested ``def`` visible from ``fn`` under ``name``
+        (Python closure scoping: this function, then the enclosing
+        chain)."""
+        cur: Optional[FunctionInfo] = fn
+        while cur is not None:
+            target = cur.local_defs.get(name)
+            if target is not None:
+                return target
+            cur = (
+                self.functions.get(cur.parent)
+                if cur.parent is not None
+                else None
+            )
+        return None
 
     def _method_targets(
         self,
@@ -526,7 +698,8 @@ class Project:
         fn: FunctionInfo,
         callee: ast.Attribute,
         local_types: Dict[str, Set[str]],
-    ) -> Set[str]:
+    ) -> Tuple[Set[str], bool]:
+        """Returns ``(candidate ids, used_name_fallback)``."""
         name = callee.attr
         recv = callee.value
         candidates: Set[str] = set()
@@ -534,7 +707,7 @@ class Project:
         if isinstance(recv, ast.Name) and recv.id == "self" and fn.cls:
             target = self.class_method(f"{fn.module}:{fn.cls}", name)
             if target is not None:
-                return {target.id}
+                return {target.id}, False
         # typed local: x = Ctor(...); x.m()
         if isinstance(recv, ast.Name) and recv.id in local_types:
             for cid in local_types[recv.id]:
@@ -542,7 +715,7 @@ class Project:
                 if target is not None:
                     candidates.add(target.id)
             if candidates:
-                return candidates
+                return candidates, False
         # typed attribute: self.agg.m() / driver.agg.m() via the
         # project-wide attribute-type map.
         if isinstance(recv, ast.Attribute):
@@ -551,15 +724,64 @@ class Project:
                 if target is not None:
                     candidates.add(target.id)
             if candidates:
-                return candidates
+                return candidates, False
         # Fallback: every visible project method with this name.
         for fid in self._by_method.get(name, ()):  # pragma: no branch
             target = self.functions[fid]
-            if target.cls is None:
-                continue  # bare functions resolve via dotted paths
+            if target.cls is None or target.nested:
+                # Bare functions resolve via dotted paths; nested
+                # defs only via explicit local/callable references.
+                continue
             if target.module in mod.visible:
                 candidates.add(fid)
-        return candidates
+        return candidates, bool(candidates)
+
+    # -- callable-argument tracing ----------------------------------------
+
+    def callable_targets(
+        self, mod: Module, fn: FunctionInfo, expr: ast.expr
+    ) -> Set[str]:
+        """Function ids a callable-valued *expression* may denote —
+        the argument side of a thread-submission surface
+        (``pipe.push(task, finalize)``): a lambda, a nested ``def``
+        (or an alias of one), a module-level function, or a bound
+        method (``self._accel_finalize``)."""
+        out: Set[str] = set()
+        if isinstance(expr, ast.Lambda):
+            fid = mod.lambda_map.get((expr.lineno, expr.col_offset))
+            if fid is not None:
+                out.add(fid)
+            return out
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            # One level of local re-aliasing: ``t = task``.
+            for targets, value in fn.assigns:
+                if isinstance(value, ast.Name) and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in targets
+                ):
+                    name = value.id
+                    break
+            local = self._local_def(fn, name)
+            if local is not None:
+                return {local.id}
+            dotted = self.resolve_dotted(mod, ast.Name(id=name))
+            if dotted is not None:
+                ent = self.lookup(dotted)
+                if ent is not None and ent[0] == "func":
+                    out.add(ent[1])
+            return out
+        if isinstance(expr, ast.Attribute):
+            out |= self._method_targets(
+                mod, fn, expr, self._local_var_types(mod, fn)
+            )[0]
+            dotted = self.resolve_dotted(mod, expr)
+            if dotted is not None:
+                ent = self.lookup(dotted)
+                if ent is not None and ent[0] == "func":
+                    out.add(ent[1])
+            return out
+        return out
 
     # -- convenience for rules --------------------------------------------
 
@@ -567,7 +789,35 @@ class Project:
         return [
             self.functions[fid]
             for fid in sorted(self._by_method.get(name, ()))
+            if not self.functions[fid].nested
         ]
 
-    def iter_functions(self) -> Sequence[FunctionInfo]:
-        return list(self.functions.values())
+    def iter_functions(
+        self, include_nested: bool = False
+    ) -> Sequence[FunctionInfo]:
+        """All indexed functions.  Nested defs/lambdas are excluded by
+        default: the enclosing function's body walk already covers
+        their statements, so rules that scan every function would
+        double-report.  Lane-tracing rules pass
+        ``include_nested=True``."""
+        return [
+            fn
+            for fn in self.functions.values()
+            if include_nested or not fn.nested
+        ]
+
+    def adjacency(self) -> Dict[str, Set[str]]:
+        """The resolved call graph as one shared adjacency map
+        (``caller id -> callee ids``), built once per project and
+        cached — every reachability rule walks this same structure
+        instead of re-deriving edges from ``fn.calls``."""
+        cached = getattr(self, "_adjacency_cache", None)
+        if cached is not None:
+            return cached
+        adj: Dict[str, Set[str]] = {}
+        for fn in self.functions.values():
+            edges = adj.setdefault(fn.id, set())
+            for call in fn.calls:
+                edges.update(call.targets)
+        self._adjacency_cache = adj
+        return adj

@@ -8,13 +8,16 @@ from bytewax_tpu.analysis.resolver import (
     FunctionInfo,
     Module,
     Project,
-    body_walk,
 )
 
 __all__ = [
     "comm_receiver_events",
     "const_str_arg",
+    "is_comm_expr",
+    "is_pipeline_expr",
     "local_aliases",
+    "pipeline_aliases",
+    "pipeline_submit_sites",
 ]
 
 
@@ -37,16 +40,16 @@ def local_aliases(
     predicate tags — e.g. ``c = self.comm`` with a predicate matching
     ``*.comm``.  Chained re-aliasing (``d = c``) is followed until a
     fixpoint, so a rename chain cannot smuggle the value past a
-    rule."""
+    rule.  Reads the resolver's pre-collected assignment list — no
+    AST re-walk."""
     tagged: Set[str] = set()
     assigns: List[Tuple[str, ast.expr]] = []
-    for node in body_walk(fn):
-        if isinstance(node, ast.Assign):
-            # Every target of a (possibly chained) assignment:
-            # ``c = d = self.comm`` tags both names.
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name):
-                    assigns.append((tgt.id, node.value))
+    for targets, value in fn.assigns:
+        # Every target of a (possibly chained) assignment:
+        # ``c = d = self.comm`` tags both names.
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                assigns.append((tgt.id, value))
     changed = True
     while changed:
         changed = False
@@ -61,26 +64,44 @@ def local_aliases(
     return tagged
 
 
-def _comm_attr_names(project: Project) -> Set[str]:
-    """Attribute names that hold the Comm object (``self.comm`` by
-    convention, plus anything assigned FROM a comm-denoting
-    expression anywhere in the project, to a fixpoint:
-    ``self.mesh = driver.comm`` makes ``.mesh`` comm-holding too).
-    Cached on the project object."""
-    cached = getattr(project, "_comm_attr_names_cache", None)
+def _project_assigns(project: Project):
+    """Every assignment in the project — function bodies (the scan
+    pass) plus class-level statements — as ``(mod, targets, value)``
+    triples, collected once and cached.  The attribute fixpoints
+    below iterate this list instead of re-walking every AST."""
+    cached = getattr(project, "_project_assigns_cache", None)
     if cached is not None:
         return cached
-    names: Set[str] = {"comm"}
+    out = []
+    for mod in project.modules.values():
+        for targets, value in mod.scope_assigns:
+            out.append((mod, targets, value))
+        for fn in mod.functions.values():
+            if fn.nested:
+                continue  # enclosing scan already covers these
+            for targets, value in fn.assigns:
+                out.append((mod, targets, value))
+    project._project_assigns_cache = out
+    return out
 
-    def denotes_comm(expr: ast.expr, mod: Module) -> bool:
+
+def _attr_name_fixpoint(
+    project: Project, seed: Set[str], ctor_dotted: str
+) -> Set[str]:
+    """Attribute names that (transitively) hold a value of the given
+    class: seeded by name convention and/or construction
+    (``X = Ctor(...)``), closed over project-wide re-assignment
+    (``self.mesh = driver.comm`` makes ``.mesh`` holding too)."""
+    names = set(seed)
+
+    def denotes(expr: ast.expr, mod: Module) -> bool:
         if isinstance(expr, ast.Attribute):
             return expr.attr in names
         if isinstance(expr, ast.Name):
             return expr.id in names
         if isinstance(expr, ast.Call):
             return (
-                project.resolve_dotted(mod, expr.func)
-                == contracts.COMM_CLASS
+                project.resolve_dotted(mod, expr.func) == ctor_dotted
             )
         return False
 
@@ -89,19 +110,30 @@ def _comm_attr_names(project: Project) -> Set[str]:
     changed = True
     while changed:
         changed = False
-        for mod in project.modules.values():
-            for node in ast.walk(mod.tree):
-                if not isinstance(node, ast.Assign):
-                    continue
-                if not denotes_comm(node.value, mod):
-                    continue
-                for tgt in node.targets:
-                    if (
-                        isinstance(tgt, ast.Attribute)
-                        and tgt.attr not in names
-                    ):
-                        names.add(tgt.attr)
-                        changed = True
+        for mod, targets, value in _project_assigns(project):
+            if not denotes(value, mod):
+                continue
+            for tgt in targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and tgt.attr not in names
+                ):
+                    names.add(tgt.attr)
+                    changed = True
+    return names
+
+
+def _comm_attr_names(project: Project) -> Set[str]:
+    """Attribute names that hold the Comm object (``self.comm`` by
+    convention, plus anything assigned FROM a comm-denoting
+    expression anywhere in the project, to a fixpoint).  Cached on
+    the project object."""
+    cached = getattr(project, "_comm_attr_names_cache", None)
+    if cached is not None:
+        return cached
+    names = _attr_name_fixpoint(
+        project, {"comm"}, contracts.COMM_CLASS
+    )
     project._comm_attr_names_cache = names
     return names
 
@@ -149,6 +181,101 @@ def _is_comm_expr(
     return False
 
 
+def is_comm_expr(
+    project: Project,
+    mod: Module,
+    fn: FunctionInfo,
+    node: ast.expr,
+    aliases: Optional[Set[str]] = None,
+) -> bool:
+    """Public face of :func:`_is_comm_expr` for rules that need to
+    recognize the cluster Comm object outside the raw-send event
+    scan (e.g. bound-method aliases of ``comm.send`` on the worker
+    lane)."""
+    return _is_comm_expr(
+        project, mod, fn, node, aliases if aliases is not None else set()
+    )
+
+
+def _pipeline_attr_names(project: Project) -> Set[str]:
+    """Attribute names that hold a :class:`DevicePipeline`
+    (``self._pipe`` by convention, plus anything assigned from a
+    pipeline-denoting expression project-wide, to a fixpoint) —
+    the same shape as :func:`_comm_attr_names`."""
+    cached = getattr(project, "_pipeline_attr_names_cache", None)
+    if cached is not None:
+        return cached
+    names = _attr_name_fixpoint(
+        project, set(), contracts.PIPELINE_CLASS
+    )
+    project._pipeline_attr_names_cache = names
+    return names
+
+
+def is_pipeline_expr(
+    project: Project,
+    mod: Module,
+    fn: FunctionInfo,
+    node: ast.expr,
+    aliases: Set[str],
+) -> bool:
+    """Does this expression denote a dispatch pipeline?  True for a
+    ``DevicePipeline(...)`` construction, an attribute whose name is
+    pipeline-holding project-wide (``self._pipe``), a local name
+    assigned from one of those, and ``self`` inside the pipeline
+    class itself."""
+    if isinstance(node, ast.Call):
+        return (
+            project.resolve_dotted(mod, node.func)
+            == contracts.PIPELINE_CLASS
+        )
+    if isinstance(node, ast.Attribute):
+        return node.attr in _pipeline_attr_names(project)
+    if isinstance(node, ast.Name):
+        if node.id in aliases:
+            return True
+        if node.id == "self" and fn.cls is not None:
+            return any(
+                f"{ci.module}.{ci.name}" == contracts.PIPELINE_CLASS
+                for ci in project.mro(f"{fn.module}:{fn.cls}")
+            )
+    return False
+
+
+def pipeline_aliases(
+    project: Project, mod: Module, fn: FunctionInfo
+) -> Set[str]:
+    """Local names aliased to a pipeline-denoting expression."""
+    return local_aliases(
+        fn,
+        lambda expr: is_pipeline_expr(project, mod, fn, expr, set()),
+    )
+
+
+def pipeline_submit_sites(
+    project: Project, mod: Module, fn: FunctionInfo
+) -> Iterable[Tuple[ast.Call, Set[str]]]:
+    """Yield ``(call, worker_targets)`` for every thread-submission
+    call in ``fn``: a ``push``/``submit`` on a pipeline-denoting
+    receiver, with the callable first argument resolved to the
+    function ids that will run on the worker lane."""
+    aliases: Optional[Set[str]] = None
+    for call in fn.calls:
+        node = call.node
+        callee = node.func
+        if not isinstance(callee, ast.Attribute):
+            continue
+        if callee.attr not in contracts.PIPELINE_SUBMIT_METHODS:
+            continue
+        if aliases is None:
+            aliases = pipeline_aliases(project, mod, fn)
+        if not is_pipeline_expr(project, mod, fn, callee.value, aliases):
+            continue
+        if not node.args:
+            continue
+        yield node, project.callable_targets(mod, fn, node.args[0])
+
+
 def comm_receiver_events(
     project: Project, mod: Module, fn: FunctionInfo
 ) -> Iterable[Tuple[str, ast.Call]]:
@@ -158,34 +285,30 @@ def comm_receiver_events(
     - ``("raw_send", call)`` — ``send``/``broadcast`` on a
       Comm-denoting receiver (through any local alias)
     - ``("ship", call)`` — ``ship_deliver``/``ship_route``
+
+    Iterates the resolver's pre-resolved call list (no AST re-walk);
+    aliases are computed lazily — only when a candidate name
+    actually appears.
     """
-    aliases = local_aliases(
-        fn,
-        lambda expr: _is_comm_expr(project, mod, fn, expr, set()),
-    )
-    for node in body_walk(fn):
-        if not isinstance(node, ast.Call):
-            continue
+    aliases: Optional[Set[str]] = None
+    for call in fn.calls:
+        node = call.node
         callee = node.func
-        if isinstance(callee, ast.Name) or isinstance(
-            callee, ast.Attribute
-        ):
-            name = (
-                callee.id
-                if isinstance(callee, ast.Name)
-                else callee.attr
-            )
-        else:
-            continue
-        dotted = project.resolve_dotted(mod, callee)
-        if dotted == contracts.COMM_CLASS:
+        if call.dotted == contracts.COMM_CLASS:
             yield ("comm_construct", node)
             continue
-        if name in contracts.SHIP_METHODS:
+        if call.name in contracts.SHIP_METHODS:
             yield ("ship", node)
             continue
-        if name in contracts.RAW_SEND_METHODS and isinstance(
+        if call.name in contracts.RAW_SEND_METHODS and isinstance(
             callee, ast.Attribute
         ):
+            if aliases is None:
+                aliases = local_aliases(
+                    fn,
+                    lambda expr: _is_comm_expr(
+                        project, mod, fn, expr, set()
+                    ),
+                )
             if _is_comm_expr(project, mod, fn, callee.value, aliases):
                 yield ("raw_send", node)

@@ -117,6 +117,8 @@ def check(project: Project) -> List[Diagnostic]:
             )
         # The injector may never originate traffic.
         for fn in faults_mod.functions.values():
+            if fn.nested:
+                continue  # enclosing body walk already covers these
             for node in body_walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
@@ -148,6 +150,8 @@ def check(project: Project) -> List[Diagnostic]:
 
     for mod in project.modules.values():
         for fn in mod.functions.values():
+            if fn.nested:
+                continue  # enclosing body walk already covers these
             fires = list(_fire_calls(project, mod, fn))
             for call, site in fires:
                 if site is None:

@@ -79,6 +79,8 @@ def check(project: Project) -> List[Diagnostic]:
                 )
 
         for fn in mod.functions.values():
+            if fn.nested:
+                continue  # enclosing body walk already covers these
             for kind, call in comm_receiver_events(project, mod, fn):
                 if kind != "raw_send":
                     continue

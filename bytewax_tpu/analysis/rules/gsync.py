@@ -26,7 +26,7 @@ Checks, on the resolved call graph:
 """
 
 import ast
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from bytewax_tpu.analysis import contracts
 from bytewax_tpu.analysis.diagnostics import Diagnostic
@@ -34,7 +34,6 @@ from bytewax_tpu.analysis.resolver import (
     MODULE_QUAL,
     FunctionInfo,
     Project,
-    body_walk,
 )
 from bytewax_tpu.analysis.rules._util import local_aliases
 
@@ -52,41 +51,41 @@ def _is_gsync_source(expr: ast.expr) -> bool:
 def _seed_calls(
     project: Project, fn: FunctionInfo
 ) -> List[Tuple[int, str]]:
-    """(lineno, what) for every collective seed in this function."""
-    mod = project.modules[fn.module]
-    aliases = local_aliases(fn, _is_gsync_source)
+    """(lineno, what) for every collective seed in this function.
+    Iterates the resolver's pre-resolved call list; aliases are
+    computed lazily from the pre-collected assignment list."""
+    aliases = None
     seeds: List[Tuple[int, str]] = []
-    for node in body_walk(fn):
-        if not isinstance(node, ast.Call):
+    for call in fn.calls:
+        name = call.name
+        if name in contracts.GSYNC_PRIMITIVES:
+            seeds.append((call.lineno, name))
             continue
-        callee = node.func
-        name = None
-        if isinstance(callee, ast.Attribute):
-            name = callee.attr
-        elif isinstance(callee, ast.Name):
-            name = callee.id
-        if name is None:
-            continue
-        if name in contracts.GSYNC_PRIMITIVES or (
-            isinstance(callee, ast.Name) and callee.id in aliases
-        ):
-            what = (
-                name
-                if name in contracts.GSYNC_PRIMITIVES
-                else f"{name} (alias of a gsync primitive)"
-            )
-            seeds.append((node.lineno, what))
-            continue
+        if isinstance(call.node.func, ast.Name):
+            if aliases is None:
+                aliases = (
+                    local_aliases(fn, _is_gsync_source)
+                    if fn.assigns
+                    else set()
+                )
+            if name in aliases:
+                seeds.append(
+                    (
+                        call.lineno,
+                        f"{name} (alias of a gsync primitive)",
+                    )
+                )
+                continue
         if fn.module in contracts.LOCAL_COLLECTIVE_MODULES:
             continue
-        dotted = project.resolve_dotted(mod, callee) or ""
+        dotted = call.dotted or ""
         if dotted in contracts.JAX_COLLECTIVES or any(
             dotted.endswith("." + c) or dotted == c
             for c in contracts.JAX_COLLECTIVES
         ):
-            seeds.append((node.lineno, dotted))
+            seeds.append((call.lineno, dotted))
         elif name in contracts.COLLECTIVE_WRAPPERS:
-            seeds.append((node.lineno, name))
+            seeds.append((call.lineno, name))
     return seeds
 
 

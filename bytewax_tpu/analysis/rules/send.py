@@ -44,6 +44,8 @@ def check(project: Project) -> List[Diagnostic]:
     out: List[Diagnostic] = []
     for mod in project.modules.values():
         for fn in mod.functions.values():
+            if fn.nested:
+                continue  # enclosing body walk already covers these
             for kind, call in comm_receiver_events(project, mod, fn):
                 if mod.name in _ALLOWED[kind]:
                     continue

@@ -1,0 +1,201 @@
+"""BTX-THREAD — the pipeline worker lane never touches main-only state.
+
+The dispatch pipeline (docs/performance.md) runs each delivery's
+device phase on a single worker thread; everything that must stay
+ordered with the rest of the dataflow — cluster sends, sync rounds,
+downstream emission, vocab/split caches, recovery-store writes,
+residency tier movement — belongs to the main thread.  A worker task
+that reaches one of those is a data race (or, for sends and sync
+rounds, a cluster-protocol violation) that no single-schedule test
+reliably catches.  This rule is a static thread-ownership race
+detector:
+
+1. **Worker-lane roots** — the resolver traces the callable argument
+   of every ``DevicePipeline.push``/``submit`` call (a lambda, a
+   nested ``def``, an alias of one, or a bound method) to the
+   functions that will execute on the worker thread.
+
+2. **Reachability** — from each root, walk the shared call graph; a
+   call to anything named in ``contracts.MAIN_ONLY``, any function
+   defined in a ``contracts.MAIN_ONLY_MODULES`` module, a raw comm
+   send (through any receiver or bound-method alias), or a gsync
+   primitive is a finding, reported at the submit site with a
+   witness chain.  ``contracts.WORKER_SAFE`` waives the
+   deliberately-shared flight-ring/ledger append paths.
+
+Targets owned by a ``global_exchange = True`` class are excluded
+from the walk: the collective tier never enters the pipeline (its
+flush is a cluster-ordered collective; the driver's dispatch path
+returns before ``push`` when the aggregation is global), so the
+name-fallback edge into it is a known over-approximation.
+"""
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from bytewax_tpu.analysis import contracts
+from bytewax_tpu.analysis.diagnostics import Diagnostic
+from bytewax_tpu.analysis.resolver import FunctionInfo, Project
+from bytewax_tpu.analysis.rules._util import (
+    is_comm_expr,
+    local_aliases,
+    pipeline_submit_sites,
+)
+
+RULE_ID = "BTX-THREAD"
+
+
+def worker_lane_roots(
+    project: Project,
+) -> Dict[str, List[Tuple[str, int]]]:
+    """Worker-lane root function ids -> the ``(file, line)`` submit
+    sites that hand them to the worker thread.  Shared with the
+    pinning test in ``tests/test_comm_invariants.py``."""
+    roots: Dict[str, List[Tuple[str, int]]] = {}
+    for fn in project.iter_functions(include_nested=True):
+        mod = project.modules[fn.module]
+        for call, targets in pipeline_submit_sites(project, mod, fn):
+            for target in sorted(targets):
+                roots.setdefault(target, []).append(
+                    (mod.rel, call.lineno)
+                )
+    return roots
+
+
+def _global_exchange_owned(project: Project, fid: str) -> bool:
+    """Is this function a method of a ``global_exchange = True``
+    class (the never-pipelining collective tier)?"""
+    fn = project.functions.get(fid)
+    if fn is None or fn.cls is None or fn.nested:
+        return False
+    return (
+        project.class_attr(f"{fn.module}:{fn.cls}", "global_exchange")
+        is True
+    )
+
+
+def _main_only_hits(
+    project: Project, fn: FunctionInfo
+) -> List[Tuple[int, str]]:
+    """(lineno, what) for every main-thread-only touch in ``fn``."""
+    mod = project.modules[fn.module]
+    hits: List[Tuple[int, str]] = []
+    # Bound-method aliases of a raw send: s = self.comm.send; s(...).
+    send_aliases = local_aliases(
+        fn,
+        lambda expr: isinstance(expr, ast.Attribute)
+        and expr.attr in contracts.RAW_SEND_METHODS
+        and is_comm_expr(project, mod, fn, expr.value),
+    )
+    for call in fn.calls:
+        if call.name in send_aliases:
+            hits.append(
+                (
+                    call.lineno,
+                    f"{call.name} (alias of a raw cluster send)",
+                )
+            )
+            continue
+        if (
+            call.fallback
+            and call.name in contracts.FALLBACK_BENIGN_METHODS
+        ):
+            # dict.get / list.append mis-bound to a project method by
+            # the name fallback — not a worker-lane touch.
+            continue
+        if (
+            call.name in contracts.MAIN_ONLY
+            and call.name not in contracts.WORKER_SAFE
+        ):
+            # A send/broadcast name only counts on a comm-denoting
+            # receiver (sockets aside, .send is too common a name);
+            # every other MAIN_ONLY name counts as-is.
+            if call.name in contracts.RAW_SEND_METHODS:
+                callee = call.node.func
+                if not (
+                    isinstance(callee, ast.Attribute)
+                    and is_comm_expr(
+                        project, mod, fn, callee.value, send_aliases
+                    )
+                ):
+                    continue
+            hits.append((call.lineno, call.name))
+            continue
+        for target in call.targets:
+            t_mod = target.split(":", 1)[0]
+            if (
+                t_mod in contracts.MAIN_ONLY_MODULES
+                and not _global_exchange_owned(project, target)
+            ):
+                hits.append(
+                    (
+                        call.lineno,
+                        f"{call.name} (defined in main-only module "
+                        f"{t_mod})",
+                    )
+                )
+                break
+    return hits
+
+
+def _lane_edges(fn: FunctionInfo):
+    """Callees the worker lane actually follows: every resolved
+    target except benign-name fallback bindings (see
+    ``contracts.FALLBACK_BENIGN_METHODS``)."""
+    for call in fn.calls:
+        if (
+            call.fallback
+            and call.name in contracts.FALLBACK_BENIGN_METHODS
+        ):
+            continue
+        yield from call.targets
+
+
+def check(project: Project) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    for root_id, sites in sorted(worker_lane_roots(project).items()):
+        root = project.functions.get(root_id)
+        if root is None:
+            continue
+        # BFS over the worker lane, excluding the collective tier.
+        parent: Dict[str, Optional[str]] = {root_id: None}
+        queue = [root_id]
+        while queue:
+            fid = queue.pop(0)
+            fn = project.functions[fid]
+            hits = _main_only_hits(project, fn)
+            if hits:
+                chain: List[FunctionInfo] = []
+                cur: Optional[str] = fid
+                while cur is not None:
+                    chain.append(project.functions[cur])
+                    cur = parent[cur]
+                chain.reverse()
+                via = " -> ".join(f.qualname for f in chain)
+                site_mod = project.modules[fn.module]
+                lineno, what = hits[0]
+                for rel, submit_line in sites:
+                    out.append(
+                        Diagnostic(
+                            RULE_ID,
+                            rel,
+                            submit_line,
+                            f"worker-lane task {root.qualname} "
+                            f"reaches main-thread-only surface "
+                            f"{what} ({site_mod.rel}:{lineno}) via "
+                            f"{via}; the pipeline worker may only "
+                            "run device phases — sends, sync "
+                            "rounds, emission, recovery-store and "
+                            "residency state belong to the main "
+                            "thread",
+                        )
+                    )
+                break  # one finding per root is enough
+            for target in sorted(set(_lane_edges(fn))):
+                if target in parent:
+                    continue
+                if _global_exchange_owned(project, target):
+                    continue  # the collective tier never pipelines
+                parent[target] = fid
+                queue.append(target)
+    return out

@@ -1558,6 +1558,7 @@ class _StatefulBatchRt(_OpRt):
                         and not self.logics
                     ):
                         self.wagg = None
+                        # bytewax: allow[BTX-DRAIN] — host-tier fallback teardown: _wagg_empty() just proved the pipeline idle and the windower stateless, so there is nothing to drain
                         self._pipe_shutdown()
                         self.process("up", entries[i:])
                         return
@@ -1580,6 +1581,7 @@ class _StatefulBatchRt(_OpRt):
                 # fall back to the host tier before any device state
                 # exists.
                 self.wagg = None
+                # bytewax: allow[BTX-DRAIN] — host-tier fallback teardown: _wagg_empty() just proved the pipeline idle and the windower stateless, so there is nothing to drain
                 self._pipe_shutdown()
                 self.process("up", entries[i:])
                 return
@@ -1717,7 +1719,9 @@ class _StatefulBatchRt(_OpRt):
                     # never mistaken for a retryable dispatch fault
                     # (the delivery already folded — a retry would
                     # double-count it).
+                    # bytewax: allow[BTX-DRAIN] — this IS a drain point: the flush right here quiesces every in-flight phase before the eviction below reclaims slots
                     self.pipeline_flush()
+                    # bytewax: allow[BTX-DRAIN] — eviction immediately after the full flush above; the budget check runs post-fold by design (docs/state-residency.md)
                     self._res.evict_to_budget(self.driver.epoch)
                 return True
 
@@ -1788,6 +1792,7 @@ class _StatefulBatchRt(_OpRt):
             # there unwinds into the retry/demotion handling with the
             # delivery fully replayable).  Restores flush the pipeline
             # first; pure touches are dict updates.
+            # bytewax: allow[BTX-DRAIN] — restore-before-dispatch: prepare_entries flushes the pipeline (the callback) before any slot moves, making this call site its own drain point
             self._res.prepare_entries(
                 entries, self.driver.epoch, self.pipeline_flush
             )
@@ -1893,6 +1898,7 @@ class _StatefulBatchRt(_OpRt):
                 # never strands cold state.)
                 self.agg = None
                 self._res = None
+                # bytewax: allow[BTX-DRAIN] — host-tier fallback teardown: the pending/keys/logics guard just proved the pipeline idle and the state empty
                 self._pipe_shutdown()
                 self.process("up", rest)
                 return
@@ -1904,6 +1910,7 @@ class _StatefulBatchRt(_OpRt):
             # See _process_accel: restore evicted keys before the
             # delivery dispatches (scan outputs read per-key state, so
             # the restore must land before the fold).
+            # bytewax: allow[BTX-DRAIN] — restore-before-dispatch: prepare_entries flushes the pipeline (the callback) before any slot moves, making this call site its own drain point
             self._res.prepare_entries(
                 entries, self.driver.epoch, self.pipeline_flush
             )
@@ -1926,6 +1933,7 @@ class _StatefulBatchRt(_OpRt):
                     # keys, so cold state blocks the silent fallback.)
                     self.sagg = None
                     self._res = None
+                    # bytewax: allow[BTX-DRAIN] — host-tier fallback teardown: the pending/keys/logics guard just proved the pipeline idle and the state empty
                     self._pipe_shutdown()
                     self.process("up", entries[i:])
                     return

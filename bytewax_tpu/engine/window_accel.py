@@ -615,6 +615,7 @@ class DeviceWindowAggState:
             for i in due_rows
         ]
         events = []
+        # bytewax: allow[BTX-DRAIN] — the windower's .agg is its own slot table (never residency-wrapped; the driver evicts only the keyed-agg/scan tiers), and this due-window fetch runs inside the deferred device phase the pipeline worker owns
         snaps = self.agg.snapshots_for(
             [f"{self.keys[kid]}\x00{wid}" for kid, wid, _ in due]
         )

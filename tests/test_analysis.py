@@ -105,6 +105,74 @@ def test_snapshot_rule_flags_residency_pairing():
     )
 
 
+def test_thread_rule_flags_worker_lane_alias_send():
+    diags = _diags("fixture_thread_worker_send.py", ["BTX-THREAD"])
+    assert [d.rule for d in diags] == ["BTX-THREAD"]
+    msg = diags[0].message
+    # The callable was traced INTO the thread submission (a nested
+    # def is the worker-lane root)...
+    assert "LeakyStep.process.<locals>.task" in msg
+    # ...and the send surface was reached through a bound-method
+    # alias — no line in the fixture spells `comm.send(...)`.
+    assert "alias of a raw cluster send" in msg
+    source = (FIXTURES / "fixture_thread_worker_send.py").read_text()
+    assert not re.search(r"comm\s*\.\s*send\s*\(", source)
+    # The diagnostic lands at the submit site, where a deliberate
+    # exception would be waived.
+    assert "self._pipe.push(task, finalize)" in source.splitlines()[
+        diags[0].lineno - 1
+    ]
+
+
+def test_drain_rule_flags_per_batch_eviction_and_flush():
+    diags = _diags("fixture_drain_per_batch.py", ["BTX-DRAIN"])
+    msgs = "\n".join(d.message for d in diags)
+    # Eviction reachable from a per-batch path, with a witness chain.
+    assert "evict_to_budget" in msgs
+    assert "EagerStep.process -> EagerStep._maybe_trim" in msgs
+    # Raw pipeline drain on a per-batch path (receiver-typed seed).
+    assert "DevicePipeline.flush" in msgs
+    # Flush-before-sync: the gsync primitive hides behind a
+    # bound-method alias and still gets flagged.
+    assert "without first flushing" in msgs
+    assert {d.rule for d in diags} == {"BTX-DRAIN"}
+
+
+def test_knob_rule_flags_uncataloged_and_computed_reads():
+    diags = _diags("fixture_knob_uncataloged.py", ["BTX-KNOB"])
+    msgs = "\n".join(d.message for d in diags)
+    assert "uncataloged knob BYTEWAX_TPU_TURBO" in msgs
+    assert "computed BYTEWAX_TPU_* knob name" in msgs
+    # Subscript loads are reads too.
+    assert "BYTEWAX_TPU_SECRET_MODE" in msgs
+    # A knob name bound to a variable first cannot slip the catalog.
+    assert "BYTEWAX_TPU_STEALTH_MODE" in msgs
+    assert len(diags) == 4
+
+
+def test_new_rule_waiver_round_trip(tmp_path):
+    """Each new rule's finding is suppressed by an inline waiver on
+    the flagged line — the same escape hatch the engine's deliberate
+    exceptions use — and reappears when the waiver is removed."""
+    cases = {
+        "fixture_thread_worker_send.py": "BTX-THREAD",
+        "fixture_drain_per_batch.py": "BTX-DRAIN",
+        "fixture_knob_uncataloged.py": "BTX-KNOB",
+    }
+    for name, rule in cases.items():
+        diags = _diags(name, [rule])
+        assert diags, name
+        lines = (FIXTURES / name).read_text().splitlines()
+        for d in diags:
+            lines[d.lineno - 1] += f"  # bytewax: allow[{rule}]"
+        waived = tmp_path / name
+        waived.write_text("\n".join(lines) + "\n")
+        after, _s, _p = analyze_paths(
+            [waived], rule_ids=[rule], rel_root=tmp_path
+        )
+        assert not after, (name, after)
+
+
 def test_backend_rule_flags_unforced_script():
     diags = _diags(
         "fixture_backend_script.py", ["BTX-BACKEND"], scripts=True

@@ -221,6 +221,193 @@ def test_connector_edge_resilience_is_process_local():
     assert checked >= 8  # the scan really covered both modules
 
 
+def test_drain_point_inventory_is_pinned():
+    """The pipeline-era drain contract (docs/performance.md,
+    docs/state-residency.md): drain-only operations are pinned by
+    name, raw pipeline drains by receiver, and the drain-point set —
+    window close/notify, epoch close, snapshot, the EOF ladder,
+    demotion, the gsync-bearing startup paths — is hardcoded here so
+    editing contracts.py alone cannot quietly bless a new per-batch
+    readback.  Extending either set requires updating the table AND
+    this test AND re-checking the contract note in CLAUDE.md +
+    docs/contracts.md."""
+    assert contracts.DRAIN_ONLY_METHODS == {
+        "evict_to_budget",
+        "prepare",
+        "prepare_entries",
+        "extract_keys",
+        "inject_keys",
+        "demotion_snapshots",
+        "pipeline_flush",
+        "pipeline_shutdown",
+        "_pipe_shutdown",
+        "_close_epoch",
+        "_close_epoch_inner",
+    }
+    assert contracts.PIPELINE_DRAIN_METHODS == {
+        "flush",
+        "shutdown",
+        "drop_pending",
+    }
+    assert contracts.DRAIN_POINTS == {
+        ("bytewax_tpu.engine.driver", "_StatefulBatchRt.advance"),
+        ("bytewax_tpu.engine.driver", "_StatefulBatchRt._demote"),
+        ("bytewax_tpu.engine.driver", "_Driver._close_epoch"),
+        ("bytewax_tpu.engine.driver", "_Driver._close_epoch_inner"),
+        ("bytewax_tpu.engine.driver", "_Driver._drain_pipelines"),
+        ("bytewax_tpu.engine.driver", "_Driver._apply_eof_step"),
+        ("bytewax_tpu.engine.driver", "_Driver._startup_rescale"),
+        ("bytewax_tpu.engine.driver", "_Driver.run"),
+    }
+    assert contracts.DRAIN_POINT_METHOD_NAMES == {
+        "pre_close",
+        "on_upstream_eof",
+        "epoch_snaps",
+        "on_notify",
+        "on_eof",
+    }
+    # The flush-before-sync exemptions are exactly the startup
+    # rounds (no pipeline can hold work yet) and the collective
+    # flush (its one caller, pre_close, flushes first).
+    assert contracts.GSYNC_PREFLUSHED == {
+        ("bytewax_tpu.engine.sharded_state", "GlobalAggState.flush"),
+        ("bytewax_tpu.engine.driver", "_Driver.run"),
+        ("bytewax_tpu.engine.driver", "_Driver._startup_rescale"),
+    }
+    # And every pinned drain point still exists (staleness guard,
+    # like test_allowlist_is_not_stale).
+    project = _project()
+    for module, qualname in contracts.DRAIN_POINTS:
+        assert f"{module}:{qualname}" in project.functions, qualname
+    diags = _check(["BTX-DRAIN"])
+    assert not diags, format_diagnostics(diags)
+
+
+def test_worker_lane_inventory_is_pinned():
+    """The thread-ownership contract (docs/performance.md): the
+    worker-lane roots the resolver traces out of the pipeline
+    submissions, and the MAIN_ONLY surface they must never reach,
+    pinned by value."""
+    from bytewax_tpu.analysis.rules.thread import worker_lane_roots
+
+    project = _project()
+    roots = worker_lane_roots(project)
+    driver = "bytewax_tpu.engine.driver"
+    # Exactly the three device-tier submission shapes: the window
+    # task, the scan task, and the keyed-aggregation fold lambda.
+    assert set(roots) == {
+        f"{driver}:_StatefulBatchRt._push_window_task.<locals>.task",
+        f"{driver}:_StatefulBatchRt._push_scan_task.<locals>.task",
+        f"{driver}:_StatefulBatchRt._process_accel.<locals>.<lambda>",
+    }
+    # The send surface, sync rounds, emission/routing, recovery
+    # store, residency movement, and pipeline drains are main-only.
+    for name in (
+        "ship_deliver",
+        "ship_route",
+        "send",
+        "broadcast",
+        "global_sync",
+        "next_gsync_tag",
+        "emit",
+        "route",
+        "write_epoch",
+        "evict_to_budget",
+        "inject_keys",
+        "demotion_snapshots",
+        "pipeline_flush",
+        "flush",
+        "push",
+        "submit",
+        "_close_epoch",
+    ):
+        assert name in contracts.MAIN_ONLY, name
+    assert contracts.MAIN_ONLY_MODULES == {
+        "bytewax_tpu.engine.comm",
+        "bytewax_tpu.engine.recovery_store",
+        "bytewax_tpu.engine.residency",
+        "bytewax_tpu.engine.dlq",
+        "bytewax_tpu.engine.webserver",
+    }
+    # The deliberately-shared surface stays exactly the flight-ring/
+    # ledger append paths.
+    assert contracts.WORKER_SAFE == {
+        "note_phase",
+        "note_source_lag",
+        "note_pipeline_stall",
+        "note_flush_depth",
+        "record",
+        "count",
+    }
+    assert contracts.PIPELINE_SUBMIT_METHODS == {"push", "submit"}
+    assert (
+        contracts.PIPELINE_CLASS
+        == "bytewax_tpu.engine.pipeline.DevicePipeline"
+    )
+    diags = _check(["BTX-THREAD"])
+    assert not diags, format_diagnostics(diags)
+
+
+def test_knob_catalog_is_pinned():
+    """The knob inventory: exactly today's 44 BYTEWAX_TPU_* knobs,
+    each with a default and a doc anchor.  Adding a knob requires
+    updating contracts.KNOBS, this list, docs/configuration.md, and
+    the anchor doc — BTX-KNOB enforces the rest (literal reads,
+    staleness, doc mention)."""
+    assert sorted(contracts.KNOBS) == [
+        "BYTEWAX_TPU_ACCEL",
+        "BYTEWAX_TPU_COMPILE_CACHE",
+        "BYTEWAX_TPU_COORDINATOR",
+        "BYTEWAX_TPU_DEMOTE_AFTER",
+        "BYTEWAX_TPU_DIAL_TIMEOUT_S",
+        "BYTEWAX_TPU_DISTRIBUTED",
+        "BYTEWAX_TPU_DLQ_DIR",
+        "BYTEWAX_TPU_EPOCH_STALL_S",
+        "BYTEWAX_TPU_FAULTS",
+        "BYTEWAX_TPU_FAULTS_KINDS",
+        "BYTEWAX_TPU_FAULTS_MIN_GAP_S",
+        "BYTEWAX_TPU_FAULTS_RATE",
+        "BYTEWAX_TPU_FAULTS_SEED",
+        "BYTEWAX_TPU_FAULTS_SITES",
+        "BYTEWAX_TPU_FAULT_DELAY_S",
+        "BYTEWAX_TPU_GC",
+        "BYTEWAX_TPU_GLOBAL_EXCHANGE",
+        "BYTEWAX_TPU_GLOBAL_EXCHANGE_DEBUG",
+        "BYTEWAX_TPU_HB_S",
+        "BYTEWAX_TPU_HEARTBEAT_S",
+        "BYTEWAX_TPU_HOST_STATE_BUDGET",
+        "BYTEWAX_TPU_INGEST_TARGET_ROWS",
+        "BYTEWAX_TPU_IO_BACKOFF_CAP_S",
+        "BYTEWAX_TPU_IO_BACKOFF_S",
+        "BYTEWAX_TPU_IO_RETRIES",
+        "BYTEWAX_TPU_MAX_RESTARTS",
+        "BYTEWAX_TPU_PAD_MAX_POW",
+        "BYTEWAX_TPU_PAD_MIN_POW",
+        "BYTEWAX_TPU_PALLAS",
+        "BYTEWAX_TPU_PIPELINE_DEPTH",
+        "BYTEWAX_TPU_PLATFORM",
+        "BYTEWAX_TPU_POSTMORTEM_DIR",
+        "BYTEWAX_TPU_QUARANTINE",
+        "BYTEWAX_TPU_QUARANTINE_REPROBE_S",
+        "BYTEWAX_TPU_RESCALE",
+        "BYTEWAX_TPU_RESTART_BACKOFF_S",
+        "BYTEWAX_TPU_RESTART_RESET_S",
+        "BYTEWAX_TPU_REUSEPORT",
+        "BYTEWAX_TPU_RX_BUFFER_CAP",
+        "BYTEWAX_TPU_SHARD",
+        "BYTEWAX_TPU_SPILL_DIR",
+        "BYTEWAX_TPU_STATE_BUDGET",
+        "BYTEWAX_TPU_TEXT_DEVICE",
+        "BYTEWAX_TPU_TRACE_DIR",
+    ]
+    assert len(contracts.KNOBS) == 44
+    for name, (default, doc) in contracts.KNOBS.items():
+        assert isinstance(default, str), name
+        assert doc.startswith("docs/") and doc.endswith(".md"), name
+    diags = _check(["BTX-KNOB"])
+    assert not diags, format_diagnostics(diags)
+
+
 def test_ingest_batching_is_process_local():
     """The columnar-ingest PR pin: batch-native sources, coalescing,
     and bucketed padding (engine/batching.py + the connectors) are

@@ -3,11 +3,14 @@ tree must be clean (golden test), via both the API and the CLI entry
 points (``python -m bytewax_tpu.analysis`` is what CI and operators
 run)."""
 
+import re
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 from bytewax_tpu.analysis import analyze_tree
+from bytewax_tpu.analysis.contracts import KNOBS
 from bytewax_tpu.analysis.diagnostics import format_diagnostics
 from bytewax_tpu.analysis.rules import ALL_RULES
 
@@ -15,7 +18,10 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 def test_tree_is_clean():
-    diags, suppressed, project = analyze_tree()
+    timings = {}
+    t0 = time.perf_counter()
+    diags, suppressed, project = analyze_tree(timings=timings)
+    wall = time.perf_counter() - t0
     assert not diags, (
         "the shipped tree violates an engine contract (see "
         "docs/contracts.md):\n" + format_diagnostics(diags)
@@ -25,6 +31,11 @@ def test_tree_is_clean():
     # Sanity: the scan actually covered the engine and the examples.
     assert "bytewax_tpu.engine.driver" in project.modules
     assert any(m.startswith("examples.") for m in project.modules)
+    # Every rule really ran, and the full tree stays fast enough to
+    # run on every CI round (budget well above the ~3s measured, far
+    # below the ~5s ceiling the analyzer tooling targets).
+    assert set(timings) == set(ALL_RULES) | {"<call-graph>"}
+    assert wall < 30, f"analyzer took {wall:.1f}s on the tree"
 
 
 def test_rule_registry_is_complete():
@@ -35,7 +46,51 @@ def test_rule_registry_is_complete():
         "BTX-FAULT",
         "BTX-SNAPSHOT",
         "BTX-BACKEND",
+        "BTX-DRAIN",
+        "BTX-THREAD",
+        "BTX-KNOB",
     }
+
+
+def test_docs_rule_catalog_matches_registry():
+    """docs/contracts.md's rule-catalog table lists exactly the
+    analyzer's rule ids — a rule without a catalog entry (or a
+    catalog row for a deleted rule) is doc drift, failed here."""
+    text = (REPO / "docs" / "contracts.md").read_text()
+    catalog = text.split("## Rule catalog", 1)[1].split("##", 1)[0]
+    documented = set(
+        re.findall(r"^\|\s*`(BTX-[A-Z]+)`", catalog, re.MULTILINE)
+    )
+    assert documented == set(ALL_RULES), (
+        "docs/contracts.md rule catalog drifted from the registry: "
+        f"doc-only {sorted(documented - set(ALL_RULES))}, "
+        f"undocumented {sorted(set(ALL_RULES) - documented)}"
+    )
+
+
+def test_docs_knob_table_matches_catalog():
+    """docs/configuration.md's reference table lists exactly the
+    pinned KNOBS catalog (names AND defaults) — the table is
+    generated from the catalog and must not drift."""
+    text = (REPO / "docs" / "configuration.md").read_text()
+    rows = dict(
+        re.findall(
+            r"^\|\s*`(BYTEWAX_TPU_[A-Z0-9_]+)`\s*\|\s*(?:`([^`|]*)`)?\s*\|",
+            text,
+            re.MULTILINE,
+        )
+    )
+    assert set(rows) == set(KNOBS), (
+        "docs/configuration.md knob table drifted from "
+        "contracts.KNOBS: doc-only "
+        f"{sorted(set(rows) - set(KNOBS))}, missing "
+        f"{sorted(set(KNOBS) - set(rows))}"
+    )
+    for name, (default, _doc) in KNOBS.items():
+        assert rows[name] == default, (
+            f"{name}: doc default {rows[name]!r} != catalog "
+            f"{default!r}"
+        )
 
 
 def test_cli_exits_zero_on_shipped_tree():
@@ -63,3 +118,70 @@ def test_cli_exits_nonzero_on_positive_fixture():
     )
     assert res.returncode == 1, res.stdout + res.stderr
     assert "BTX-SEND" in res.stdout
+
+
+def test_cli_exits_nonzero_on_each_new_rule_fixture():
+    fixtures = REPO / "tests" / "analysis_fixtures"
+    for name, rule in (
+        ("fixture_drain_per_batch.py", "BTX-DRAIN"),
+        ("fixture_thread_worker_send.py", "BTX-THREAD"),
+        ("fixture_knob_uncataloged.py", "BTX-KNOB"),
+    ):
+        res = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "bytewax_tpu.analysis",
+                "--rule",
+                rule,
+                str(fixtures / name),
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=120,
+        )
+        assert res.returncode == 1, (name, res.stdout, res.stderr)
+        assert rule in res.stdout, (name, res.stdout)
+
+
+def test_cli_rule_filter_json_and_timings():
+    """The CI surface: --rule filtering, --json output, --timings
+    per-rule wall times."""
+    fixture = (
+        REPO
+        / "tests"
+        / "analysis_fixtures"
+        / "fixture_knob_uncataloged.py"
+    )
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.analysis",
+            "--rule",
+            "BTX-KNOB",
+            "--json",
+            "--timings",
+            str(fixture),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    import json
+
+    assert res.returncode == 1, res.stdout + res.stderr
+    records = [
+        json.loads(line) for line in res.stdout.strip().splitlines()
+    ]
+    assert records and all(r["rule"] == "BTX-KNOB" for r in records)
+    timing_lines = [
+        json.loads(line)
+        for line in res.stderr.splitlines()
+        if line.startswith("{")
+    ]
+    assert timing_lines and "BTX-KNOB" in timing_lines[0]["timings_s"]
+    # Only the requested rule ran.
+    assert "BTX-SEND" not in timing_lines[0]["timings_s"]
