@@ -1051,6 +1051,164 @@ def _run_rescale_resume():
                 os.environ[k] = v
 
 
+def _run_graceful_stop():
+    """Stop-request-to-clean-exit wall time, in seconds.
+
+    A single-process keyed flow with a recovery store takes a
+    cooperative stop request mid-stream (the in-process equivalent of
+    SIGTERM / ``POST /stop``; docs/recovery.md "Graceful
+    drain-to-stop"): the run loop drains to the next epoch close —
+    pipelines flushed, snapshots committed — and returns a typed
+    ``GracefulStop``.  Reported is request → ``run_main`` returning:
+    the whole drain + teardown.  Compare ``restart_recovery_s`` (the
+    crash path on the same flow shape): the graceful path commits
+    instead of replaying, so a stop-and-relaunch cycle pays no
+    recovery at all.
+    """
+    import tempfile
+    from datetime import timedelta
+
+    import bytewax_tpu.operators as op
+    from bytewax_tpu import xla
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine import driver as _driver
+    from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+    from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+    t_req = [None]
+
+    def trig(kv):
+        if t_req[0] is None and kv[1] == 1500.0:
+            t_req[0] = time.perf_counter()
+            _driver.request_stop()
+        return kv
+
+    with tempfile.TemporaryDirectory() as td:
+        init_db_dir(td, 1)
+        inp = [(f"k{i % 8}", float(i)) for i in range(20000)]
+        out = []
+        flow = Dataflow("graceful_stop_bench_df")
+        s = op.input("inp", flow, TestingSource(inp, batch_size=16))
+        s = op.map("trig", s, trig)
+        r = op.reduce_final("sum", s, xla.SUM)
+        op.output("out", r, TestingSink(out))
+        status = run_main(
+            flow,
+            epoch_interval=timedelta(0),
+            recovery_config=RecoveryConfig(td),
+        )
+        dt = (
+            time.perf_counter() - t_req[0]
+            if t_req[0] is not None
+            else None
+        )
+    if status is None or dt is None:
+        msg = "graceful stop did not trigger"
+        raise RuntimeError(msg)
+    return dt
+
+
+def _run_autoscale_grow():
+    """Grow-decision-to-first-epoch-close-at-the-new-size wall time
+    (the graceful autoscale path), in seconds.
+
+    An in-process 2-lane cluster runs a keyed flow (5k keys through
+    the device tier); mid-stream the grow decision lands — exactly
+    what the outer supervisor does on a confirmed ``rescale_hint``,
+    minus the HTTP hop: a graceful stop (the drained epoch commits;
+    zero replayed epochs), then a relaunch at 3 lanes with
+    ``BYTEWAX_TPU_RESCALE=1`` paying driver build + startup migration
+    + state reload, until the first epoch close at the new size.
+    The graceful sibling of ``rescale_resume_s`` (whose stop is a
+    mid-stream EOF).
+    """
+    import tempfile
+    from datetime import timedelta
+
+    import bytewax_tpu.operators as op
+    from bytewax_tpu import xla
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine import driver as _driver
+    from bytewax_tpu.engine import flight
+    from bytewax_tpu.engine.driver import cluster_main
+    from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+    from bytewax_tpu.testing import TestingSink, TestingSource
+
+    n_keys = 5000
+    env_keys = ("BYTEWAX_TPU_RESCALE", "BYTEWAX_FLIGHT_RECORDER")
+    saved = {k: os.environ.get(k) for k in env_keys}
+    os.environ["BYTEWAX_FLIGHT_RECORDER"] = "1"
+    main_rec = flight.RECORDER
+    flight.RECORDER = flight.FlightRecorder(1 << 15)
+    flight.RECORDER.activate(True)
+
+    t_req = [None]
+
+    def trig(kv):
+        if t_req[0] is None and kv[1] == -1.0:
+            t_req[0] = time.time()
+            _driver.request_stop()
+        return kv
+
+    def flow_of(items, out):
+        flow = Dataflow("autoscale_bench_df")
+        s = op.input(
+            "inp", flow, TestingSource(items, batch_size=256)
+        )
+        s = op.map("trig", s, trig)
+        scored = op.stateful_map("ema", s, xla.ema(0.3))
+        op.output("out", scored, TestingSink(out))
+        return flow
+
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            init_db_dir(td, 2)
+            inp = [
+                (f"k{i % n_keys:05d}", float(i % 97))
+                for i in range(2 * n_keys)
+            ]
+            half = len(inp) // 2
+            items = inp[:half] + [("stop", -1.0)] + inp[half:]
+            status = cluster_main(
+                flow_of(items, []),
+                [],
+                0,
+                worker_count_per_proc=2,
+                epoch_interval=timedelta(0),
+                recovery_config=RecoveryConfig(td),
+            )
+            if status is None or t_req[0] is None:
+                msg = "graceful stop did not trigger"
+                raise RuntimeError(msg)
+            os.environ["BYTEWAX_TPU_RESCALE"] = "1"
+            t_resume = time.time()
+            cluster_main(
+                flow_of(items, []),
+                [],
+                0,
+                worker_count_per_proc=3,
+                epoch_interval=timedelta(0),
+                recovery_config=RecoveryConfig(td),
+            )
+        events = flight.RECORDER.tail(1 << 15)
+        if not any(e["kind"] == "rescale" for e in events):
+            msg = "rescale migration did not run"
+            raise RuntimeError(msg)
+        first_close_t = next(
+            e["t"]
+            for e in events
+            if e["kind"] == "epoch_close" and e["t"] >= t_resume
+        )
+        return first_close_t - t_req[0]
+    finally:
+        flight.RECORDER = main_rec
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _run_residency_stress(
     n_rows: int = 100_000, n_keys: int = 4096, budget: int = 64
 ):
@@ -1387,6 +1545,24 @@ def main() -> None:
     except Exception as ex:  # noqa: BLE001 - bench must still report
         extra["rescale_resume_s"] = None
         extra["rescale_resume_error"] = str(ex)[:200]
+
+    # Graceful drain-to-stop (docs/recovery.md): stop request →
+    # clean exit with the in-flight epoch committed — the drain the
+    # autoscaler pays instead of the crash path's recovery replay.
+    try:
+        extra["graceful_stop_s"] = round(_run_graceful_stop(), 3)
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["graceful_stop_s"] = None
+        extra["graceful_stop_error"] = str(ex)[:200]
+
+    # The closed autoscaling loop's end-to-end pause: grow decision →
+    # graceful drain → relaunch at the new size with the startup
+    # migration → first epoch close at the new size.
+    try:
+        extra["autoscale_grow_s"] = round(_run_autoscale_grow(), 3)
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["autoscale_grow_s"] = None
+        extra["autoscale_grow_error"] = str(ex)[:200]
 
     # Tiered key-state residency under stress (cardinality >> budget;
     # docs/state-residency.md): throughput with continuous evict/

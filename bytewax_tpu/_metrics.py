@@ -17,6 +17,7 @@ from prometheus_client.exposition import generate_latest
 __all__ = [
     "DURATION_BUCKETS",
     "DURATION_HISTOGRAMS",
+    "autoscale_actions_count",
     "barrier_wait_seconds",
     "comm_bytes",
     "comm_fenced_frames",
@@ -256,6 +257,16 @@ step_demotion_count = Counter(
     "Stateful steps demoted from the device tier to the host tier "
     "after consecutive device faults",
     ["step_id"],
+)
+
+autoscale_actions_count = Counter(
+    "bytewax_autoscale_actions_count",
+    "Actions taken by the outer cluster supervisor "
+    "(python -m bytewax_tpu.supervise): action=grow|shrink is a "
+    "coordinated graceful stop + relaunch at a new size acting on "
+    "rescale_hint; action=relaunch is a hard-dead child process "
+    "respawned in place",
+    ["action"],
 )
 
 

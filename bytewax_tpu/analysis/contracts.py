@@ -559,6 +559,14 @@ WORKER_SAFE = frozenset(
 #: reference and must list exactly these names.
 KNOBS: Dict[str, Tuple[str, str]] = {
     "BYTEWAX_TPU_ACCEL": ("1", "docs/configuration.md"),
+    "BYTEWAX_TPU_ALLOW_REMOTE_STOP": ("0", "docs/deployment.md"),
+    "BYTEWAX_TPU_AUTOSCALE_COOLDOWN_S": ("30", "docs/deployment.md"),
+    "BYTEWAX_TPU_AUTOSCALE_HYSTERESIS": ("3", "docs/deployment.md"),
+    "BYTEWAX_TPU_AUTOSCALE_POLL_S": ("2", "docs/deployment.md"),
+    "BYTEWAX_TPU_AUTOSCALE_STOP_TIMEOUT_S": (
+        "60",
+        "docs/deployment.md",
+    ),
     "BYTEWAX_TPU_COMPILE_CACHE": ("", "docs/performance.md"),
     "BYTEWAX_TPU_COORDINATOR": ("", "docs/deployment.md"),
     "BYTEWAX_TPU_DEMOTE_AFTER": ("3", "docs/recovery.md"),

@@ -20,8 +20,10 @@ import contextlib
 import os
 import pickle
 import random
+import threading
 import time
 import zlib
+from collections import deque
 from datetime import datetime, timedelta, timezone
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -37,6 +39,7 @@ from bytewax_tpu.engine.dlq import DeadLetterQueue
 from bytewax_tpu.errors import (
     DeviceFault,
     EpochStalled,
+    GracefulStop,
     TransientIOError,
     TransientSinkError,
     TransientSourceError,
@@ -61,7 +64,13 @@ from bytewax_tpu.native import (
 from bytewax_tpu.tracing import span as _span, spans_active as _spans_active
 from bytewax_tpu.outputs import DynamicSink, FixedPartitionedSink
 
-__all__ = ["cluster_main", "run_main"]
+__all__ = [
+    "cluster_main",
+    "request_stop",
+    "reset_stop",
+    "run_main",
+    "stop_requested",
+]
 
 _EMPTY_COOLDOWN = timedelta(milliseconds=1)
 _DEFAULT_EPOCH_INTERVAL = timedelta(seconds=10)
@@ -200,6 +209,47 @@ _RESTARTABLE = (
 
 def _max_restarts() -> int:
     return int(os.environ.get("BYTEWAX_TPU_MAX_RESTARTS", "0") or 0)
+
+
+#: Cooperative stop flag for this process (docs/recovery.md "Graceful
+#: drain-to-stop").  An Event, not a driver attribute, because the
+#: setters live outside the driver's lifetime: the CLI's
+#: SIGTERM/SIGINT handlers install before the driver exists, the API
+#: server's ``POST /stop`` runs on its own thread, and a supervised
+#: restart rebuilds the driver while the request must survive.
+_STOP_EVENT = threading.Event()
+
+
+def request_stop(source: str = "api") -> None:
+    """Request a graceful drain-to-stop of the execution running (or
+    about to run) in this process.
+
+    The run loop observes the flag and drains to a stop at the next
+    epoch close — a globally-ordered, pipeline-drained point: the
+    epoch's snapshots and DLQ flush commit exactly as usual, in a
+    cluster every process agrees on the stop via the existing
+    epoch-close sync round (no new control-frame kinds), and the
+    entry point returns a typed :class:`~bytewax_tpu.errors.GracefulStop`
+    instead of unwinding through the restart supervisor.  Safe to
+    call from any thread or signal handler.
+    """
+    already = _STOP_EVENT.is_set()
+    _STOP_EVENT.set()
+    if not already:
+        _flight.note_stop_requested(source)
+
+
+def stop_requested() -> bool:
+    """Whether a graceful stop has been requested on this process."""
+    return _STOP_EVENT.is_set()
+
+
+def reset_stop() -> None:
+    """Clear a pending stop request (entry points consume it
+    implicitly when they return — a stop targets one execution, not
+    the process forever; a request made BEFORE the entry point is
+    honored by that execution at its first epoch close)."""
+    _STOP_EVENT.clear()
 
 
 def _enable_compile_cache(cache_dir: str) -> None:
@@ -376,8 +426,11 @@ def _backoff_delay(
 
 def _supervised(
     make: Callable[[int], "_Driver"], proc_id: int = 0
-) -> None:
-    """Run a driver under the restart supervisor.
+) -> Optional[GracefulStop]:
+    """Run a driver under the restart supervisor.  Returns the
+    driver's completion status: a typed
+    :class:`~bytewax_tpu.errors.GracefulStop` after a cooperative
+    drain-to-stop, ``None`` after an EOF completion.
 
     ``make(generation)`` builds a fresh driver (re-opening the
     recovery store recomputes ``resume_from()``, so each generation
@@ -411,44 +464,58 @@ def _supervised(
     rng = _backoff.seeded_rng("restart", proc_id)
     attempt = 0
     generation = 0
-    while True:
-        started = time.monotonic()
-        try:
-            make(generation).run()
-            return
-        except _RESTARTABLE as ex:
-            # Crash post-mortem (BYTEWAX_TPU_POSTMORTEM_DIR): the
-            # flight ring tail, counters, and the in-flight epoch's
-            # ledger, written before any restart decision so the
-            # evidence survives whether this burst restarts or gives
-            # up.  ``generation`` is still the generation that failed.
-            _flight.write_postmortem(
-                proc_id, generation, type(ex).__name__, str(ex)
-            )
-            if time.monotonic() - started >= reset_s:
-                attempt = 0  # healthy run: new failure burst
-            if attempt >= max_restarts:
-                raise
-            attempt += 1
-            generation += 1
-            base = float(
-                os.environ.get("BYTEWAX_TPU_RESTART_BACKOFF_S", "0.5")
-                or 0.5
-            )
-            delay = _backoff_delay(base, attempt, rng)
-            _flight.note_restart(attempt, type(ex).__name__, delay)
-            import logging
+    try:
+        while True:
+            started = time.monotonic()
+            try:
+                return make(generation).run()
+            except _RESTARTABLE as ex:
+                # Crash post-mortem (BYTEWAX_TPU_POSTMORTEM_DIR): the
+                # flight ring tail, counters, and the in-flight
+                # epoch's ledger, written before any restart decision
+                # so the evidence survives whether this burst
+                # restarts or gives up.  ``generation`` is still the
+                # generation that failed.
+                _flight.write_postmortem(
+                    proc_id, generation, type(ex).__name__, str(ex)
+                )
+                if time.monotonic() - started >= reset_s:
+                    attempt = 0  # healthy run: new failure burst
+                if attempt >= max_restarts:
+                    raise
+                attempt += 1
+                generation += 1
+                base = float(
+                    os.environ.get(
+                        "BYTEWAX_TPU_RESTART_BACKOFF_S", "0.5"
+                    )
+                    or 0.5
+                )
+                delay = _backoff_delay(base, attempt, rng)
+                _flight.note_restart(attempt, type(ex).__name__, delay)
+                import logging
 
-            logging.getLogger(__name__).warning(
-                "worker fault (%s: %s); supervised restart %d/%d "
-                "in %.2fs",
-                type(ex).__name__,
-                ex,
-                attempt,
-                max_restarts,
-                delay,
-            )
-            time.sleep(delay)
+                logging.getLogger(__name__).warning(
+                    "worker fault (%s: %s); supervised restart %d/%d "
+                    "in %.2fs",
+                    type(ex).__name__,
+                    ex,
+                    attempt,
+                    max_restarts,
+                    delay,
+                )
+                time.sleep(delay)
+    finally:
+        # A stop request targets one execution: consume it when this
+        # invocation ends (graceful stop, EOF, or a fatal unwind) so
+        # it cannot leak into the next entry-point call.  It is NOT
+        # cleared at entry — a request that arrived before the run
+        # loop existed (a k8s SIGTERM during the slow jax/flow
+        # import, an embedder calling request_stop() just before
+        # run_main) must stop that execution at its first epoch
+        # close — and it deliberately survives supervised restarts
+        # within the invocation.
+        _STOP_EVENT.clear()
 
 
 class _StepError(RuntimeError):
@@ -2706,6 +2773,16 @@ class _Driver:
         #: /healthz readiness: True once run startup (mesh handshake,
         #: agreement round, rescale migration, runtime builds) is done.
         self._ready = False
+        #: Set when an epoch close's sync round agreed the cluster
+        #: stops (any process voted stop): every process breaks out of
+        #: its run loop after that close and returns GracefulStop.
+        self._stop_agreed = False
+        #: Recent rescale-hint advice, appended at epoch close (rate
+        #: limited) so an external autoscaler's K-consecutive-poll
+        #: hysteresis reads the engine's own history instead of
+        #: re-deriving it from raw signals (docs/recovery.md).
+        self._hint_log: deque = deque(maxlen=64)
+        self._last_hint_at = float("-inf")
 
     # -- cluster topology --------------------------------------------------
 
@@ -2796,6 +2873,18 @@ class _Driver:
 
         epoch_close_duration_seconds.observe(dt)
         _flight.RECORDER.note_epoch_close(closing, dt)
+        # Rescale-hint history: one advice sample per wall-clock
+        # second at most (interval-0 flows close per loop iteration;
+        # the percentile math must stay off that hot path), appended
+        # at the close — the main thread — and read racily by
+        # /status like every other observability surface.
+        now_hint = time.monotonic()
+        if now_hint - self._last_hint_at >= 1.0:
+            self._last_hint_at = now_hint
+            advice, _reasons, _signals = self._hint_advice()
+            self._hint_log.append(
+                {"epoch": closing, "advice": advice, "t": time.time()}
+            )
         if self._gc_managed:
             # Deterministic collection points: the cycle collector is
             # off during the hot loop (its periodic full scans over a
@@ -2875,19 +2964,39 @@ class _Driver:
             with self._ledger_phase("snapshot"):
                 for rt in self.rts:
                     rt.epoch_snaps()  # still clears awoken sets
-        if self.comm is not None and self._flight_sync:
-            # Telemetry piggyback on the epoch-close sync ladder:
-            # one gsync round carrying each process's compact
-            # flight-recorder summary, so any process's /status shows
-            # the whole cluster.  Legal control-plane metadata — an
-            # uncounted gsync frame at a globally-ordered point; the
-            # gate was agreed cluster-wide by the startup "fcfg"
-            # round, so every process runs the same round sequence.
+        if self.comm is not None:
+            # Epoch-close sync round: the graceful-stop vote plus the
+            # telemetry piggyback.  One gsync round at a globally-
+            # ordered point (every process reaches this exactly once
+            # per close_epoch broadcast), UNCONDITIONAL so the stop
+            # vote always has a ride — the startup "fcfg" round now
+            # only gates whether the summary payload is populated,
+            # not whether the round runs, keeping the round sequence
+            # identical across processes by construction.  Any
+            # process voting stop stops the whole cluster after this
+            # (already committed) close; no new control-frame kinds.
+            payload = {
+                "stop": _STOP_EVENT.is_set(),
+                "summary": (
+                    _flight.RECORDER.summary(self.epoch)
+                    if self._flight_sync
+                    else None
+                ),
+            }
             replies = self.global_sync(
-                ("fstat", self.next_gsync_tag()),
-                _flight.RECORDER.summary(self.epoch),
+                ("fstat", self.next_gsync_tag()), payload
             )
-            _flight.RECORDER.cluster = dict(sorted(replies.items()))
+            if any(r["stop"] for r in replies.values()):
+                self._stop_agreed = True
+            if self._flight_sync:
+                _flight.RECORDER.cluster = {
+                    pid: r["summary"]
+                    for pid, r in sorted(replies.items())
+                }
+        elif _STOP_EVENT.is_set():
+            # Single process (or in-process lanes): nothing to agree
+            # with — the close that just committed is the stop point.
+            self._stop_agreed = True
         self.epoch += 1
         _faults.set_epoch(self.epoch)
         _flight.RECORDER.record("epoch_open", epoch=self.epoch)
@@ -3154,14 +3263,13 @@ class _Driver:
             )
         self._rescale_from = None
 
-    def _rescale_hint(self) -> Dict[str, Any]:
-        """The ``/status`` rescale recommendation (docs/recovery.md):
-        a ``grow``/``shrink``/``hold`` advice derived from epoch-close
-        latency, pipeline flush stalls, and residency restore/spill
-        pressure, for an external autoscaler (or the operator) to
-        stop the cluster and relaunch it at a better size with
-        ``--rescale``.  Read racily off the API-server thread —
-        observability, not the epoch protocol."""
+    def _hint_advice(
+        self,
+    ) -> Tuple[str, List[str], Dict[str, Any]]:
+        """One rescale-advice sample: ``(advice, reasons, signals)``
+        from the engine's current load signals (the pure
+        :func:`derive_rescale_hint` over the flight counters and the
+        epoch ledger's attribution)."""
         rec = _flight.RECORDER
         counters = rec.counters
         closes = max(int(counters.get("epoch_close_count", 0)), 1)
@@ -3189,23 +3297,38 @@ class _Driver:
             spill_bytes_per_close=spill_bytes_per_close,
             phase_fractions=phase_fractions,
         )
+        signals = {
+            "worker_count": self.worker_count,
+            "epoch_interval_s": interval_s,
+            "epoch_close_p99_s": close_p99_s,
+            "flush_stall_s_per_close": round(stall_s_per_close, 6),
+            "restores_per_close": round(restores_per_close, 3),
+            "spill_bytes_per_close": round(spill_bytes_per_close, 1),
+            "epoch_closes": int(counters.get("epoch_close_count", 0)),
+            "phase_fractions": phase_fractions,
+        }
+        return advice, reasons, signals
+
+    def _rescale_hint(self) -> Dict[str, Any]:
+        """The ``/status`` rescale recommendation (docs/recovery.md):
+        a ``grow``/``shrink``/``hold`` advice derived from epoch-close
+        latency, pipeline flush stalls, and residency restore/spill
+        pressure, for an external autoscaler (or the operator) to
+        stop the cluster and relaunch it at a better size with
+        ``--rescale``.  ``history`` is the engine's own recent advice
+        samples (appended at epoch close, at most one per second), so
+        a K-consecutive-poll hysteresis decision reads recorded
+        history instead of re-deriving the signals.  Read racily off
+        the API-server thread — observability, not the epoch
+        protocol."""
+        advice, reasons, signals = self._hint_advice()
         return {
             "advice": advice,
             "reasons": reasons,
-            "signals": {
-                "worker_count": self.worker_count,
-                "epoch_interval_s": interval_s,
-                "epoch_close_p99_s": close_p99_s,
-                "flush_stall_s_per_close": round(stall_s_per_close, 6),
-                "restores_per_close": round(restores_per_close, 3),
-                "spill_bytes_per_close": round(
-                    spill_bytes_per_close, 1
-                ),
-                "epoch_closes": int(
-                    counters.get("epoch_close_count", 0)
-                ),
-                "phase_fractions": phase_fractions,
-            },
+            "signals": signals,
+            "history": _flight.FlightRecorder._copied(
+                lambda: list(self._hint_log), []
+            ),
         }
 
     def _status(self) -> Dict[str, Any]:
@@ -3241,6 +3364,7 @@ class _Driver:
             },
             "rescale_hint": self._rescale_hint(),
             "epoch": self.epoch,
+            "stopping": _STOP_EVENT.is_set() or self._stop_agreed,
             "eof": bool(rts) and all(rt.eof for rt in rts),
             "queue_depths": {
                 rt.op.step_id: sum(len(q) for q in rt.queues.values())
@@ -3274,15 +3398,25 @@ class _Driver:
         round, any rescale migration, and the runtime builds all
         completed (the server only starts after them, so an
         in-startup or mid-restart-backoff process simply refuses the
-        connection — also not ready)."""
+        connection — also not ready).  Once a graceful stop is
+        requested the state flips to ``draining`` and readiness drops
+        (HTTP 503), so external probes/k8s stop routing new work to a
+        cluster that is winding down while liveness stays green."""
+        draining = _STOP_EVENT.is_set() or self._stop_agreed
         return {
-            "ready": self._ready,
+            "ready": self._ready and not draining,
+            "draining": draining,
+            "state": (
+                "draining"
+                if draining
+                else ("ready" if self._ready else "starting")
+            ),
             "proc_id": self.proc_id,
             "generation": self.generation,
             "epoch": self.epoch,
         }
 
-    def run(self) -> None:
+    def run(self) -> Optional[GracefulStop]:
         clustered = self.comm is not None
 
         # Flight recorder: ring writes on only when someone can look
@@ -3387,6 +3521,7 @@ class _Driver:
             status_fn=self._status,
             port_offset=self.api_port_offset,
             health_fn=self._health,
+            stop_fn=lambda: request_stop("http"),
         )
         self._ready = True
 
@@ -3422,7 +3557,10 @@ class _Driver:
                     epoch_started = time.monotonic()
                     self._reports = {}
                     self._last_report = None
-                    if final:
+                    if final or self._stop_agreed:
+                        # EOF, or the close's sync round agreed the
+                        # cluster stops: every process saw the same
+                        # votes, so all exit after this same close.
                         break
 
                 if clustered:
@@ -3457,9 +3595,10 @@ class _Driver:
                     if all(rt.eof for rt in self.rts):
                         self._close_epoch()
                         break
-                    if elapsed >= interval_s and (
-                        interval_s > 0 or self._progressed
-                    ):
+                    if (
+                        elapsed >= interval_s
+                        and (interval_s > 0 or self._progressed)
+                    ) or _STOP_EVENT.is_set():
                         # Quiesce the dispatch pipelines INLINE before
                         # the close (no new input may sneak in
                         # between): each flush emits into downstream
@@ -3472,11 +3611,21 @@ class _Driver:
                             for rt in self.rts:
                                 rt.drain()
                         self._close_epoch()
+                        if self._stop_agreed:
+                            # Graceful drain-to-stop: the close above
+                            # committed this epoch's snapshots/DLQ, so
+                            # a resume replays zero epochs.
+                            break
                         epoch_started = time.monotonic()
                 else:
-                    want_close = elapsed >= interval_s and (
-                        interval_s > 0 or self._progressed or self._holding
-                    )
+                    want_close = (
+                        elapsed >= interval_s
+                        and (
+                            interval_s > 0
+                            or self._progressed
+                            or self._holding
+                        )
+                    ) or _STOP_EVENT.is_set()
                     if self.stall_s > 0:
                         # Watchdog clock: time spent WANTING an epoch
                         # close (or holding the barrier) without one
@@ -3611,6 +3760,15 @@ class _Driver:
         if not aborted:
             for rt in self.rts:
                 rt.close()
+        if self._stop_agreed:
+            status = GracefulStop(
+                self.epoch - 1,
+                generation=self.generation,
+                proc_id=self.proc_id,
+            )
+            _flight.note_graceful_stop(status.epoch)
+            return status
+        return None
 
 
 def run_main(
@@ -3618,11 +3776,16 @@ def run_main(
     *,
     epoch_interval: Optional[timedelta] = None,
     recovery_config: Optional[Any] = None,
-) -> None:
+) -> Optional[GracefulStop]:
     """Execute a dataflow in the current process with one worker lane.
 
     Blocks until execution is complete.  Entry-point parity with the
-    reference's ``run_main`` (``src/run.rs:114-146``).
+    reference's ``run_main`` (``src/run.rs:114-146``).  Returns
+    ``None`` on EOF completion, or a typed
+    :class:`~bytewax_tpu.errors.GracefulStop` when a cooperative stop
+    request (SIGTERM/SIGINT via the CLI, ``POST /stop``, or
+    :func:`request_stop`) drained the execution at an epoch close —
+    the resumed store then replays zero epochs.
 
     :arg flow: Dataflow to run.
     :arg epoch_interval: System time length of each epoch (snapshot
@@ -3642,7 +3805,7 @@ def run_main(
     ``BYTEWAX_TPU_RESCALE=1``), in which case the keyed state is
     re-sharded at startup (docs/recovery.md).
     """
-    _supervised(
+    return _supervised(
         lambda gen: _Driver(
             flow,
             worker_count=1,
@@ -3662,7 +3825,7 @@ def cluster_main(
     epoch_interval: Optional[timedelta] = None,
     recovery_config: Optional[Any] = None,
     worker_count_per_proc: int = 1,
-) -> None:
+) -> Optional[GracefulStop]:
     """Execute a dataflow in the current process as part of a cluster.
 
     Entry-point parity with the reference's ``cluster_main``
@@ -3689,8 +3852,15 @@ def cluster_main(
     one globally-ordered re-entry point — before any epoch
     processing, preserving exactly-once via the truncating-sink
     resume (docs/recovery.md).
+
+    Returns ``None`` on EOF completion, or a typed
+    :class:`~bytewax_tpu.errors.GracefulStop` after a cooperative
+    drain-to-stop: a stop requested on ANY process rides the
+    epoch-close sync round, every process commits the same final
+    epoch, and all exit cleanly together (docs/recovery.md "Graceful
+    drain-to-stop").
     """
-    _supervised(
+    return _supervised(
         lambda gen: _Driver(
             flow,
             worker_count=worker_count_per_proc,

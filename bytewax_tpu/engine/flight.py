@@ -58,10 +58,12 @@ __all__ = [
     "enabled",
     "ensure_compile_listener",
     "ledger_fractions",
+    "note_autoscale",
     "note_barrier",
     "note_comm",
     "note_demotion",
     "note_dlq",
+    "note_graceful_stop",
     "note_eviction",
     "note_fault",
     "note_fenced",
@@ -78,6 +80,7 @@ __all__ = [
     "note_restart",
     "note_source_lag",
     "note_spill",
+    "note_stop_requested",
     "note_transfer",
     "note_unquarantine",
     "write_postmortem",
@@ -564,6 +567,45 @@ def note_restart(attempt: int, cause: str, backoff_s: float) -> None:
     RECORDER.counters["last_restart_at"] = time.time()
     RECORDER.record(
         "restart", attempt=attempt, cause=cause, backoff_s=backoff_s
+    )
+
+
+def note_stop_requested(source: str) -> None:
+    """A cooperative stop was requested on this process (``signal``,
+    ``http`` for ``POST /stop``, or ``api`` for a direct
+    ``request_stop()`` call); the run loop drains to a stop at the
+    next epoch close."""
+    RECORDER.count("stop_requested_count")
+    RECORDER.counters["stop_requested_at"] = time.time()
+    RECORDER.record("stop_requested", source=source)
+
+
+def note_graceful_stop(epoch: int) -> None:
+    """The execution drained to a clean stop: epoch ``epoch`` closed
+    (snapshots + DLQ committed), the cluster agreed on the stop vote,
+    and the process exits with a :class:`~bytewax_tpu.errors.GracefulStop`
+    status — a resume replays zero epochs."""
+    RECORDER.count("graceful_stop_count")
+    RECORDER.record("graceful_stop", epoch=epoch)
+
+
+def note_autoscale(
+    action: str, from_procs: int, to_procs: int, reason: str = ""
+) -> None:
+    """The outer cluster supervisor (:mod:`bytewax_tpu.supervise`)
+    performed one autoscale action: ``grow``/``shrink`` (a coordinated
+    graceful stop + relaunch at a new size) or ``relaunch`` (a
+    hard-dead child respawned in place)."""
+    from bytewax_tpu._metrics import autoscale_actions_count
+
+    autoscale_actions_count.labels(action).inc()
+    RECORDER.count("autoscale_actions_count")
+    RECORDER.record(
+        "autoscale",
+        action=action,
+        from_procs=from_procs,
+        to_procs=to_procs,
+        reason=reason,
     )
 
 

@@ -17,8 +17,15 @@ Reference parity (``/root/reference/src/webserver/mod.rs``): when
   readiness (HTTP 200 once run startup — mesh handshake, the "fcfg"
   agreement round, any rescale migration, runtime builds — finished;
   503 before that; connection refused while starting up or sleeping
-  out a restart backoff).  Wire it to k8s liveness/readiness probes
-  (docs/deployment.md), and
+  out a restart backoff; 503 with ``"state": "draining"`` once a
+  graceful stop is requested, so probes stop routing new work to a
+  winding-down cluster).  Wire it to k8s liveness/readiness probes
+  (docs/deployment.md),
+- ``POST /stop`` — request a cooperative drain-to-stop
+  (docs/recovery.md "Graceful drain-to-stop"): the flow commits the
+  in-flight epoch at the next close and exits with a typed
+  ``GracefulStop`` status; any one process's ``/stop`` stops the
+  whole cluster via the epoch-close sync round, and
 - ``GET /stacks`` — a ``faulthandler``-style plain-text dump of every
   thread's current Python stack (main loop, pipeline workers, comm),
   for diagnosing a hung barrier without attaching py-spy.
@@ -67,6 +74,32 @@ class _Handler(BaseHTTPRequestHandler):
     flow_json: str = "{}"
     status_fn: Optional[Callable[[], dict]] = None
     health_fn: Optional[Callable[[], dict]] = None
+    stop_fn: Optional[Callable[[], None]] = None
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path != "/stop" or type(self).stop_fn is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        # Cooperative drain-to-stop (docs/recovery.md): flag the run
+        # loop and acknowledge; the flow stops at the next epoch
+        # close, so the response races the exit deliberately — the
+        # caller polls /healthz (``draining``) or waits for the
+        # process to finish.
+        try:
+            type(self).stop_fn()
+            body = json.dumps({"stopping": True}).encode()
+            code = 200
+        except Exception as ex:  # noqa: BLE001 - never 500 the plane
+            body = json.dumps(
+                {"stopping": False, "error": str(ex)}
+            ).encode()
+            code = 500
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802
         code = 200
@@ -121,6 +154,8 @@ class _ApiServer:
     def __init__(self, server: ThreadingHTTPServer, thread: threading.Thread):
         self._server = server
         self._thread = thread
+        #: The bound port (configured port may be 0 = ephemeral).
+        self.port = server.server_address[1]
 
     def shutdown(self) -> None:
         self._server.shutdown()
@@ -133,6 +168,7 @@ def maybe_start_server(
     status_fn: Optional[Callable[[], dict]] = None,
     port_offset: int = 0,
     health_fn: Optional[Callable[[], dict]] = None,
+    stop_fn: Optional[Callable[[], None]] = None,
 ) -> Optional[_ApiServer]:
     """Start the API server if ``BYTEWAX_DATAFLOW_API_ENABLED`` is
     set (to anything but ``0``); returns a handle to shut it down,
@@ -141,8 +177,10 @@ def maybe_start_server(
     ``status_fn`` is a zero-arg callable (supplied by the engine
     driver) returning the live ``/status`` document; ``health_fn``
     returns the ``/healthz`` readiness payload (at minimum a
-    ``ready`` bool — absent means always-ready); ``port_offset``
-    is this process's rank among co-located cluster processes."""
+    ``ready`` bool — absent means always-ready); ``stop_fn`` arms
+    ``POST /stop`` (a cooperative drain-to-stop request — 404 when
+    absent); ``port_offset`` is this process's rank among co-located
+    cluster processes."""
     from bytewax_tpu.engine.flight import _truthy
 
     if not _truthy("BYTEWAX_DATAFLOW_API_ENABLED"):
@@ -171,6 +209,26 @@ def maybe_start_server(
         int(os.environ.get("BYTEWAX_DATAFLOW_API_PORT", _DEFAULT_PORT))
         + port_offset
     )
+    if stop_fn is not None and host not in (
+        "127.0.0.1",
+        "localhost",
+        "::1",
+    ):
+        # POST /stop is the plane's one mutating endpoint and carries
+        # no auth: off loopback (the probe-wiring 0.0.0.0 case) it
+        # would let any network peer drain the whole cluster.  Serve
+        # it there only behind the explicit opt-in knob; the
+        # read-only endpoints stay up either way.
+        if os.environ.get(
+            "BYTEWAX_TPU_ALLOW_REMOTE_STOP", "0"
+        ) in ("", "0"):
+            logger.warning(
+                "POST /stop disabled on non-loopback bind %s; set "
+                "BYTEWAX_TPU_ALLOW_REMOTE_STOP=1 to accept remote "
+                "stop requests (docs/deployment.md)",
+                host,
+            )
+            stop_fn = None
     handler = type(
         "_BoundHandler",
         (_Handler,),
@@ -178,6 +236,7 @@ def maybe_start_server(
             "flow_json": flow_json,
             "status_fn": staticmethod(status_fn),
             "health_fn": staticmethod(health_fn),
+            "stop_fn": staticmethod(stop_fn),
         },
     )
     try:

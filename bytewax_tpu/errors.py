@@ -20,6 +20,7 @@ __all__ = [
     "ClusterPeerDead",
     "DeviceFault",
     "EpochStalled",
+    "GracefulStop",
     "TransientIOError",
     "TransientSinkError",
     "TransientSourceError",
@@ -67,6 +68,38 @@ class EpochStalled(BytewaxRuntimeError):
         super().__init__(msg)
         self.epoch = epoch
         self.stalled_s = stalled_s
+
+
+class GracefulStop:
+    """Typed completion status of a cooperative drain-to-stop
+    (docs/recovery.md "Graceful drain-to-stop").
+
+    Returned — not raised — by ``run_main``/``cluster_main`` when a
+    stop request (SIGTERM/SIGINT, ``POST /stop``, or
+    ``engine.driver.request_stop()``) drained the execution: the
+    in-flight epoch closed normally (pipelines flushed, DLQ flushed,
+    snapshots committed) and every cluster process agreed on the stop
+    via the epoch-close sync round, so resuming the recovery store
+    replays zero epochs.  ``None`` means the flow ran to EOF instead.
+
+    ``epoch`` is the last epoch that closed (and committed) before
+    the exit; a subsequent resume starts at ``epoch + 1``.
+    """
+
+    __slots__ = ("epoch", "generation", "proc_id")
+
+    def __init__(
+        self, epoch: int, *, generation: int = 0, proc_id: int = 0
+    ):
+        self.epoch = epoch
+        self.generation = generation
+        self.proc_id = proc_id
+
+    def __repr__(self) -> str:
+        return (
+            f"GracefulStop(epoch={self.epoch}, "
+            f"generation={self.generation}, proc_id={self.proc_id})"
+        )
 
 
 class DeviceFault(BytewaxRuntimeError):

@@ -17,16 +17,43 @@ addresses).
 import argparse
 import ast
 import inspect
+import logging
 import os
+import signal
 import sys
 from datetime import timedelta
 from pathlib import Path
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
-from bytewax_tpu.engine.driver import cluster_main, run_main
-from bytewax_tpu.recovery import RecoveryConfig
+#: Signals caught before the engine finished importing (below): the
+#: heavy jax/engine import takes seconds, and a k8s SIGTERM landing in
+#: that window must become a graceful stop, not a default kill.  The
+#: stdlib-only early handler records the request;
+#: ``_install_stop_handlers`` converts it into ``request_stop()`` once
+#: the engine is importable.  A second signal (the early handler
+#: restores default handling) stays fatal, so a stuck startup is
+#: killable.
+_EARLY_STOP_SIGNALS: List[int] = []
+
+
+def _early_stop_handler(signum: int, _frame: Any) -> None:
+    _EARLY_STOP_SIGNALS.append(signum)
+    signal.signal(signum, signal.SIG_DFL)
+
+
+if __name__ == "__main__":  # CLI execution only, never plain import
+    for _sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(_sig, _early_stop_handler)
+        except ValueError:  # not the main thread
+            break
+
+from bytewax_tpu.engine.driver import cluster_main, run_main  # noqa: E402
+from bytewax_tpu.recovery import RecoveryConfig  # noqa: E402
 
 __all__ = ["cli_main"]
+
+logger = logging.getLogger("bytewax_tpu")
 
 
 def _prepare_import(import_str: str) -> Tuple[str, str]:
@@ -230,7 +257,47 @@ def _create_arg_parser() -> argparse.ArgumentParser:
         action=_EnvDefault,
         envvar="BYTEWAX_TPU_RESTART_BACKOFF_S",
     )
+    autoscale = parser.add_argument_group(
+        "Autoscaling",
+        "Run under the outer cluster supervisor "
+        "(python -m bytewax_tpu.supervise): it spawns the cluster "
+        "processes, relaunches hard-dead ones, and acts on the "
+        "engine's rescale_hint by gracefully draining the cluster "
+        "and relaunching it at a better size; see docs/deployment.md",
+    )
+    autoscale.add_argument(
+        "--autoscale",
+        type=str,
+        default=None,
+        metavar="MIN:MAX",
+        help="Process-count bounds, e.g. 2:8; implies spawning and "
+        "supervising the whole cluster from this command",
+    )
     return parser
+
+
+def _install_stop_handlers() -> None:
+    """SIGTERM/SIGINT request a graceful drain-to-stop (the flow
+    commits the in-flight epoch at the next close and exits with a
+    GracefulStop status); a second signal restores default handling,
+    so a stuck drain stays killable.  A signal already caught by the
+    early import-window handler above is converted into the stop
+    request here — the request then survives until the execution's
+    first epoch close."""
+    from bytewax_tpu.engine.driver import request_stop
+
+    if _EARLY_STOP_SIGNALS:
+        request_stop("signal")
+
+    def _handler(signum: int, _frame: Any) -> None:
+        signal.signal(signum, signal.SIG_DFL)
+        request_stop("signal")
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _handler)
+        except ValueError:  # not the main thread (embedded use)
+            return
 
 
 def _parse_args(argv=None) -> argparse.Namespace:
@@ -274,11 +341,13 @@ def cli_main(
     addresses: Optional[str] = None,
     epoch_interval: Optional[timedelta] = None,
     recovery_config: Optional[Any] = None,
-) -> None:
-    """Dispatch to ``run_main`` or ``cluster_main`` based on args."""
+) -> Optional[Any]:
+    """Dispatch to ``run_main`` or ``cluster_main`` based on args.
+    Returns the entry point's completion status (``None`` on EOF, a
+    typed ``GracefulStop`` after a cooperative drain-to-stop)."""
     if process_id is not None or (workers_per_process or 0) > 1 or addresses:
         addr_list = addresses.split(";") if addresses else []
-        cluster_main(
+        return cluster_main(
             flow,
             addr_list,
             process_id or 0,
@@ -286,12 +355,11 @@ def cli_main(
             recovery_config=recovery_config,
             worker_count_per_proc=workers_per_process or 1,
         )
-    else:
-        run_main(
-            flow,
-            epoch_interval=epoch_interval,
-            recovery_config=recovery_config,
-        )
+    return run_main(
+        flow,
+        epoch_interval=epoch_interval,
+        recovery_config=recovery_config,
+    )
 
 
 def _main() -> None:
@@ -306,6 +374,32 @@ def _main() -> None:
         )
     if args.rescale:
         os.environ["BYTEWAX_TPU_RESCALE"] = "1"
+    if args.autoscale is not None:
+        # Outer-supervisor mode: this process spawns and watches the
+        # cluster instead of running the flow (the children import
+        # the dataflow; the supervisor never initializes jax).
+        if _EARLY_STOP_SIGNALS:
+            # Termination was requested while this module was still
+            # importing: there is nothing to drain yet — honor it by
+            # not launching the cluster at all.
+            logger.warning(
+                "termination requested during startup; not "
+                "launching the autoscaler"
+            )
+            sys.exit(0)
+        from bytewax_tpu.supervise import autoscale_main
+
+        sys.exit(
+            autoscale_main(
+                args.import_str,
+                args.autoscale,
+                workers_per_process=args.workers_per_process,
+                recovery_directory=args.recovery_directory,
+                snapshot_interval=args.snapshot_interval,
+                backup_interval=args.backup_interval,
+            )
+        )
+    _install_stop_handlers()
     module_str, dataflow_name = _prepare_import(args.import_str)
     flow = _locate_dataflow(module_str, dataflow_name)
     recovery_config = None
@@ -313,7 +407,7 @@ def _main() -> None:
         recovery_config = RecoveryConfig(
             args.recovery_directory, backup_interval=args.backup_interval
         )
-    cli_main(
+    status = cli_main(
         flow,
         workers_per_process=args.workers_per_process,
         process_id=args.process_id,
@@ -321,6 +415,8 @@ def _main() -> None:
         epoch_interval=args.snapshot_interval,
         recovery_config=recovery_config,
     )
+    if status is not None:
+        logger.warning("graceful stop: %r", status)
 
 
 if __name__ == "__main__":

@@ -349,13 +349,23 @@ def test_worker_lane_inventory_is_pinned():
 
 
 def test_knob_catalog_is_pinned():
-    """The knob inventory: exactly today's 44 BYTEWAX_TPU_* knobs,
+    """The knob inventory: exactly today's 49 BYTEWAX_TPU_* knobs,
     each with a default and a doc anchor.  Adding a knob requires
     updating contracts.KNOBS, this list, docs/configuration.md, and
     the anchor doc — BTX-KNOB enforces the rest (literal reads,
-    staleness, doc mention)."""
+    staleness, doc mention).  The autoscaling-loop PR added exactly
+    five: the four BYTEWAX_TPU_AUTOSCALE_* knobs read by the outer
+    supervisor (bytewax_tpu/supervise.py) and
+    BYTEWAX_TPU_ALLOW_REMOTE_STOP (the POST /stop non-loopback
+    opt-in in engine/webserver.py), all anchored at
+    docs/deployment.md."""
     assert sorted(contracts.KNOBS) == [
         "BYTEWAX_TPU_ACCEL",
+        "BYTEWAX_TPU_ALLOW_REMOTE_STOP",
+        "BYTEWAX_TPU_AUTOSCALE_COOLDOWN_S",
+        "BYTEWAX_TPU_AUTOSCALE_HYSTERESIS",
+        "BYTEWAX_TPU_AUTOSCALE_POLL_S",
+        "BYTEWAX_TPU_AUTOSCALE_STOP_TIMEOUT_S",
         "BYTEWAX_TPU_COMPILE_CACHE",
         "BYTEWAX_TPU_COORDINATOR",
         "BYTEWAX_TPU_DEMOTE_AFTER",
@@ -400,12 +410,45 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_TEXT_DEVICE",
         "BYTEWAX_TPU_TRACE_DIR",
     ]
-    assert len(contracts.KNOBS) == 44
+    assert len(contracts.KNOBS) == 49
     for name, (default, doc) in contracts.KNOBS.items():
         assert isinstance(default, str), name
         assert doc.startswith("docs/") and doc.endswith(".md"), name
     diags = _check(["BTX-KNOB"])
     assert not diags, format_diagnostics(diags)
+
+
+def test_supervisor_is_process_local():
+    """The autoscaling-loop PR pin: the outer cluster supervisor
+    (bytewax_tpu/supervise.py) and the graceful-stop surfaces are
+    HTTP + OS process management only.  The frame-kind inventory
+    above is byte-identical (the stop vote rides the EXISTING
+    epoch-close gsync round — no new kinds), no allowlist grew to
+    admit the supervisor, and none of its functions call a raw send
+    primitive, a ship method, or a sync round — so it can never
+    reach the send surface or early-exit a collective tier."""
+    modules = {"bytewax_tpu.supervise"}
+    allowlisted = (
+        set().union(*contracts.SEND_ALLOWED.values())
+        | contracts.GSYNC_CALLER_MODULES
+    )
+    assert not (modules & allowlisted)
+
+    project = _project()
+    assert "bytewax_tpu.supervise" in project.modules
+    forbidden = (
+        contracts.RAW_SEND_METHODS
+        | contracts.SHIP_METHODS
+        | contracts.GSYNC_PRIMITIVES
+    )
+    checked = 0
+    for qual, fn in project.functions.items():
+        mod = qual.split(":", 1)[0]
+        if mod in modules:
+            checked += 1
+            comm_calls = [c.name for c in fn.calls if c.name in forbidden]
+            assert not comm_calls, f"{qual} calls {comm_calls}"
+    assert checked >= 10  # the scan really covered the supervisor
 
 
 def test_ingest_batching_is_process_local():

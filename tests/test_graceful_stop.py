@@ -1,0 +1,290 @@
+"""Graceful drain-to-stop (tentpole of the autoscaling-loop PR;
+docs/recovery.md "Graceful drain-to-stop").
+
+A cooperative stop request (``request_stop()`` / SIGTERM / ``POST
+/stop``) drains the execution at the next epoch close — pipelines
+flushed, DLQ flushed, snapshots committed — and the entry point
+returns a typed ``GracefulStop`` instead of unwinding through the
+supervisor; resuming the store replays ZERO epochs.  Everything here
+is fast and single-process/in-process (tier-1, so the drain path is
+exercised on every run); the clustered stop vote riding the
+epoch-close gsync round is exercised end-to-end by the slow
+supervisor integration tests in ``test_supervise.py``.
+"""
+
+import json
+import urllib.error
+import urllib.request
+from datetime import timedelta
+
+import pytest
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.engine import driver, flight
+from bytewax_tpu.engine.driver import request_stop, run_main
+from bytewax_tpu.engine.recovery_store import RecoveryStore
+from bytewax_tpu.errors import GracefulStop
+from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+from bytewax_tpu.testing import TestingSink, TestingSource
+
+ZERO_TD = timedelta(seconds=0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stop():
+    driver.reset_stop()
+    yield
+    driver.reset_stop()
+
+
+def _sum_flow(inp, out, stop_at=None):
+    """Keyed running-sum flow; when ``stop_at`` is given, a host-tier
+    map step requests a graceful stop the moment that input value
+    passes through — a deterministic in-band stand-in for SIGTERM."""
+    flow = Dataflow("graceful_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=4))
+
+    def trig(kv, _stop_at=stop_at):
+        if _stop_at is not None and kv[1] == _stop_at:
+            request_stop()
+        return kv
+
+    s = op.map("trig", s, trig)
+    s = op.stateful_map(
+        "sum", s, lambda st, v: ((st or 0.0) + v,) * 2
+    )
+    op.output("out", s, TestingSink(out))
+    return flow
+
+
+def _oracle(rows):
+    sums, want = {}, []
+    for k, v in rows:
+        sums[k] = sums.get(k, 0.0) + v
+        want.append((k, sums[k]))
+    return want
+
+
+def test_graceful_stop_commits_and_resumes_with_zero_replay(
+    tmp_path, entry_point
+):
+    # Mid-stream stop under every entry point (single lane and the
+    # in-process cluster lanes): the stop epoch commits, the status
+    # is typed, and the resumed execution starts at exactly the next
+    # epoch — zero replay — with exactly-once output overall.
+    inp = [(f"k{i % 8}", float(i)) for i in range(600)]
+    db = tmp_path / "db"
+    db.mkdir()
+    init_db_dir(db, 2)
+    rc = RecoveryConfig(str(db))
+
+    stops_before = flight.RECORDER.counters.get(
+        "graceful_stop_count", 0
+    )
+    out = []
+    status = entry_point(
+        _sum_flow(inp, out, stop_at=300.0),
+        epoch_interval=ZERO_TD,
+        recovery_config=rc,
+    )
+    assert isinstance(status, GracefulStop)
+    assert (
+        flight.RECORDER.counters.get("graceful_stop_count", 0)
+        == stops_before + 1
+    )
+    n = len(out)
+    assert 0 < n < len(inp), "stop should land mid-stream"
+    # Every consumed row's output landed (keyed batches group per
+    # key, so compare multisets — each running-sum pair is unique).
+    assert sorted(out) == sorted(_oracle(inp)[:n])
+
+    # Zero replayed epochs: the resume point is exactly one past the
+    # epoch the graceful stop committed.
+    store = RecoveryStore(rc.db_dir)
+    resume = store.resume_from()
+    store.close()
+    assert resume.resume_epoch == status.epoch + 1
+
+    # The resumed execution finishes the stream exactly-once.
+    out2 = []
+    status2 = entry_point(
+        _sum_flow(inp, out2),
+        epoch_interval=ZERO_TD,
+        recovery_config=rc,
+    )
+    assert status2 is None
+    assert sorted(out + out2) == sorted(_oracle(inp))
+
+
+def test_stop_request_before_run_is_honored_then_consumed():
+    # A stop requested BEFORE the entry point (the k8s SIGTERM-
+    # during-slow-import shape, or an embedder calling request_stop
+    # just before run_main) must stop that execution at its first
+    # epoch close...
+    request_stop()
+    inp = [(f"k{i % 2}", float(i)) for i in range(64)]
+    out = []
+    status = run_main(_sum_flow(inp, out), epoch_interval=ZERO_TD)
+    assert isinstance(status, GracefulStop)
+    assert len(out) < len(inp)
+    # ...and the request is consumed when the invocation ends: the
+    # next execution runs to EOF (a stop targets one execution, not
+    # the process forever).
+    out2 = []
+    status2 = run_main(_sum_flow(inp, out2), epoch_interval=ZERO_TD)
+    assert status2 is None
+    assert sorted(out2) == sorted(_oracle(inp))
+
+
+def test_health_and_status_report_draining():
+    flow = _sum_flow([("a", 1.0)], [])
+    d = driver._Driver(
+        flow,
+        worker_count=1,
+        epoch_interval=ZERO_TD,
+        recovery_config=None,
+    )
+    h = d._health()
+    assert h["state"] == "starting"
+    assert not h["ready"] and not h["draining"]
+    d._ready = True
+    h = d._health()
+    assert h["ready"] and h["state"] == "ready"
+
+    request_stop()
+    h = d._health()
+    assert h["state"] == "draining"
+    assert h["draining"] and not h["ready"]
+    st = d._status()
+    assert st["stopping"] is True
+    # The hint exposes the advice history list for K-consecutive
+    # hysteresis consumers (empty before any epoch close).
+    assert st["rescale_hint"]["history"] == []
+
+
+def test_rescale_hint_history_recorded_at_epoch_close():
+    inp = [(f"k{i % 4}", float(i)) for i in range(64)]
+    out = []
+    d = driver._Driver(
+        _sum_flow(inp, out),
+        worker_count=1,
+        epoch_interval=ZERO_TD,
+        recovery_config=None,
+    )
+    assert d.run() is None
+    history = d._rescale_hint()["history"]
+    assert history, "epoch closes should record advice samples"
+    for sample in history:
+        assert sample["advice"] in ("grow", "shrink", "hold")
+        assert sample["epoch"] >= 1
+    # Rate limited to one sample per second: a sub-second run with
+    # hundreds of interval-0 closes records just the first.
+    assert len(history) == 1
+
+
+def test_webserver_stop_endpoint_and_draining(tmp_path, monkeypatch):
+    # Unit test of the API-plane surfaces: POST /stop arms the stop
+    # flag, /healthz flips to 503 + draining, /status reports
+    # stopping — with fake fns, no engine run.
+    monkeypatch.chdir(tmp_path)  # the server dumps dataflow.json
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_ENABLED", "1")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_PORT", "0")
+    from bytewax_tpu.engine.webserver import maybe_start_server
+
+    state = {"stop": False}
+
+    def health():
+        draining = state["stop"]
+        return {
+            "ready": not draining,
+            "draining": draining,
+            "state": "draining" if draining else "ready",
+        }
+
+    srv = maybe_start_server(
+        _sum_flow([("a", 1.0)], []),
+        status_fn=lambda: {"stopping": state["stop"]},
+        health_fn=health,
+        stop_fn=lambda: state.__setitem__("stop", True),
+    )
+    assert srv is not None
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as rsp:
+            body = json.loads(rsp.read())
+        assert body["ready"] and not body["draining"]
+
+        req = urllib.request.Request(
+            base + "/stop", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=5) as rsp:
+            assert json.loads(rsp.read())["stopping"] is True
+
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert exc_info.value.code == 503
+        body = json.loads(exc_info.value.read())
+        assert body["draining"] and body["state"] == "draining"
+        assert body["live"], "liveness must stay green while draining"
+
+        with urllib.request.urlopen(base + "/status", timeout=5) as rsp:
+            assert json.loads(rsp.read())["stopping"] is True
+
+        # POST anywhere else stays a 404 (no new surface).
+        req = urllib.request.Request(
+            base + "/nope", data=b"", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc_info.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_webserver_remote_stop_requires_opt_in(tmp_path, monkeypatch):
+    # POST /stop is the plane's one mutating endpoint: on a
+    # non-loopback bind (the k8s probe-wiring case) it is disabled
+    # unless BYTEWAX_TPU_ALLOW_REMOTE_STOP=1 — any network peer
+    # could otherwise drain the whole cluster.
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_ENABLED", "1")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_PORT", "0")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_HOST", "0.0.0.0")
+    from bytewax_tpu.engine.webserver import maybe_start_server
+
+    state = {"stop": False}
+    flow = _sum_flow([("a", 1.0)], [])
+    srv = maybe_start_server(
+        flow, stop_fn=lambda: state.__setitem__("stop", True)
+    )
+    assert srv is not None
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/stop",
+            data=b"",
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc_info.value.code == 404
+        assert state["stop"] is False
+    finally:
+        srv.shutdown()
+
+    monkeypatch.setenv("BYTEWAX_TPU_ALLOW_REMOTE_STOP", "1")
+    srv = maybe_start_server(
+        flow, stop_fn=lambda: state.__setitem__("stop", True)
+    )
+    assert srv is not None
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/stop",
+            data=b"",
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as rsp:
+            assert json.loads(rsp.read())["stopping"] is True
+        assert state["stop"] is True
+    finally:
+        srv.shutdown()
