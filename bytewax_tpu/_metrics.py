@@ -42,6 +42,8 @@ __all__ = [
     "state_resident_keys",
     "state_spill_bytes",
     "step_demotion_count",
+    "wire_bytes_count",
+    "wire_codec_seconds",
     "worker_restart_count",
     "xla_compile_count",
     "xla_compile_seconds",
@@ -209,8 +211,26 @@ comm_frames = Counter(
 
 comm_bytes = Counter(
     "bytewax_comm_bytes",
-    "Cluster-mesh bytes shipped per peer (framed, pickled)",
+    "Cluster-mesh bytes shipped per peer (framed payload bytes; see "
+    "bytewax_wire_bytes_count for the codec split)",
     ["peer", "direction"],
+)
+
+wire_bytes_count = Counter(
+    "bytewax_wire_bytes_count",
+    "Cluster-mesh payload bytes per wire codec (docs/performance.md "
+    "'Columnar exchange'): codec=columnar is the zero-copy record-"
+    "batch framing, codec=pickle the whole-frame fallback "
+    "(control frames, item lists, object-dtype payloads, or "
+    "BYTEWAX_TPU_WIRE=pickle)",
+    ["codec", "direction"],  # direction: tx | rx
+)
+
+wire_codec_seconds = Counter(
+    "bytewax_wire_codec_seconds",
+    "Cumulative seconds spent encoding/decoding cluster-mesh "
+    "payloads, per codec",
+    ["codec", "op"],  # op: encode | decode
 )
 
 

@@ -42,8 +42,28 @@ SEND_ALLOWED: Dict[str, FrozenSet[str]] = {
 #: Raw-send method names on a Comm-typed receiver.
 RAW_SEND_METHODS = frozenset({"send", "broadcast"})
 
-#: The driver's routed send surfaces.
-SHIP_METHODS = frozenset({"ship_deliver", "ship_route"})
+#: The driver's routed send surfaces.  ``ship_flush`` drains the
+#: per-peer route accumulator onto the wire — a send surface like the
+#: other two (it counts frames into the barrier's quiescence math),
+#: but ALSO a drain-only operation (see BTX-DRAIN below): callable
+#: from the pinned drain points only, never from a per-batch path.
+SHIP_METHODS = frozenset({"ship_deliver", "ship_route", "ship_flush"})
+
+#: The columnar wire codec (``engine/wire.py``; docs/performance.md
+#: "Columnar exchange"): pure encode/decode plus the route
+#: accumulator — no sockets, no frames of its own.  Only the comm/
+#: driver pair may call into it (resolved calls into the module from
+#: anywhere else are a BTX-SEND finding): payload encoding is part of
+#: the send surface, and a third caller framing its own payloads
+#: would be a covert channel around the counted ship surfaces.
+WIRE_MODULE = "bytewax_tpu.engine.wire"
+WIRE_ALLOWED_MODULES = frozenset(
+    {
+        "bytewax_tpu.engine.comm",
+        "bytewax_tpu.engine.driver",
+        "bytewax_tpu.engine.wire",
+    }
+)
 
 # ---------------------------------------------------------------------------
 # BTX-FRAMES — the control-frame kind inventory
@@ -337,6 +357,10 @@ DRAIN_ONLY_METHODS = frozenset(
         # epoch-close entry (snapshots + the close sync ladder).
         "_close_epoch",
         "_close_epoch_inner",
+        # the route-accumulator flush (engine/wire.py): frames ship
+        # and count ONLY at poll boundaries / drain points, so the
+        # count-matched barrier sees exactly what left the process.
+        "ship_flush",
     }
 )
 
@@ -437,6 +461,7 @@ MAIN_ONLY = frozenset(
         # send surface / sync rounds
         "ship_deliver",
         "ship_route",
+        "ship_flush",
         "send",
         "broadcast",
         "global_sync",
@@ -613,6 +638,7 @@ KNOBS: Dict[str, Tuple[str, str]] = {
     "BYTEWAX_TPU_STATE_BUDGET": ("", "docs/state-residency.md"),
     "BYTEWAX_TPU_TEXT_DEVICE": ("0", "docs/performance.md"),
     "BYTEWAX_TPU_TRACE_DIR": ("", "docs/observability.md"),
+    "BYTEWAX_TPU_WIRE": ("columnar", "docs/performance.md"),
 }
 
 #: The knob name prefix the rule keys on.

@@ -9,7 +9,12 @@ the regex scan it replaced provably missed that shape) and restricts:
 
 - ``Comm(...)`` construction to ``engine/comm.py`` + ``engine/driver.py``
 - ``send``/``broadcast`` on a Comm-denoting receiver to the same pair
-- ``ship_deliver``/``ship_route`` calls to ``engine/driver.py``
+- ``ship_deliver``/``ship_route``/``ship_flush`` calls to
+  ``engine/driver.py``
+- resolved calls into the columnar wire codec (``engine/wire.py``) to
+  the comm/driver pair — payload encoding is part of the send
+  surface, and a third caller framing its own payloads would be a
+  covert channel around the counted ship surfaces
 """
 
 from typing import List
@@ -40,6 +45,22 @@ _ALLOWED = {
 }
 
 
+def _wire_calls(mod, fn):
+    """Calls in ``fn`` that RESOLVE into the wire codec module —
+    dotted paths (``_wire.encode``) and import-resolved names; the
+    visible-name fallback is excluded so an unrelated ``x.decode()``
+    / ``x.add()`` with an unknown receiver cannot false-fire."""
+    prefix_dot = contracts.WIRE_MODULE + "."
+    prefix_fn = contracts.WIRE_MODULE + ":"
+    for call in fn.calls:
+        if call.dotted is not None and call.dotted.startswith(prefix_dot):
+            yield call.node
+        elif not call.fallback and any(
+            t.startswith(prefix_fn) for t in call.targets
+        ):
+            yield call.node
+
+
 def check(project: Project) -> List[Diagnostic]:
     out: List[Diagnostic] = []
     for mod in project.modules.values():
@@ -57,6 +78,20 @@ def check(project: Project) -> List[Diagnostic]:
                         f"{_WHAT[kind]} in {fn.qualname}; allowed "
                         f"modules: "
                         f"{sorted(_ALLOWED[kind])}",
+                    )
+                )
+            if mod.name in contracts.WIRE_ALLOWED_MODULES:
+                continue
+            for node in _wire_calls(mod, fn):
+                out.append(
+                    Diagnostic(
+                        RULE_ID,
+                        mod.rel,
+                        node.lineno,
+                        "wire-codec call (engine/wire.py is part of "
+                        f"the send surface) in {fn.qualname}; "
+                        "allowed modules: "
+                        f"{sorted(contracts.WIRE_ALLOWED_MODULES)}",
                     )
                 )
     return out

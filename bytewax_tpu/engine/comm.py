@@ -3,9 +3,12 @@
 The reference forms a full TCP mesh between processes and pickles
 payloads at process boundaries
 (``/root/reference/src/run.rs:257-271``,
-``src/pyo3_extensions.rs:94-148``).  Same wire model here: every
+``src/pyo3_extensions.rs:94-148``).  Same mesh model here: every
 process listens on its address and dials every other; frames are
-length-prefixed pickles.  This mesh carries *host-side* keyed exchange
+length-prefixed payloads whose encoding is owned by
+:mod:`bytewax_tpu.engine.wire` — a zero-copy columnar framing for
+record-batch data, pickle for everything else (the reference's only
+encoding).  This mesh carries *host-side* keyed exchange
 and control-plane traffic (epoch barriers, EOF coordination); device
 math stays on each process's chips — on a TPU pod the heavy exchange
 rides ICI inside the compiled step instead (see
@@ -13,7 +16,6 @@ rides ICI inside the compiled step instead (see
 """
 
 import os
-import pickle
 import selectors
 import socket
 import struct
@@ -22,6 +24,7 @@ from typing import Any, List, Optional, Tuple
 
 from bytewax_tpu.engine import faults as _faults
 from bytewax_tpu.engine import flight as _flight
+from bytewax_tpu.engine import wire as _wire
 from bytewax_tpu.engine.backoff import backoff_delay, seeded_rng
 from bytewax_tpu.errors import ClusterPeerDead
 
@@ -250,7 +253,10 @@ class Comm:
         other must not deadlock in blocking sends."""
         if _faults.fire("comm.send", peer=dest) == "drop":
             return
-        payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        # Payload encoding is owned by engine/wire.py: columnar
+        # framing for codable record-batch payloads, whole-frame
+        # pickle otherwise (docs/performance.md "Columnar exchange").
+        payload = _wire.encode(msg)
         data = memoryview(
             _LEN.pack(len(payload)) + _GEN.pack(self.generation) + payload
         )
@@ -316,7 +322,7 @@ class Comm:
                 self.fenced_frames += 1
                 _flight.note_fenced(peer, gen)
                 continue
-            msg = pickle.loads(frame)
+            msg = _wire.decode(frame)
             if msg == _HB:
                 continue  # liveness only; never delivered
             out.append((peer, msg))

@@ -34,6 +34,7 @@ from bytewax_tpu.engine import backoff as _backoff
 from bytewax_tpu.engine import batching as _batching
 from bytewax_tpu.engine import faults as _faults
 from bytewax_tpu.engine import flight as _flight
+from bytewax_tpu.engine import wire as _wire
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
 from bytewax_tpu.engine.dlq import DeadLetterQueue
 from bytewax_tpu.errors import (
@@ -2558,6 +2559,22 @@ class _Driver:
             from bytewax_tpu.engine.comm import Comm
 
             self.comm = Comm(addresses, proc_id, generation=generation)
+        #: Per-peer coalescing of ship_route slices (engine/wire.py;
+        #: docs/performance.md "Columnar exchange"): same-(peer,
+        #: stream, lane) slices merge under the ingest coalescer's
+        #: can_merge rules and ship as one frame at ship_flush —
+        #: called at every poll boundary and before every drain
+        #: point, so the count-matched barrier sees exactly the
+        #: frames that hit the wire.  ``BYTEWAX_TPU_WIRE=pickle``
+        #: restores the legacy wire wholesale — whole-frame pickle
+        #: AND one frame per routed slice — which is also the
+        #: comparison baseline bench.py measures.
+        self._ship_acc = (
+            _wire.RouteAccumulator()
+            if self.comm is not None
+            and _wire.wire_mode() == "columnar"
+            else None
+        )
         self.sent = [0] * self.proc_count
         self.rcvd = [0] * self.proc_count
         #: gsync frames from peers ahead of this process's sync round.
@@ -2801,10 +2818,49 @@ class _Driver:
 
     def ship_route(self, stream_id: str, entry: Entry) -> None:
         """Send an entry to its lane's owner, routed to the stream's
-        consumers there."""
-        dest = self.owner_proc(entry[0])
+        consumers there.
+
+        Zero-row slices never hit the wire (an empty group is a no-op
+        at every consumer, so skipping it is unobservable — and not
+        sending means not counting, so the barrier stays matched).
+        Non-empty slices accumulate per (peer, stream, lane) in the
+        route accumulator and ship as merged frames at the next
+        ``ship_flush`` (poll boundary / drain point)."""
+        w, items = entry
+        try:
+            if len(items) == 0:
+                return
+        except TypeError:
+            pass
+        acc = self._ship_acc
+        if acc is not None:
+            acc.add(self.owner_proc(w), stream_id, w, items)
+            return
+        dest = self.owner_proc(w)
         self.sent[dest] += 1
         self.comm.send(dest, ("route", stream_id, entry))
+
+    def ship_flush(self) -> None:
+        """Put every accumulated routed frame on the wire.  Drain-point
+        machinery (BTX-DRAIN): called from the run loop's poll
+        boundary, epoch-close entry, and the EOF ladder — never from a
+        per-batch path — so the sent counts the quiescence reports
+        carry always reflect what actually left this process.  Frames
+        are counted as they go out, and the ``comm.send`` fault site
+        fires before each run leaves the accumulator's pending set, so
+        an injected error unwinds with the rows still pending instead
+        of silently dropping them."""
+        acc = self._ship_acc
+        if acc is None:
+            return
+        while True:
+            frame = acc.peek()
+            if frame is None:
+                return
+            dest, stream_id, w, items = frame
+            self.sent[dest] += 1
+            self.comm.send(dest, ("route", stream_id, (w, items)))
+            acc.pop()
 
     def resume_state(self, step_id: str, state_key: str) -> Optional[Any]:
         ser = self._loads.get((step_id, state_key))
@@ -2904,6 +2960,12 @@ class _Driver:
                 self._last_gc = _time.monotonic()
 
     def _close_epoch_inner(self, workers: Optional[range] = None) -> None:
+        # The route accumulator flushes before anything else this
+        # close does: emissions must land in the epoch whose
+        # snapshots cover them, and every sync round below must run
+        # with nothing pending on this process.  Normally a no-op —
+        # the run loop's poll-boundary flush already drained it.
+        self.ship_flush()
         # Dispatch pipelines drain before ANY sync round this close
         # performs (the pre_close collective flushes, the telemetry
         # piggyback): no gsync point may be reached with this process
@@ -3129,6 +3191,11 @@ class _Driver:
                 rt.on_upstream_eof()
                 rt.drain()
             rt.eof = True
+        if self.comm is not None:
+            # EOF-ladder drains can route: flush before the ladder's
+            # next count-matched report so the shipped frames are
+            # counted in the same generation that produced them.
+            self.ship_flush()
         self._eof_k = k + 1
         self._progressed = True
 
@@ -3363,6 +3430,17 @@ class _Driver:
                 "pending_flush": self.dlq.pending_count(),
             },
             "rescale_hint": self._rescale_hint(),
+            "wire": {
+                "mode": _wire.wire_mode(),
+                "pending_frames": (
+                    # Racy read — observability, like every other
+                    # field here.
+                    self._ship_acc.pending_frames()
+                    if self._ship_acc is not None
+                    else 0
+                ),
+                **_flight.wire_status(),
+            },
             "epoch": self.epoch,
             "stopping": _STOP_EVENT.is_set() or self._stop_agreed,
             "eof": bool(rts) and all(rt.eof for rt in rts),
@@ -3588,6 +3666,14 @@ class _Driver:
                             rt.on_upstream_eof()
                             rt.drain()
                             rt.eof = True
+
+                if clustered:
+                    # Poll boundary: routed slices accumulated during
+                    # this pass ship NOW — before the quiescence
+                    # report below is computed, so the count-matched
+                    # barrier can never observe drained queues while
+                    # frames still sit in the accumulator.
+                    self.ship_flush()
 
                 elapsed = time.monotonic() - epoch_started
 

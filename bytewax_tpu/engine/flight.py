@@ -83,6 +83,8 @@ __all__ = [
     "note_stop_requested",
     "note_transfer",
     "note_unquarantine",
+    "note_wire",
+    "wire_status",
     "write_postmortem",
 ]
 
@@ -521,6 +523,61 @@ def note_comm(direction: str, peer: int, nbytes: int) -> None:
     _comm_children[("bytes", direction, peer)].inc(nbytes)
     RECORDER.count(f"comm_frames_{direction}")
     RECORDER.count(f"comm_bytes_{direction}", nbytes)
+
+
+_wire_children: Dict[Tuple[str, str], Any] = {}
+
+
+def note_wire(op: str, codec: str, nbytes: int, seconds: float) -> None:
+    """One wire-codec pass over a cluster-mesh payload (``op``
+    ``encode``/``decode``, ``codec`` ``columnar``/``pickle``);
+    counters only — frames are too hot for ring events."""
+    key = (op, codec)
+    # Both label children live under ONE key (installed atomically
+    # under the lock): a second driver thread racing first use must
+    # never observe a half-initialized pair.
+    pair = _wire_children.get(key)
+    if pair is None:
+        from bytewax_tpu._metrics import (
+            wire_bytes_count,
+            wire_codec_seconds,
+        )
+
+        with _lock:
+            pair = _wire_children.setdefault(
+                key,
+                (
+                    wire_codec_seconds.labels(codec, op),
+                    wire_bytes_count.labels(
+                        codec, "tx" if op == "encode" else "rx"
+                    ),
+                ),
+            )
+    secs, bts = pair
+    secs.inc(seconds)
+    bts.inc(nbytes)
+    RECORDER.count(f"wire_{op}_frames_{codec}")
+    RECORDER.count(f"wire_{op}_bytes_{codec}", nbytes)
+    RECORDER.count(f"wire_{op}_seconds_{codec}", seconds)
+
+
+def wire_status() -> Dict[str, Any]:
+    """The ``/status`` wire section: per-direction frame/byte/time
+    totals split by codec (docs/observability.md)."""
+    c = RECORDER.counters
+    out: Dict[str, Any] = {}
+    for op in ("encode", "decode"):
+        out[op] = {
+            codec: {
+                "frames": int(c.get(f"wire_{op}_frames_{codec}", 0)),
+                "bytes": int(c.get(f"wire_{op}_bytes_{codec}", 0)),
+                "seconds": round(
+                    c.get(f"wire_{op}_seconds_{codec}", 0.0), 6
+                ),
+            }
+            for codec in ("columnar", "pickle")
+        }
+    return out
 
 
 def note_gsync(tag: Any, seconds: float) -> None:

@@ -1,0 +1,483 @@
+"""Columnar frames on the wire — the cluster exchange codec.
+
+PR 8 made ingest columnar end to end, but a batch crossing a process
+boundary used to collapse into a length-prefixed pickle: the keyed
+shuffle paid ``pickle.dumps``/``loads`` on every NumPy record batch
+and each routed slice shipped one tiny frame.  Following Exoshuffle's
+shuffle-as-a-library layering (PAPERS.md) this module owns the wire
+*format* and the *batching policy* of the exchange, riding inside the
+existing ``ship_deliver``/``ship_route`` payloads — zero new frame
+kinds, zero new send surface, and the count-matched epoch barrier
+counts exactly the frames that hit the socket.
+
+Two pieces live here (docs/performance.md "Columnar exchange"):
+
+- **The codec** (:func:`encode` / :func:`decode`): a ``deliver`` /
+  ``route`` payload carrying an :class:`ArrayBatch` whose columns are
+  fixed-width (numeric, ``datetime64``, ``S``/``U`` bytes) is framed
+  as a compact header — schema (column names, dtypes, roles by name:
+  ``key``/``key_id``/``ts``/``value``), row count, per-column byte
+  lengths — followed by the raw column buffers, and decoded
+  **zero-copy** via ``np.frombuffer`` over the received frame.
+  Object-dtype columns fall back to a per-column pickle inside the
+  columnar frame; non-batch payloads (control frames, item lists)
+  fall back to the whole-frame pickle encoding unchanged.  Frames are
+  versioned: an unknown version raises a typed
+  :class:`~bytewax_tpu.errors.WireFormatError` instead of guessing.
+
+- **Per-peer accumulation** (:class:`RouteAccumulator`): ``ship_route``
+  slices for the same (peer, stream, lane) accumulate and coalesce
+  under the ingest coalescer's ``can_merge``/``merge_batches`` rules
+  (engine/batching.py) until a poll boundary, so small routed slices
+  amortize syscalls and per-frame headers.  The driver flushes it
+  unconditionally before every drain point (``_Driver.ship_flush``,
+  a BTX-DRAIN drain-only operation), so the generation-tagged
+  count-matched barrier and epoch quiescence see exactly the frames
+  they count.
+
+This module is pure encode/decode and in-memory accumulation — no
+sockets, no comm frames.  It is callable only from the allowlisted
+comm/driver modules (``contracts.WIRE_ALLOWED_MODULES``, enforced by
+BTX-SEND and pinned in ``tests/test_comm_invariants.py``).
+
+``BYTEWAX_TPU_WIRE=pickle`` restores the legacy wire wholesale —
+whole-frame pickle for every payload AND one frame per routed slice
+(the driver arms no accumulator) — which is both the mixed-version
+rollout mode and the comparison baseline bench.py measures.
+"""
+
+import os
+import pickle
+import struct
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bytewax_tpu.engine import flight as _flight
+from bytewax_tpu.engine.arrays import ArrayBatch
+from bytewax_tpu.engine.batching import can_merge, merge_batches
+from bytewax_tpu.errors import WireFormatError
+
+__all__ = [
+    "RouteAccumulator",
+    "decode",
+    "encode",
+    "reconfigure",
+    "wire_mode",
+]
+
+#: Frame magic.  The first byte can never begin a protocol-2+ pickle
+#: (those start with ``b"\x80"``), so ``decode`` can tell the two
+#: encodings apart from the first bytes alone — the versioned
+#: fallback needs no out-of-band flag.
+_MAGIC = b"\xb5BXW"
+_VERSION = 1
+
+_U8 = struct.Struct("<B")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+_KIND_DELIVER = 0
+_KIND_ROUTE = 1
+
+#: Per-column encodings inside a columnar frame.
+_COL_RAW = 0
+_COL_PICKLE = 1
+
+#: Header flag bits.
+_FLAG_SCALE = 1
+_FLAG_VOCAB = 2
+_FLAG_VOCAB_PICKLED = 4
+
+#: Column buffers are padded to this alignment so the zero-copy
+#: ``np.frombuffer`` views start on aligned offsets (unaligned numpy
+#: views are legal but slower on every subsequent op).
+_ALIGN = 8
+
+#: dtype kinds shipped as raw buffers: bool, signed/unsigned ints,
+#: floats, complex, timedelta64, datetime64, and fixed-width S/U
+#: string cells.  Everything else (object columns above all) takes
+#: the per-column pickle fallback.
+_RAW_KINDS = frozenset("biufcmMSU")
+
+_mode_cache: Optional[str] = None
+
+
+def wire_mode() -> str:
+    """The armed wire: ``"columnar"`` (default) or ``"pickle"``
+    (``BYTEWAX_TPU_WIRE=pickle`` — the legacy wire: whole-frame
+    pickle, no route accumulation).  Cached; re-read after
+    :func:`reconfigure` (tests/bench)."""
+    global _mode_cache
+    if _mode_cache is None:
+        raw = os.environ.get("BYTEWAX_TPU_WIRE", "columnar") or "columnar"
+        _mode_cache = "pickle" if raw == "pickle" else "columnar"
+    return _mode_cache
+
+
+def reconfigure() -> None:
+    """Drop the cached env knob (tests/bench tweak it mid-process)."""
+    global _mode_cache
+    _mode_cache = None
+
+
+# -- encode -----------------------------------------------------------------
+
+
+def _pack_str(s: str) -> Optional[bytes]:
+    raw = s.encode("utf-8")
+    if len(raw) > 0xFFFF:
+        return None
+    return _U16.pack(len(raw)) + raw
+
+
+def _encode_columnar(msg: Any) -> Optional[bytes]:
+    """The columnar framing of one ship payload, or None when the
+    payload is not a codable batch (the caller then pickles whole)."""
+    if type(msg) is not tuple or not msg:
+        return None
+    if msg[0] == "deliver" and len(msg) == 4:
+        kind, meta, entry = _KIND_DELIVER, msg[1:3], msg[3]
+    elif msg[0] == "route" and len(msg) == 3:
+        kind, meta, entry = _KIND_ROUTE, msg[1:2], msg[2]
+    else:
+        return None
+    if type(entry) is not tuple or len(entry) != 2:
+        return None
+    w, batch = entry
+    # Exact types only: a bool lane index or an ArrayBatch subclass
+    # carrying extra state must round-trip through pickle unchanged.
+    if type(w) is not int or type(batch) is not ArrayBatch:
+        return None
+    head: List[bytes] = [_MAGIC, _U8.pack(_VERSION), _U8.pack(kind)]
+    if kind == _KIND_DELIVER:
+        op_idx, port = meta
+        if not (0 <= int(op_idx) <= 0xFFFFFFFF):
+            return None
+        port_b = _pack_str(port)
+        if port_b is None:
+            return None
+        head.append(_U32.pack(int(op_idx)))
+        head.append(port_b)
+    else:
+        (stream_id,) = meta
+        sid_b = _pack_str(stream_id)
+        if sid_b is None:
+            return None
+        head.append(sid_b)
+    nrows = len(batch)
+    flags = 0
+    scale_b = b""
+    if batch.value_scale is not None:
+        if type(batch.value_scale) is not float:
+            return None
+        flags |= _FLAG_SCALE
+        scale_b = _F64.pack(batch.value_scale)
+    vocab = batch.key_vocab
+    vocab_buf = b""
+    vocab_desc = b""
+    if vocab is not None:
+        flags |= _FLAG_VOCAB
+        if (
+            isinstance(vocab, np.ndarray)
+            and vocab.ndim == 1
+            and vocab.dtype.kind in _RAW_KINDS
+            and vocab.dtype.itemsize > 0
+        ):
+            dt_b = _pack_str(vocab.dtype.str)
+            if dt_b is None:
+                return None
+            vocab_buf = np.ascontiguousarray(vocab).tobytes()
+            vocab_desc = dt_b + _U64.pack(len(vocab)) + _U64.pack(
+                len(vocab_buf)
+            )
+        else:
+            flags |= _FLAG_VOCAB_PICKLED
+            vocab_buf = pickle.dumps(
+                vocab, protocol=pickle.HIGHEST_PROTOCOL
+            )
+            vocab_desc = _U64.pack(len(vocab_buf))
+    cols = batch.cols
+    if len(cols) > 0xFFFF:
+        return None
+    bufs: List[bytes] = []
+    col_desc: List[bytes] = []
+    for name, col in cols.items():
+        name_b = _pack_str(name)
+        if name_b is None:
+            return None
+        arr = np.asarray(col)
+        if (
+            arr.ndim == 1
+            and len(arr) == nrows
+            and arr.dtype.kind in _RAW_KINDS
+            and arr.dtype.itemsize > 0
+        ):
+            dt_b = _pack_str(arr.dtype.str)
+            if dt_b is None:
+                return None
+            buf = np.ascontiguousarray(arr).tobytes()
+            col_desc.append(
+                name_b + _U8.pack(_COL_RAW) + dt_b + _U64.pack(len(buf))
+            )
+        else:
+            # Object-dtype (or otherwise unframeable) column: pickle
+            # just this column inside the columnar frame.
+            buf = pickle.dumps(arr, protocol=pickle.HIGHEST_PROTOCOL)
+            col_desc.append(
+                name_b + _U8.pack(_COL_PICKLE) + _U64.pack(len(buf))
+            )
+        bufs.append(buf)
+    head.append(_I64.pack(w))
+    head.append(_U64.pack(nrows))
+    head.append(_U8.pack(flags))
+    head.append(scale_b)
+    head.append(_U16.pack(len(cols)))
+    head.extend(col_desc)
+    head.append(vocab_desc)
+    out = b"".join(head)
+    parts = [out]
+    off = len(out)
+    for buf in bufs + ([vocab_buf] if vocab_buf else []):
+        pad = -off % _ALIGN
+        if pad:
+            parts.append(b"\x00" * pad)
+            off += pad
+        parts.append(buf)
+        off += len(buf)
+    return b"".join(parts)
+
+
+def encode(msg: Any) -> bytes:
+    """Encode one mesh payload for the wire: columnar framing for
+    codable ``deliver``/``route`` batch payloads, whole-frame pickle
+    for everything else (and for everything under
+    ``BYTEWAX_TPU_WIRE=pickle``)."""
+    t0 = time.perf_counter()
+    data = None
+    if wire_mode() == "columnar":
+        data = _encode_columnar(msg)
+    if data is None:
+        data = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        codec = "pickle"
+    else:
+        codec = "columnar"
+    _flight.note_wire("encode", codec, len(data), time.perf_counter() - t0)
+    return data
+
+
+# -- decode -----------------------------------------------------------------
+
+
+class _Reader:
+    """Sequential header reader with truncation checks (a torn or
+    corrupted frame raises :class:`WireFormatError`, never slices
+    garbage)."""
+
+    __slots__ = ("data", "off")
+
+    def __init__(self, data: bytes, off: int):
+        self.data = data
+        self.off = off
+
+    def take(self, st: struct.Struct) -> Any:
+        end = self.off + st.size
+        if end > len(self.data):
+            raise WireFormatError("truncated columnar frame header")
+        (val,) = st.unpack_from(self.data, self.off)
+        self.off = end
+        return val
+
+    def take_str(self) -> str:
+        n = self.take(_U16)
+        end = self.off + n
+        if end > len(self.data):
+            raise WireFormatError("truncated columnar frame header")
+        s = self.data[self.off : end].decode("utf-8")
+        self.off = end
+        return s
+
+    def take_buf(self, n: int) -> Tuple[int, int]:
+        """Reserve an ``n``-byte aligned payload region; returns its
+        (start, end) offsets."""
+        self.off += -self.off % _ALIGN
+        end = self.off + n
+        if end > len(self.data):
+            raise WireFormatError("truncated columnar frame payload")
+        start = self.off
+        self.off = end
+        return start, end
+
+
+def _decode_columnar(data: bytes) -> Any:
+    version = data[4]
+    if version != _VERSION:
+        msg = (
+            f"columnar wire frame version {version} is not supported "
+            f"by this process (speaks version {_VERSION}); mixed-"
+            "version clusters must run the pickle wire "
+            "(BYTEWAX_TPU_WIRE=pickle) during the rollout"
+        )
+        raise WireFormatError(msg)
+    rd = _Reader(data, 5)
+    kind = rd.take(_U8)
+    if kind == _KIND_DELIVER:
+        op_idx = rd.take(_U32)
+        port = rd.take_str()
+    elif kind == _KIND_ROUTE:
+        stream_id = rd.take_str()
+    else:
+        raise WireFormatError(f"unknown columnar frame kind {kind}")
+    w = rd.take(_I64)
+    nrows = rd.take(_U64)
+    flags = rd.take(_U8)
+    scale = rd.take(_F64) if flags & _FLAG_SCALE else None
+    ncols = rd.take(_U16)
+    specs: List[Tuple[str, int, Optional[str], int]] = []
+    for _ in range(ncols):
+        name = rd.take_str()
+        colkind = rd.take(_U8)
+        if colkind == _COL_RAW:
+            dt = rd.take_str()
+            nbytes = rd.take(_U64)
+            specs.append((name, colkind, dt, nbytes))
+        elif colkind == _COL_PICKLE:
+            nbytes = rd.take(_U64)
+            specs.append((name, colkind, None, nbytes))
+        else:
+            raise WireFormatError(
+                f"unknown column encoding {colkind} in columnar frame"
+            )
+    vocab_spec: Optional[Tuple[Optional[str], int, int]] = None
+    if flags & _FLAG_VOCAB:
+        if flags & _FLAG_VOCAB_PICKLED:
+            vocab_spec = (None, 0, rd.take(_U64))
+        else:
+            dt = rd.take_str()
+            nvocab = rd.take(_U64)
+            vocab_spec = (dt, nvocab, rd.take(_U64))
+    cols: Dict[str, Any] = {}
+    for name, colkind, dt, nbytes in specs:
+        start, end = rd.take_buf(nbytes)
+        if colkind == _COL_RAW:
+            dtype = np.dtype(dt)
+            if nbytes != nrows * dtype.itemsize:
+                raise WireFormatError(
+                    f"column {name!r} carries {nbytes} bytes for "
+                    f"{nrows} rows of {dt}"
+                )
+            # Zero-copy: a read-only view over the received frame.
+            cols[name] = np.frombuffer(
+                data, dtype=dtype, count=nrows, offset=start
+            )
+        else:
+            cols[name] = pickle.loads(data[start:end])
+    vocab = None
+    if vocab_spec is not None:
+        dt, nvocab, nbytes = vocab_spec
+        start, end = rd.take_buf(nbytes)
+        if dt is None:
+            vocab = pickle.loads(data[start:end])
+        else:
+            vocab = np.frombuffer(
+                data, dtype=np.dtype(dt), count=nvocab, offset=start
+            )
+    batch = ArrayBatch(cols, key_vocab=vocab, value_scale=scale)
+    if kind == _KIND_DELIVER:
+        return ("deliver", op_idx, port, (w, batch))
+    return ("route", stream_id, (w, batch))
+
+
+def decode(data: bytes) -> Any:
+    """Decode one received mesh frame: columnar frames rebuild their
+    :class:`ArrayBatch` zero-copy, anything else is a pickle."""
+    t0 = time.perf_counter()
+    if data[:4] == _MAGIC:
+        msg = _decode_columnar(data)
+        codec = "columnar"
+    else:
+        msg = pickle.loads(data)
+        codec = "pickle"
+    _flight.note_wire("decode", codec, len(data), time.perf_counter() - t0)
+    return msg
+
+
+# -- per-peer route accumulation --------------------------------------------
+
+
+class RouteAccumulator:
+    """Per-(peer process, stream, lane) coalescing of routed slices.
+
+    ``add`` appends a slice to the bucket's current *run* when the
+    ingest coalescer's ``can_merge`` rules allow it (same columns,
+    same scale, same vocab identity — exactly the merges no consumer
+    can observe); an incompatible slice starts a new run.  Each run
+    becomes ONE wire frame at flush.
+
+    Flush protocol (``_Driver.ship_flush``): ``peek`` exposes the
+    oldest run merged into its frame payload, the caller sends it and
+    counts it, and only then ``pop``s — so a fault fired inside
+    ``comm.send`` (the pinned chaos site) unwinds with the run still
+    in the pending set, never silently dropping accumulated rows.
+    Rows only ever wait within one poll iteration: the driver flushes
+    at every poll boundary and before every drain point.
+    """
+
+    __slots__ = ("_runs", "_order", "_head")
+
+    def __init__(self):
+        self._runs: Dict[Tuple[int, str, int], List[List[Any]]] = {}
+        self._order: Deque[Tuple[int, str, int]] = deque()
+        self._head: Optional[Tuple[int, str, int, Any]] = None
+
+    def add(self, dest: int, stream_id: str, w: int, items: Any) -> None:
+        key = (dest, stream_id, w)
+        runs = self._runs.get(key)
+        if runs is None:
+            runs = []
+            self._runs[key] = runs
+            self._order.append(key)
+        if runs and can_merge(runs[-1][-1], items):
+            runs[-1].append(items)
+        else:
+            runs.append([items])
+        # A peeked-but-unsent head may alias the run just extended.
+        self._head = None
+
+    def pending(self) -> bool:
+        return bool(self._order)
+
+    def pending_frames(self) -> int:
+        """How many wire frames a full flush would ship right now
+        (every run of every bucket) — the /status observability
+        figure, read racily off the API thread (the ``list()`` copy
+        is GIL-atomic, so a concurrent add/pop can't break the
+        iteration)."""
+        return sum(len(runs) for runs in list(self._runs.values()))
+
+    def peek(self) -> Optional[Tuple[int, str, int, Any]]:
+        """The oldest pending frame as ``(dest, stream_id, w, items)``
+        with its run merged, or None; stays pending until :meth:`pop`."""
+        if self._head is not None:
+            return self._head
+        if not self._order:
+            return None
+        key = self._order[0]
+        dest, stream_id, w = key
+        self._head = (dest, stream_id, w, merge_batches(self._runs[key][0]))
+        return self._head
+
+    def pop(self) -> None:
+        """Drop the run :meth:`peek` exposed (it is on the wire)."""
+        self._head = None
+        key = self._order[0]
+        runs = self._runs[key]
+        runs.pop(0)
+        if not runs:
+            self._order.popleft()
+            del self._runs[key]

@@ -24,6 +24,7 @@ __all__ = [
     "TransientIOError",
     "TransientSinkError",
     "TransientSourceError",
+    "WireFormatError",
     "callable_location",
     "is_transient_io_error",
     "note_context",
@@ -144,6 +145,19 @@ class TransientSinkError(TransientIOError):
     that deduplicates): the driver retries the same batch in place —
     strictly before the epoch's snapshot commit, so exactly-once
     output is untouched — and escalates after the retry budget."""
+
+
+class WireFormatError(BytewaxRuntimeError):
+    """A received cluster-mesh frame claimed the columnar wire
+    encoding (docs/performance.md "Columnar exchange") but could not
+    be decoded: an unsupported frame version (mixed-version cluster —
+    run the rollout on ``BYTEWAX_TPU_WIRE=pickle``), an unknown
+    column encoding, or a truncated/corrupted header.  Raised instead
+    of guessing at the payload — and deliberately FATAL, not
+    supervisor-restartable: the peer would re-send the same encoding
+    after a restart (a version skew does not heal by retrying), so a
+    restart loop would only hide the operator error the message
+    names."""
 
 
 #: ``OSError`` errnos classified transient by default: interrupted /

@@ -157,6 +157,22 @@ def test_send_surface_allowlist_is_pinned():
         },
         "ship": {"bytewax_tpu.engine.driver"},
     }
+    # The columnar-exchange PR grew the ship surface by exactly one
+    # method: ship_flush, the route-accumulator drain (frames ship
+    # and count ONLY there or in the direct ship paths) — and made
+    # the wire codec module part of the send surface: only the comm/
+    # driver pair (and the module itself) may call into it.
+    assert contracts.SHIP_METHODS == {
+        "ship_deliver",
+        "ship_route",
+        "ship_flush",
+    }
+    assert contracts.WIRE_MODULE == "bytewax_tpu.engine.wire"
+    assert contracts.WIRE_ALLOWED_MODULES == {
+        "bytewax_tpu.engine.comm",
+        "bytewax_tpu.engine.driver",
+        "bytewax_tpu.engine.wire",
+    }
     assert contracts.GSYNC_CALLER_MODULES == {
         "bytewax_tpu.engine.driver",
         "bytewax_tpu.engine.sharded_state",
@@ -169,7 +185,7 @@ def test_allowlist_is_not_stale():
     # moves them.
     project = _project()
     driver = "bytewax_tpu.engine.driver"
-    for fn in ("ship_deliver", "ship_route", "global_sync"):
+    for fn in ("ship_deliver", "ship_route", "ship_flush", "global_sync"):
         assert f"{driver}:_Driver.{fn}" in project.functions
     sharded = project.modules["bytewax_tpu.engine.sharded_state"]
     flush = project.functions[
@@ -243,6 +259,10 @@ def test_drain_point_inventory_is_pinned():
         "_pipe_shutdown",
         "_close_epoch",
         "_close_epoch_inner",
+        # The columnar-exchange PR: the route-accumulator flush is
+        # drain-only — frames ship (and count into the barrier's
+        # quiescence math) only at poll boundaries / drain points.
+        "ship_flush",
     }
     assert contracts.PIPELINE_DRAIN_METHODS == {
         "flush",
@@ -305,6 +325,7 @@ def test_worker_lane_inventory_is_pinned():
     for name in (
         "ship_deliver",
         "ship_route",
+        "ship_flush",
         "send",
         "broadcast",
         "global_sync",
@@ -349,7 +370,7 @@ def test_worker_lane_inventory_is_pinned():
 
 
 def test_knob_catalog_is_pinned():
-    """The knob inventory: exactly today's 49 BYTEWAX_TPU_* knobs,
+    """The knob inventory: exactly today's 50 BYTEWAX_TPU_* knobs,
     each with a default and a doc anchor.  Adding a knob requires
     updating contracts.KNOBS, this list, docs/configuration.md, and
     the anchor doc — BTX-KNOB enforces the rest (literal reads,
@@ -409,8 +430,9 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_STATE_BUDGET",
         "BYTEWAX_TPU_TEXT_DEVICE",
         "BYTEWAX_TPU_TRACE_DIR",
+        "BYTEWAX_TPU_WIRE",
     ]
-    assert len(contracts.KNOBS) == 49
+    assert len(contracts.KNOBS) == 50
     for name, (default, doc) in contracts.KNOBS.items():
         assert isinstance(default, str), name
         assert doc.startswith("docs/") and doc.endswith(".md"), name
@@ -449,6 +471,48 @@ def test_supervisor_is_process_local():
             comm_calls = [c.name for c in fn.calls if c.name in forbidden]
             assert not comm_calls, f"{qual} calls {comm_calls}"
     assert checked >= 10  # the scan really covered the supervisor
+
+
+def test_wire_codec_is_pure_and_allowlisted():
+    """The columnar-exchange PR pin (docs/performance.md "Columnar
+    exchange"): ``engine/wire.py`` is pure encode/decode plus the
+    route accumulator — no sockets, no frames of its own.  The
+    frame-kind inventory above is byte-identical (columnar framing
+    rides INSIDE the existing deliver/route payloads), none of the
+    wire module's functions touch a raw send primitive, a ship
+    method, or a sync round, and it never constructs a Comm.  The
+    module itself is send-surface-adjacent: BTX-SEND restricts
+    resolved calls into it to the comm/driver pair
+    (``contracts.WIRE_ALLOWED_MODULES``, pinned in
+    test_send_surface_allowlist_is_pinned)."""
+    project = _project()
+    assert contracts.WIRE_MODULE in project.modules
+    forbidden = (
+        contracts.RAW_SEND_METHODS
+        | contracts.SHIP_METHODS
+        | contracts.GSYNC_PRIMITIVES
+    )
+    checked = 0
+    for qual, fn in project.functions.items():
+        mod = qual.split(":", 1)[0]
+        if mod != contracts.WIRE_MODULE:
+            continue
+        checked += 1
+        comm_calls = [c.name for c in fn.calls if c.name in forbidden]
+        assert not comm_calls, f"{qual} calls {comm_calls}"
+        constructs = [
+            c.name for c in fn.calls if c.dotted == contracts.COMM_CLASS
+        ]
+        assert not constructs, f"{qual} constructs Comm"
+    assert checked >= 10  # the scan really covered the codec
+
+    # And the accumulator's flush counterpart really exists where
+    # BTX-DRAIN pins it (staleness guard).
+    driver = "bytewax_tpu.engine.driver"
+    flush = project.functions[f"{driver}:_Driver.ship_flush"]
+    assert any(
+        c.name in contracts.RAW_SEND_METHODS for c in flush.calls
+    ), "ship_flush no longer sends — the drain-only pin is stale"
 
 
 def test_ingest_batching_is_process_local():
