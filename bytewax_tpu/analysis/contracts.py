@@ -587,6 +587,7 @@ KNOBS: Dict[str, Tuple[str, str]] = {
     "BYTEWAX_TPU_ALLOW_REMOTE_STOP": ("0", "docs/deployment.md"),
     "BYTEWAX_TPU_AUTOSCALE_COOLDOWN_S": ("30", "docs/deployment.md"),
     "BYTEWAX_TPU_AUTOSCALE_HYSTERESIS": ("3", "docs/deployment.md"),
+    "BYTEWAX_TPU_AUTOSCALE_LIVE": ("1", "docs/deployment.md"),
     "BYTEWAX_TPU_AUTOSCALE_POLL_S": ("2", "docs/deployment.md"),
     "BYTEWAX_TPU_AUTOSCALE_STOP_TIMEOUT_S": (
         "60",

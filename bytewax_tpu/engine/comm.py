@@ -195,9 +195,22 @@ class Comm:
             listener.settimeout(self._handshake_budget(deadline))
             try:
                 sock, _addr = listener.accept()
-                raw = self._read_exact(sock, _LEN.size + _GEN.size)
             except (socket.timeout, TimeoutError):
                 raise self._handshake_timeout() from None
+            try:
+                sock.settimeout(self._handshake_budget(deadline))
+                raw = self._read_exact(sock, _LEN.size + _GEN.size)
+                sock.settimeout(None)
+            except (socket.timeout, TimeoutError):
+                raise self._handshake_timeout() from None
+            except ConnectionError:
+                # An accepted connection that closed before
+                # introducing itself is not a peer: liveness probes
+                # (the autoscaler checks a joining process is at its
+                # handshake by connect-and-close) and port scanners
+                # must not kill the mesh formation.  Keep accepting.
+                sock.close()
+                continue
             peer = _LEN.unpack(raw[: _LEN.size])[0]
             self._peer_gen[peer] = _GEN.unpack(raw[_LEN.size :])[0]
             sock.sendall(_GEN.pack(self.generation))
@@ -427,6 +440,34 @@ class Comm:
                 f"cluster peer {peer} closed connection", peer=peer
             )
         return out
+
+    def closed_peers(self) -> frozenset:
+        """Peers whose connection has closed (clean exit or death).
+        The driver's sync rounds use this to tell a benign
+        completed-the-round exit from a peer that died BEFORE
+        delivering — ``recv_ready`` raises for an arbitrary closed
+        peer, so the caller must be able to look past one it already
+        heard from."""
+        return frozenset(self._closed)
+
+    def stale_peers(self) -> frozenset:
+        """Live peers silent past the heartbeat limit — the same
+        frozen/half-open condition ``recv_ready`` raises for, exposed
+        as a set because the raise names an ARBITRARY suspect: a sync
+        round looking past a benignly-finished peer must still be
+        able to see every OTHER peer that has gone quiet."""
+        if self._hb <= 0:
+            return frozenset()
+        now = time.monotonic()
+        limit = self._hb_limit
+        return frozenset(
+            peer
+            for peer, last in self._last_rx.items()
+            if peer not in self._closed
+            and peer not in self._paused
+            and peer in self._socks
+            and now - last > limit
+        )
 
     def close(self) -> None:
         for sock in self._socks.values():

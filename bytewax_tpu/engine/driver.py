@@ -38,6 +38,7 @@ from bytewax_tpu.engine import wire as _wire
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
 from bytewax_tpu.engine.dlq import DeadLetterQueue
 from bytewax_tpu.errors import (
+    ClusterPeerDead,
     DeviceFault,
     EpochStalled,
     GracefulStop,
@@ -253,6 +254,104 @@ def reset_stop() -> None:
     _STOP_EVENT.clear()
 
 
+#: Pending live-reconfiguration target for this process
+#: (docs/recovery.md "Live partial rescale"): ``(addresses tuple,
+#: workers_per_process or None)``.  Module-level like ``_STOP_EVENT``
+#: — the setters (the API server's ``POST /reconfigure``, embedders)
+#: live outside the driver's lifetime, and the request must survive
+#: an in-process supervised restart until an epoch close consumes it.
+_RECONFIG_LOCK = threading.Lock()
+_RECONFIG_TARGET: Optional[Tuple[Tuple[str, ...], Optional[int]]] = None
+
+
+def request_reconfigure(
+    addresses: List[str],
+    workers_per_process: Optional[int] = None,
+    source: str = "api",
+) -> None:
+    """Request a LIVE cluster membership change: at the next epoch
+    close every process proposes its pending target on the existing
+    close sync round, and once the whole cluster has the same target
+    the close commits as usual and each process unwinds to the
+    run-startup re-entry point — rebuilding against the new address
+    list (or retiring, when its process id falls outside it) without
+    leaving the process.  Keyed state re-shards there through the
+    delta-only store migration (docs/recovery.md "Live partial
+    rescale").  Safe to call from any thread.
+
+    ``addresses`` is the full new cluster address list (empty list =
+    a single process with no mesh); ``workers_per_process`` changes
+    the per-process lane count too (``None`` keeps the current one).
+    """
+    global _RECONFIG_TARGET
+    addrs = tuple(str(a) for a in addresses)
+    wpp = None
+    if workers_per_process is not None:
+        wpp = int(workers_per_process)
+        if wpp < 1:
+            msg = f"workers_per_process must be >= 1 (got {wpp})"
+            raise ValueError(msg)
+    with _RECONFIG_LOCK:
+        _RECONFIG_TARGET = (addrs, wpp)
+    _flight.note_reconfigure_requested(len(addrs), wpp, source)
+
+
+def _pending_reconfigure() -> Optional[
+    Tuple[Tuple[str, ...], Optional[int]]
+]:
+    with _RECONFIG_LOCK:
+        return _RECONFIG_TARGET
+
+
+def reset_reconfigure() -> None:
+    """Clear a pending reconfigure request (entry points consume it
+    implicitly when they return — like a stop request, it targets one
+    execution, not the process forever)."""
+    global _RECONFIG_TARGET
+    with _RECONFIG_LOCK:
+        _RECONFIG_TARGET = None
+
+
+def _consume_reconfigure(
+    spec: Tuple[Tuple[str, ...], int]
+) -> None:
+    """Clear the pending target iff it still matches the spec just
+    acted on (a NEWER request posted mid-close — different addresses
+    OR a different explicit lane count — must survive for the next
+    close).  A pending ``wpp=None`` ("keep mine") matches whatever
+    lane count the agreement substituted for it."""
+    global _RECONFIG_TARGET
+    with _RECONFIG_LOCK:
+        if _RECONFIG_TARGET is None:
+            return
+        addrs, wpp = _RECONFIG_TARGET
+        if addrs == spec[0] and (wpp is None or wpp == spec[1]):
+            _RECONFIG_TARGET = None
+
+
+class _Reconfigure:
+    """Internal completion status of a run that agreed a live
+    membership change: ``_supervised`` intercepts it and re-enters
+    run startup in-process at the new shape (or returns a
+    :class:`~bytewax_tpu.errors.GracefulStop` when this process
+    retires).  Never escapes the entry points."""
+
+    __slots__ = ("addresses", "wpp", "epoch")
+
+    def __init__(
+        self, addresses: List[str], wpp: int, epoch: int
+    ):
+        self.addresses = list(addresses)
+        self.wpp = wpp
+        self.epoch = epoch
+
+    def __repr__(self) -> str:
+        return (
+            f"_Reconfigure(addresses={len(self.addresses)}, "
+            f"wpp={self.wpp}, epoch={self.epoch})"
+        )
+
+
 def _enable_compile_cache(cache_dir: str) -> None:
     """Point jax's persistent compilation cache at ``cache_dir`` so
     compiled programs survive process restarts: a cold start then
@@ -426,22 +525,36 @@ def _backoff_delay(
 
 
 def _supervised(
-    make: Callable[[int], "_Driver"], proc_id: int = 0
+    make: Callable[..., "_Driver"], proc_id: int = 0
 ) -> Optional[GracefulStop]:
     """Run a driver under the restart supervisor.  Returns the
     driver's completion status: a typed
     :class:`~bytewax_tpu.errors.GracefulStop` after a cooperative
     drain-to-stop, ``None`` after an EOF completion.
 
-    ``make(generation)`` builds a fresh driver (re-opening the
-    recovery store recomputes ``resume_from()``, so each generation
-    resumes from the last committed epoch).  Restartable faults are
-    retried up to ``BYTEWAX_TPU_MAX_RESTARTS`` times *per failure
-    burst* (default 0 — supervision off, faults propagate exactly as
-    before) with capped exponential backoff starting at
+    ``make(generation, reconfig)`` builds a fresh driver (re-opening
+    the recovery store recomputes ``resume_from()``, so each
+    generation resumes from the last committed epoch); ``reconfig``
+    is ``None`` normally, or the :class:`_Reconfigure` a live
+    membership change agreed — the factory then builds against the
+    NEW address list / lane count with rescale-on-resume forced on.
+    Restartable faults are retried up to
+    ``BYTEWAX_TPU_MAX_RESTARTS`` times *per failure burst* (default
+    0 — supervision off, faults propagate exactly as before) with
+    capped exponential backoff starting at
     ``BYTEWAX_TPU_RESTART_BACKOFF_S``, jittered per process (seeded
     by ``proc_id``, so restart schedules are deterministic per
     process but desynchronized across the cluster).
+
+    A live reconfiguration (docs/recovery.md "Live partial rescale")
+    unwinds HERE, not to the OS: the run loop returns
+    :class:`_Reconfigure` after committing the agreed epoch close,
+    and this loop re-enters run startup in-process — the same
+    globally-ordered re-entry point a supervised restart uses, so the
+    "re-shard only at run startup" contract holds by construction.  A
+    process whose id falls outside the new address list retires with
+    a :class:`~bytewax_tpu.errors.GracefulStop` instead (its keyed
+    state reaches the survivors through the delta store migration).
 
     The budget and backoff are burst-scoped (the Erlang/k8s
     crash-loop intensity model): an execution that stays healthy for
@@ -465,11 +578,34 @@ def _supervised(
     rng = _backoff.seeded_rng("restart", proc_id)
     attempt = 0
     generation = 0
+    reconfig: Optional[_Reconfigure] = None
     try:
         while True:
             started = time.monotonic()
             try:
-                return make(generation).run()
+                result = make(generation, reconfig).run()
+                if isinstance(result, _Reconfigure):
+                    if proc_id >= max(len(result.addresses), 1):
+                        # This process retires: the agreed close
+                        # committed its state, the delta migration
+                        # re-routes it to the survivors, and the
+                        # supervisor reaps a clean exit.
+                        _flight.note_graceful_stop(result.epoch)
+                        return GracefulStop(
+                            result.epoch,
+                            generation=generation,
+                            proc_id=proc_id,
+                        )
+                    # Re-enter run startup in-process at the new
+                    # shape: a new fenced generation, the startup
+                    # agreement round, the (now delta-only) store
+                    # migration, fresh runtime builds — everything a
+                    # process relaunch would do, minus the process.
+                    reconfig = result
+                    generation += 1
+                    attempt = 0  # a reconfiguration is not a fault
+                    continue
+                return result
             except _RESTARTABLE as ex:
                 # Crash post-mortem (BYTEWAX_TPU_POSTMORTEM_DIR): the
                 # flight ring tail, counters, and the in-flight
@@ -517,6 +653,7 @@ def _supervised(
         # close — and it deliberately survives supervised restarts
         # within the invocation.
         _STOP_EVENT.clear()
+        reset_reconfigure()
 
 
 class _StepError(RuntimeError):
@@ -715,6 +852,14 @@ class _InputRt(_OpRt):
         #: capped backoff schedule while everything else keeps
         #: flowing.  name -> {since, fails, last_error}.
         self._quarantined: Dict[str, Dict[str, Any]] = {}
+        # A fresh runtime has no parked partitions: zero the step's
+        # quarantine gauge so a partition parked by a PREVIOUS
+        # incarnation in this process (supervised restart, live
+        # rescale rebuild) never lingers as a phantom — across a
+        # rescale its ownership may have moved entirely, and the new
+        # owner resumes it from the store's last-good-offset snapshot
+        # and re-quarantines it itself if it is still sick.
+        _flight.note_quarantine_reset(op.step_id)
         if isinstance(source, FixedPartitionedSource):
             # All processes see the same sorted name set, so the
             # partition→worker assignment is globally consistent;
@@ -1085,6 +1230,15 @@ class _InputRt(_OpRt):
         for part in self.parts.values():
             part.close()
         self.parts.clear()
+        if self._quarantined:
+            # Runtime teardown (graceful stop, live-rescale rebuild):
+            # the parked set dies with this runtime — its last good
+            # offsets are already in the store (epoch snapshots cover
+            # frozen partitions every close), so the NEXT owner
+            # resumes each partition from there.  Zero the gauge so
+            # the old owner never reports a phantom parked partition.
+            self._quarantined.clear()
+            _flight.note_quarantine_reset(self.op.step_id)
 
 
 class _FlatMapBatchRt(_OpRt):
@@ -2519,11 +2673,17 @@ class _Driver:
         addresses: Optional[List[str]] = None,
         proc_id: int = 0,
         generation: int = 0,
+        force_rescale: bool = False,
     ):
         self.plan: Plan = flatten(flow)
         #: Supervised-restart generation; tags every cluster frame so
         #: traffic from a dead generation is fenced (see engine/comm).
         self.generation = generation
+        #: The configured cluster address list (empty when meshless);
+        #: the live-reconfigure agreement compares pending targets
+        #: against this so a stale request for the CURRENT shape is a
+        #: no-op instead of a pointless rebuild.
+        self.addresses: List[str] = list(addresses) if addresses else []
         # ``worker_count`` is per process; lanes are globally
         # numbered so keyed routing is identical on every process.
         self.wpp = worker_count
@@ -2676,7 +2836,11 @@ class _Driver:
         #: without it, resuming a store written by a different worker
         #: count refuses with WorkerCountMismatchError instead of
         #: reading keyed rows with a stale route modulus.
-        self.rescale_enabled = os.environ.get(
+        #: ``force_rescale`` is the live-reconfigure re-entry: the
+        #: cluster just AGREED a membership change at an epoch close,
+        #: so the migration is part of the agreed move, not an
+        #: operator opt-in.
+        self.rescale_enabled = force_rescale or os.environ.get(
             "BYTEWAX_TPU_RESCALE", "0"
         ) not in ("", "0")
         #: Worker count(s) the resumed execution was written with,
@@ -2794,6 +2958,21 @@ class _Driver:
         #: stops (any process voted stop): every process breaks out of
         #: its run loop after that close and returns GracefulStop.
         self._stop_agreed = False
+        #: Set (to the agreed target spec) when an epoch close's sync
+        #: round agreed a live membership change: every process breaks
+        #: out after that (committed) close and unwinds to the
+        #: run-startup re-entry in ``_supervised`` — rebuild or
+        #: retire, no process restart (docs/recovery.md "Live partial
+        #: rescale").
+        self._reconfig_agreed: Optional[
+            Tuple[Tuple[str, ...], int]
+        ] = None
+        #: True while the startup rescale migration is pending/running
+        #: on this process (including peers blocked in the post-"fcfg"
+        #: wait): /healthz then reports a distinct ``migrating`` state
+        #: so external supervisors don't misread a long migration as a
+        #: wedged child.
+        self._migrating = self._rescale_from is not None
         #: Recent rescale-hint advice, appended at epoch close (rate
         #: limited) so an external autoscaler's K-consecutive-poll
         #: hysteresis reads the engine's own history instead of
@@ -3026,19 +3205,26 @@ class _Driver:
             with self._ledger_phase("snapshot"):
                 for rt in self.rts:
                     rt.epoch_snaps()  # still clears awoken sets
+        pending_reconfig = self._reconfig_spec(_pending_reconfigure())
         if self.comm is not None:
-            # Epoch-close sync round: the graceful-stop vote plus the
-            # telemetry piggyback.  One gsync round at a globally-
-            # ordered point (every process reaches this exactly once
-            # per close_epoch broadcast), UNCONDITIONAL so the stop
-            # vote always has a ride — the startup "fcfg" round now
-            # only gates whether the summary payload is populated,
-            # not whether the round runs, keeping the round sequence
-            # identical across processes by construction.  Any
-            # process voting stop stops the whole cluster after this
-            # (already committed) close; no new control-frame kinds.
+            # Epoch-close sync round: the graceful-stop vote, the
+            # live-reconfigure proposal, and the telemetry piggyback.
+            # One gsync round at a globally-ordered point (every
+            # process reaches this exactly once per close_epoch
+            # broadcast), UNCONDITIONAL so the stop vote always has a
+            # ride — the startup "fcfg" round now only gates whether
+            # the summary payload is populated, not whether the round
+            # runs, keeping the round sequence identical across
+            # processes by construction.  Any process voting stop
+            # stops the whole cluster after this (already committed)
+            # close; a membership change happens only once EVERY
+            # process carries the SAME pending target (the supervisor
+            # posts it to each child, so partial delivery just defers
+            # the move to a later close); no new control-frame kinds
+            # either way.
             payload = {
                 "stop": _STOP_EVENT.is_set(),
+                "reconfig": pending_reconfig,
                 "summary": (
                     _flight.RECORDER.summary(self.epoch)
                     if self._flight_sync
@@ -3050,6 +3236,12 @@ class _Driver:
             )
             if any(r["stop"] for r in replies.values()):
                 self._stop_agreed = True
+            else:
+                specs = {
+                    r.get("reconfig") for r in replies.values()
+                }
+                if len(specs) == 1 and None not in specs:
+                    self._agree_reconfigure(specs.pop())
             if self._flight_sync:
                 _flight.RECORDER.cluster = {
                     pid: r["summary"]
@@ -3059,6 +3251,8 @@ class _Driver:
             # Single process (or in-process lanes): nothing to agree
             # with — the close that just committed is the stop point.
             self._stop_agreed = True
+        elif pending_reconfig is not None:
+            self._agree_reconfigure(pending_reconfig)
         self.epoch += 1
         _faults.set_epoch(self.epoch)
         _flight.RECORDER.record("epoch_open", epoch=self.epoch)
@@ -3169,7 +3363,43 @@ class _Driver:
             remaining.append((src, msg))
         self._pump_stash[:] = remaining
         while len(got) < self.proc_count:
-            for _src, msg in self.comm.recv_ready(0.01):
+            try:
+                frames = self.comm.recv_ready(0.01)
+            except ClusterPeerDead as ex:
+                # A peer whose payload for THIS round already arrived
+                # has completed the round: its socket closing is a
+                # benign exit, not a death — the terminal sync round
+                # (a final close, a graceful stop, a retiring
+                # process's last close) ends with every process
+                # leaving whenever it has collected all replies, and
+                # at 3+ processes a fast finisher's FIN can overtake
+                # a slow peer's payload frame on a DIFFERENT socket.
+                # Keep collecting; a peer that died BEFORE delivering
+                # its payload still raises (it can never complete the
+                # round), unwinding to the supervisor as before.
+                # recv_ready raises for an ARBITRARY suspect (first
+                # closed peer, or first heartbeat-silent peer), so a
+                # benign exit must not shadow a real death: check
+                # every closed AND every heartbeat-stale peer, not
+                # just the reported one.
+                if ex.peer not in got:
+                    raise
+                dead = sorted(
+                    p
+                    for p in (
+                        self.comm.closed_peers()
+                        | self.comm.stale_peers()
+                    )
+                    if p not in got
+                )
+                if dead:
+                    msg = (
+                        f"cluster peer {dead[0]} went away before "
+                        "completing the sync round"
+                    )
+                    raise ClusterPeerDead(msg, peer=dead[0]) from ex
+                continue
+            for _src, msg in frames:
                 if absorb(msg):
                     continue
                 if msg[0] == "abort":
@@ -3280,6 +3510,78 @@ class _Driver:
                 rt.pipeline_flush()
         return pending
 
+    def _reconfig_spec(
+        self,
+        pending: Optional[Tuple[Tuple[str, ...], Optional[int]]],
+    ) -> Optional[Tuple[Tuple[str, ...], int]]:
+        """Normalize this process's pending reconfigure request into
+        the comparable spec the close round exchanges: the full new
+        address tuple plus an explicit lane count (an unset
+        ``workers_per_process`` means "keep mine" — every process has
+        the same current ``wpp``, so substitution is agreement-safe).
+        """
+        if pending is None:
+            return None
+        addrs, wpp = pending
+        return (addrs, wpp if wpp is not None else self.wpp)
+
+    def _agree_reconfigure(
+        self, spec: Tuple[Tuple[str, ...], int]
+    ) -> None:
+        """The close round just proved every process carries the same
+        pending membership target: consume it, and — unless it names
+        the shape the cluster already has — arm the post-close unwind
+        to the run-startup re-entry point."""
+        import logging
+
+        addrs, wpp = spec
+        _consume_reconfigure((addrs, wpp))
+        if self.store is None:
+            # Without a recovery store the rebuild would resume from
+            # NOTHING: keyed state zeroed, sources replayed from the
+            # start — a silent correctness loss, not a resize.
+            # Refuse deterministically (every process shares the
+            # store config, so the whole cluster refuses together).
+            logging.getLogger(__name__).warning(
+                "refusing live reconfigure: no recovery store is "
+                "configured, so a membership change would discard "
+                "keyed state and replay sources; run with a "
+                "recovery directory (-r) to resize live"
+            )
+            return
+        if os.environ.get("BYTEWAX_TPU_DISTRIBUTED") == "1":
+            # The jax distributed runtime pins num_processes at
+            # initialize time and cannot be re-initialized in this
+            # process: survivors would rebuild against a stale world
+            # size while the joiner dials a coordinator that expects
+            # the old one.  Multi-host pods resize through the full
+            # drain-to-stop relaunch instead (docs/deployment.md).
+            logging.getLogger(__name__).warning(
+                "refusing live reconfigure under "
+                "BYTEWAX_TPU_DISTRIBUTED=1: the jax distributed "
+                "runtime cannot change world size in-process; use "
+                "the drain-to-stop path "
+                "(BYTEWAX_TPU_AUTOSCALE_LIVE=0)"
+            )
+            return
+        same_addrs = list(addrs) == self.addresses or (
+            # A 1-address list and an empty one are both "no mesh".
+            len(addrs) <= 1 and len(self.addresses) <= 1
+        )
+        if same_addrs and wpp == self.wpp:
+            return  # stale request for the current shape: no-op
+        self._reconfig_agreed = (addrs, wpp)
+        _flight.note_reconfigure(len(addrs), wpp, self.epoch)
+        logging.getLogger(__name__).warning(
+            "live reconfigure agreed at epoch %d: %d -> %d "
+            "process(es), %d lane(s)/process; re-entering run "
+            "startup in-process",
+            self.epoch,
+            self.proc_count,
+            max(len(addrs), 1),
+            wpp,
+        )
+
     def _startup_rescale(self, clustered: bool) -> None:
         """Migrate the recovery store to this cluster's worker count
         when the resumed execution was written by a different one.
@@ -3299,8 +3601,17 @@ class _Driver:
         migrated = 0
         if self.proc_id == 0:
             t0 = time.monotonic()
+            # Delta-only (docs/recovery.md "Live partial rescale"):
+            # only rows whose home lane actually changes under the
+            # old→new modulus are rewritten, so the migration — and
+            # bytewax_rescale_migrated_keys — scales with the moved
+            # keys, not the store.  Semantically identical to the
+            # full rewrite (the stamped route column IS the old
+            # placement); legacy/mixed stamps always rewrite.
             migrated = self.store.rescale(
-                self.worker_count, ex_num=self.resume.ex_num - 1
+                self.worker_count,
+                ex_num=self.resume.ex_num - 1,
+                partial=True,
             )
             _flight.note_rescale(
                 self._rescale_from,
@@ -3329,6 +3640,7 @@ class _Driver:
                 ("rescaled", self.next_gsync_tag()), migrated
             )
         self._rescale_from = None
+        self._migrating = False
 
     def _hint_advice(
         self,
@@ -3474,27 +3786,36 @@ class _Driver:
         server answering at all; readiness means run startup finished
         on this process — the mesh handshake, the "fcfg" agreement
         round, any rescale migration, and the runtime builds all
-        completed (the server only starts after them, so an
-        in-startup or mid-restart-backoff process simply refuses the
+        completed.  The server now starts BEFORE the startup
+        agreement/migration, so a not-yet-ready process distinguishes
+        plain ``starting`` from ``migrating`` — the rescale migration
+        running (or this peer blocked in the post-"fcfg" wait behind
+        the coordinator's migration transaction); external
+        supervisors must treat ``migrating`` as live progress, not a
+        wedged child (a mid-restart-backoff process still refuses the
         connection — also not ready).  Once a graceful stop is
         requested the state flips to ``draining`` and readiness drops
         (HTTP 503), so external probes/k8s stop routing new work to a
         cluster that is winding down while liveness stays green."""
         draining = _STOP_EVENT.is_set() or self._stop_agreed
+        if draining:
+            state = "draining"
+        elif self._ready:
+            state = "ready"
+        elif self._migrating:
+            state = "migrating"
+        else:
+            state = "starting"
         return {
             "ready": self._ready and not draining,
             "draining": draining,
-            "state": (
-                "draining"
-                if draining
-                else ("ready" if self._ready else "starting")
-            ),
+            "state": state,
             "proc_id": self.proc_id,
             "generation": self.generation,
             "epoch": self.epoch,
         }
 
-    def run(self) -> Optional[GracefulStop]:
+    def run(self) -> Optional[Any]:
         clustered = self.comm is not None
 
         # Flight recorder: ring writes on only when someone can look
@@ -3512,6 +3833,26 @@ class _Driver:
         _flight.ensure_compile_listener()
         _flight.RECORDER.activate(_flight.enabled())
         _flight.RECORDER.proc_id = self.proc_id
+
+        # The API plane comes up BEFORE the startup agreement round
+        # and any rescale migration: a peer blocked in the post-"fcfg"
+        # wait (or the coordinator mid-migration) answers /healthz
+        # with a distinct ``migrating`` state instead of refusing the
+        # connection, so an external supervisor's all-ready gate and
+        # SIGKILL escalation can tell a long migration from a wedged
+        # child (docs/recovery.md "Live partial rescale").
+        from bytewax_tpu.engine.webserver import maybe_start_server
+
+        api_server = maybe_start_server(
+            self.plan.flow,
+            status_fn=self._status,
+            port_offset=self.api_port_offset,
+            health_fn=self._health,
+            stop_fn=lambda: request_stop("http"),
+            reconfigure_fn=lambda addrs, wpp: request_reconfigure(
+                addrs, wpp, source="http"
+            ),
+        )
         try:
             if clustered:
                 replies = self.global_sync(
@@ -3571,6 +3912,8 @@ class _Driver:
                 shutdown = getattr(rt, "pipeline_shutdown", None)
                 if shutdown is not None:
                     shutdown()
+            if api_server is not None:
+                api_server.shutdown()
             if clustered:
                 self.comm.close()
             if self.store is not None:
@@ -3591,16 +3934,6 @@ class _Driver:
         self._gen = 0
         self._reports: Dict[int, tuple] = {}
         self._last_report: Optional[tuple] = None
-
-        from bytewax_tpu.engine.webserver import maybe_start_server
-
-        api_server = maybe_start_server(
-            self.plan.flow,
-            status_fn=self._status,
-            port_offset=self.api_port_offset,
-            health_fn=self._health,
-            stop_fn=lambda: request_stop("http"),
-        )
         self._ready = True
 
         # Epoch-aligned garbage collection (see _close_epoch); opt
@@ -3635,10 +3968,16 @@ class _Driver:
                     epoch_started = time.monotonic()
                     self._reports = {}
                     self._last_report = None
-                    if final or self._stop_agreed:
+                    if (
+                        final
+                        or self._stop_agreed
+                        or self._reconfig_agreed is not None
+                    ):
                         # EOF, or the close's sync round agreed the
-                        # cluster stops: every process saw the same
-                        # votes, so all exit after this same close.
+                        # cluster stops (or reconfigures): every
+                        # process saw the same votes, so all exit
+                        # (resp. unwind to the run-startup re-entry)
+                        # after this same committed close.
                         break
 
                 if clustered:
@@ -3697,10 +4036,15 @@ class _Driver:
                             for rt in self.rts:
                                 rt.drain()
                         self._close_epoch()
-                        if self._stop_agreed:
-                            # Graceful drain-to-stop: the close above
+                        if (
+                            self._stop_agreed
+                            or self._reconfig_agreed is not None
+                        ):
+                            # Graceful drain-to-stop (or the live
+                            # reconfigure unwind): the close above
                             # committed this epoch's snapshots/DLQ, so
-                            # a resume replays zero epochs.
+                            # the resume — in-process for a
+                            # reconfigure — replays zero epochs.
                             break
                         epoch_started = time.monotonic()
                 else:
@@ -3854,6 +4198,13 @@ class _Driver:
             )
             _flight.note_graceful_stop(status.epoch)
             return status
+        if self._reconfig_agreed is not None:
+            # Internal status: _supervised re-enters run startup
+            # in-process at the new shape (or retires this process).
+            # The runtimes above closed exactly as a graceful stop's
+            # would — the rebuild resumes everything from the store.
+            addrs, wpp = self._reconfig_agreed
+            return _Reconfigure(list(addrs), wpp, self.epoch - 1)
         return None
 
 
@@ -3891,16 +4242,22 @@ def run_main(
     ``BYTEWAX_TPU_RESCALE=1``), in which case the keyed state is
     re-sharded at startup (docs/recovery.md).
     """
-    return _supervised(
-        lambda gen: _Driver(
+    def _make(gen: int, reconf: Optional["_Reconfigure"] = None):
+        addrs = list(reconf.addresses) if reconf is not None else None
+        return _Driver(
             flow,
-            worker_count=1,
+            worker_count=(
+                reconf.wpp if reconf is not None and reconf.wpp else 1
+            ),
             epoch_interval=epoch_interval,
             recovery_config=recovery_config,
+            addresses=addrs if addrs and len(addrs) > 1 else None,
+            proc_id=0,
             generation=gen,
-        ),
-        proc_id=0,
-    )
+            force_rescale=reconf is not None,
+        )
+
+    return _supervised(_make, proc_id=0)
 
 
 def cluster_main(
@@ -3946,17 +4303,25 @@ def cluster_main(
     epoch, and all exit cleanly together (docs/recovery.md "Graceful
     drain-to-stop").
     """
-    return _supervised(
-        lambda gen: _Driver(
+    def _make(gen: int, reconf: Optional["_Reconfigure"] = None):
+        addrs = (
+            list(reconf.addresses)
+            if reconf is not None
+            else addresses
+        )
+        return _Driver(
             flow,
-            worker_count=worker_count_per_proc,
+            worker_count=(
+                reconf.wpp
+                if reconf is not None and reconf.wpp
+                else worker_count_per_proc
+            ),
             epoch_interval=epoch_interval,
             recovery_config=recovery_config,
-            addresses=addresses
-            if addresses and len(addresses) > 1
-            else None,
+            addresses=addrs if addrs and len(addrs) > 1 else None,
             proc_id=proc_id,
             generation=gen,
-        ),
-        proc_id=proc_id,
-    )
+            force_rescale=reconf is not None,
+        )
+
+    return _supervised(_make, proc_id=proc_id)

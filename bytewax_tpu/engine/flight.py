@@ -74,6 +74,9 @@ __all__ = [
     "note_pipeline_depth",
     "note_pipeline_stall",
     "note_quarantine",
+    "note_quarantine_reset",
+    "note_reconfigure",
+    "note_reconfigure_requested",
     "note_rescale",
     "note_resident",
     "note_residency_restore",
@@ -666,6 +669,37 @@ def note_autoscale(
     )
 
 
+def note_reconfigure_requested(
+    n_addresses: int, wpp: Any, source: str
+) -> None:
+    """A live cluster reconfiguration was requested on this process
+    (``http`` for ``POST /reconfigure``, ``api`` for a direct
+    ``request_reconfigure()`` call); the run loop proposes it on the
+    next epoch-close sync round (docs/recovery.md "Live partial
+    rescale")."""
+    RECORDER.count("reconfigure_requested_count")
+    RECORDER.record(
+        "reconfigure_requested",
+        addresses=n_addresses,
+        wpp=wpp,
+        source=source,
+    )
+
+
+def note_reconfigure(n_addresses: int, wpp: int, epoch: int) -> None:
+    """The cluster agreed a live membership change at an epoch close:
+    epoch ``epoch`` committed, and this process unwinds to the
+    run-startup re-entry point to rebuild at the new size (or retire)
+    without leaving the process."""
+    RECORDER.count("reconfigure_count")
+    RECORDER.record(
+        "reconfigure",
+        addresses=n_addresses,
+        wpp=wpp,
+        epoch=epoch,
+    )
+
+
 def note_rescale(
     from_counts: Any, to_count: int, migrated_keys: int, seconds: float
 ) -> None:
@@ -823,6 +857,17 @@ def note_unquarantine(
         part=part,
         parked_s=round(parked_s, 3),
     )
+
+
+def note_quarantine_reset(step_id: str) -> None:
+    """A source runtime was torn down (EOF close, graceful stop, or a
+    live-rescale rebuild): zero the step's quarantined-partition
+    gauge so a partition parked on the OLD owner never lingers as a
+    phantom after its ownership moved — the new owner resumes it from
+    the store's last-good-offset snapshot and re-quarantines it
+    itself if it is still sick."""
+    _quarantine_gauge(step_id).set(0)
+    RECORDER.counters[f"quarantined_partitions[{step_id}]"] = 0
 
 
 def note_dlq(step_id: str, n: int) -> None:

@@ -217,34 +217,53 @@ def rescale_snaps_rows(
     con: sqlite3.Connection,
     new_worker_count: int,
     page_size: int = 1000,
+    partial: bool = False,
 ) -> int:
-    """Re-stamp every ``snaps`` row's ``route`` for a new worker
-    count, paging over distinct state keys so migration memory stays
-    bounded by the page.  Works on any ``snaps``-format SQLite — the
-    recovery partitions and the residency spill tier share the row
-    format AND this migration routine.  Returns the number of
-    distinct keys migrated.  The caller owns the transaction (the
+    """Re-stamp ``snaps`` rows' ``route`` for a new worker count,
+    paging over distinct state keys so migration memory stays bounded
+    by the page.  Works on any ``snaps``-format SQLite — the recovery
+    partitions and the residency spill tier share the row format AND
+    this migration routine.  Returns the number of distinct keys
+    whose rows were rewritten.  The caller owns the transaction (the
     recovery store wraps all partitions in one all-or-nothing
-    transaction; see :meth:`RecoveryStore.rescale`)."""
+    transaction; see :meth:`RecoveryStore.rescale`).
+
+    ``partial`` is the delta-only mode (docs/recovery.md "Live
+    partial rescale"): a key whose stamped route ALREADY equals its
+    home lane under the new modulus is skipped entirely — no UPDATE
+    touches its rows, so migration write cost scales with the keys
+    that actually move, not the store.  The stamped ``route`` column
+    IS the old placement, so no old-count parameter is needed, and
+    the mode is self-healing: legacy ``-1`` stamps and mixed stamps
+    left by a crash mid-migration never compare equal to the new
+    route, so they are always rewritten (re-running the migration is
+    idempotent in both modes)."""
     migrated = 0
     last = ""
     while True:
+        # MIN/MAX expose whether every row of a key already carries
+        # one (the new) route; anything mixed or stale rewrites.
         rows = con.execute(
-            "SELECT DISTINCT state_key FROM snaps "
-            "WHERE state_key > ? ORDER BY state_key LIMIT ?",
+            "SELECT state_key, MIN(route), MAX(route) FROM snaps "
+            "WHERE state_key > ? GROUP BY state_key "
+            "ORDER BY state_key LIMIT ?",
             (last, page_size),
         ).fetchall()
         if not rows:
             return migrated
         last = rows[-1][0]
-        con.executemany(
-            "UPDATE snaps SET route = ? WHERE state_key = ?",
-            [
-                (route_of(key, new_worker_count), key)
-                for (key,) in rows
-            ],
-        )
-        migrated += len(rows)
+        updates = []
+        for key, route_lo, route_hi in rows:
+            new_route = route_of(key, new_worker_count)
+            if partial and route_lo == route_hi == new_route:
+                continue  # home lane unchanged: leave the rows alone
+            updates.append((new_route, key))
+        if updates:
+            con.executemany(
+                "UPDATE snaps SET route = ? WHERE state_key = ?",
+                updates,
+            )
+        migrated += len(updates)
 
 
 class RecoveryStore:
@@ -585,23 +604,35 @@ class RecoveryStore:
     # -- rescale-on-resume -------------------------------------------------
 
     def rescale(
-        self, new_worker_count: int, ex_num: Optional[int] = None
+        self,
+        new_worker_count: int,
+        ex_num: Optional[int] = None,
+        partial: bool = False,
     ) -> int:
-        """Migrate the store to a new worker count: re-stamp every
-        keyed snapshot row's route for the M-worker modulus and
-        rewrite the resumed execution's ``exs`` provenance to the new
-        count, in ONE all-partition transaction (the write_epoch
-        locking pattern) so a crash mid-migration rolls back whole —
-        the supervisor's retry re-enters at run startup and re-runs
-        the migration from scratch.  The pinned ``rescale_migrate``
-        fault site fires before any row moves.  Idempotent: re-running
-        it (e.g. after a crash that committed only some partitions)
+        """Migrate the store to a new worker count: re-stamp keyed
+        snapshot rows' routes for the M-worker modulus and rewrite
+        the resumed execution's ``exs`` provenance to the new count,
+        in ONE all-partition transaction (the write_epoch locking
+        pattern) so a crash mid-migration rolls back whole — the
+        supervisor's retry re-enters at run startup and re-runs the
+        migration from scratch.  The pinned ``rescale_migrate`` fault
+        site fires before any row moves.  Idempotent: re-running it
+        (e.g. after a crash that committed only some partitions)
         recomputes the same routes.  Returns the number of distinct
-        state keys migrated.
+        state keys whose rows were rewritten.
+
+        ``partial`` is the delta-only mode (see
+        :func:`rescale_snaps_rows`): keys whose home lane does not
+        change under old→new are never touched, so the migration —
+        and the returned count, which feeds
+        ``bytewax_rescale_migrated_keys`` — scales with the delta,
+        not the store.  Semantics are identical either way; the live
+        rescale path always passes ``partial=True``.
 
         May run ONLY at run startup — the one globally-ordered
-        re-entry point — and before any process reads keyed snapshots
-        (the driver's startup agreement round orders peers behind the
+        re-entry point (a live reconfiguration re-enters exactly
+        there) — and before any process reads keyed snapshots (the
+        driver's startup agreement round orders peers behind the
         coordinator's migration).
         """
         for _idx, con in sorted(self._cons.items()):
@@ -614,7 +645,10 @@ class RecoveryStore:
             _faults.fire("rescale_migrate")
             for con in self._cons.values():
                 migrated += rescale_snaps_rows(
-                    con, new_worker_count, page_size=self.SNAP_PAGE
+                    con,
+                    new_worker_count,
+                    page_size=self.SNAP_PAGE,
+                    partial=partial,
                 )
                 if ex_num is not None and ex_num >= 0:
                     con.execute(

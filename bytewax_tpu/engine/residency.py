@@ -279,18 +279,25 @@ class SpillStore:
             "DELETE FROM snaps WHERE step_id = ?", (self.step_id,)
         )
 
-    def rescale(self, new_worker_count: int) -> int:
-        """Re-stamp every spilled row's home lane for a new worker
-        count — the spill tier speaks the recovery ``snaps`` row
-        format, so it migrates through the SAME routine the recovery
-        partitions do.  Spill files are per-execution ephemeral (a
-        restart resumes spilled keys from the *recovery* store), so
-        the engine never calls this on the resume path; it exists so
-        the format contract stays closed: any snaps-format file in
+    def rescale(
+        self, new_worker_count: int, partial: bool = False
+    ) -> int:
+        """Re-stamp spilled rows' home lanes for a new worker count —
+        the spill tier speaks the recovery ``snaps`` row format, so it
+        migrates through the SAME routine the recovery partitions do,
+        including the delta-only ``partial`` mode (rows whose home
+        lane does not change are never rewritten).  Spill files are
+        per-execution ephemeral (a restart — and a live
+        reconfiguration, which unwinds to the same run-startup
+        re-entry — resumes spilled keys from the *recovery* store),
+        so the engine never calls this on the resume path; it exists
+        so the format contract stays closed: any snaps-format file in
         the system is rescalable."""
         from bytewax_tpu.engine.recovery_store import rescale_snaps_rows
 
-        migrated = rescale_snaps_rows(self._con, new_worker_count)
+        migrated = rescale_snaps_rows(
+            self._con, new_worker_count, partial=partial
+        )
         self.worker_count = new_worker_count
         return migrated
 

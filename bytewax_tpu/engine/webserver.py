@@ -25,7 +25,15 @@ Reference parity (``/root/reference/src/webserver/mod.rs``): when
   (docs/recovery.md "Graceful drain-to-stop"): the flow commits the
   in-flight epoch at the next close and exits with a typed
   ``GracefulStop`` status; any one process's ``/stop`` stops the
-  whole cluster via the epoch-close sync round, and
+  whole cluster via the epoch-close sync round,
+- ``POST /reconfigure`` — request a live cluster membership change
+  (docs/recovery.md "Live partial rescale"): body
+  ``{"addresses": [...], "workers_per_process": N?}`` records the
+  pending target; once EVERY process carries the same target the
+  change agrees at an epoch close and each process rebuilds (or
+  retires) at the run-startup re-entry point without leaving the
+  process.  Same loopback-only guard as ``/stop``
+  (``BYTEWAX_TPU_ALLOW_REMOTE_STOP``), and
 - ``GET /stacks`` — a ``faulthandler``-style plain-text dump of every
   thread's current Python stack (main loop, pipeline workers, comm),
   for diagnosing a hung barrier without attaching py-spy.
@@ -75,31 +83,61 @@ class _Handler(BaseHTTPRequestHandler):
     status_fn: Optional[Callable[[], dict]] = None
     health_fn: Optional[Callable[[], dict]] = None
     stop_fn: Optional[Callable[[], None]] = None
+    reconfigure_fn: Optional[Callable[[list, Optional[int]], None]] = None
 
-    def do_POST(self) -> None:  # noqa: N802
-        if self.path != "/stop" or type(self).stop_fn is None:
-            self.send_response(404)
-            self.end_headers()
-            return
-        # Cooperative drain-to-stop (docs/recovery.md): flag the run
-        # loop and acknowledge; the flow stops at the next epoch
-        # close, so the response races the exit deliberately — the
-        # caller polls /healthz (``draining``) or waits for the
-        # process to finish.
-        try:
-            type(self).stop_fn()
-            body = json.dumps({"stopping": True}).encode()
-            code = 200
-        except Exception as ex:  # noqa: BLE001 - never 500 the plane
-            body = json.dumps(
-                {"stopping": False, "error": str(ex)}
-            ).encode()
-            code = 500
+    def _respond_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path == "/stop" and type(self).stop_fn is not None:
+            # Cooperative drain-to-stop (docs/recovery.md): flag the
+            # run loop and acknowledge; the flow stops at the next
+            # epoch close, so the response races the exit
+            # deliberately — the caller polls /healthz (``draining``)
+            # or waits for the process to finish.
+            try:
+                type(self).stop_fn()
+                self._respond_json(200, {"stopping": True})
+            except Exception as ex:  # noqa: BLE001 - never 500 the plane
+                self._respond_json(
+                    500, {"stopping": False, "error": str(ex)}
+                )
+            return
+        if (
+            self.path == "/reconfigure"
+            and type(self).reconfigure_fn is not None
+        ):
+            # Live membership change (docs/recovery.md "Live partial
+            # rescale"): record the pending target; the run loop
+            # proposes it on the next epoch-close sync round and the
+            # move happens once every process carries the same
+            # target.  Body: {"addresses": [...],
+            # "workers_per_process": N?}.
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(length) or b"{}")
+                addresses = req.get("addresses")
+                if not isinstance(addresses, list):
+                    msg = "body must carry an 'addresses' list"
+                    raise ValueError(msg)
+                wpp = req.get("workers_per_process")
+                type(self).reconfigure_fn(
+                    [str(a) for a in addresses],
+                    int(wpp) if wpp is not None else None,
+                )
+                self._respond_json(200, {"reconfiguring": True})
+            except Exception as ex:  # noqa: BLE001 - never 500 the plane
+                self._respond_json(
+                    400, {"reconfiguring": False, "error": str(ex)}
+                )
+            return
+        self.send_response(404)
+        self.end_headers()
 
     def do_GET(self) -> None:  # noqa: N802
         code = 200
@@ -169,6 +207,9 @@ def maybe_start_server(
     port_offset: int = 0,
     health_fn: Optional[Callable[[], dict]] = None,
     stop_fn: Optional[Callable[[], None]] = None,
+    reconfigure_fn: Optional[
+        Callable[[list, Optional[int]], None]
+    ] = None,
 ) -> Optional[_ApiServer]:
     """Start the API server if ``BYTEWAX_DATAFLOW_API_ENABLED`` is
     set (to anything but ``0``); returns a handle to shut it down,
@@ -179,8 +220,10 @@ def maybe_start_server(
     returns the ``/healthz`` readiness payload (at minimum a
     ``ready`` bool — absent means always-ready); ``stop_fn`` arms
     ``POST /stop`` (a cooperative drain-to-stop request — 404 when
-    absent); ``port_offset`` is this process's rank among co-located
-    cluster processes."""
+    absent); ``reconfigure_fn`` arms ``POST /reconfigure`` (a live
+    membership-change request, docs/recovery.md "Live partial
+    rescale" — same loopback guard as ``/stop``); ``port_offset`` is
+    this process's rank among co-located cluster processes."""
     from bytewax_tpu.engine.flight import _truthy
 
     if not _truthy("BYTEWAX_DATAFLOW_API_ENABLED"):
@@ -209,26 +252,31 @@ def maybe_start_server(
         int(os.environ.get("BYTEWAX_DATAFLOW_API_PORT", _DEFAULT_PORT))
         + port_offset
     )
-    if stop_fn is not None and host not in (
+    if (
+        stop_fn is not None or reconfigure_fn is not None
+    ) and host not in (
         "127.0.0.1",
         "localhost",
         "::1",
     ):
-        # POST /stop is the plane's one mutating endpoint and carries
-        # no auth: off loopback (the probe-wiring 0.0.0.0 case) it
-        # would let any network peer drain the whole cluster.  Serve
-        # it there only behind the explicit opt-in knob; the
-        # read-only endpoints stay up either way.
+        # POST /stop and /reconfigure are the plane's mutating
+        # endpoints and carry no auth: off loopback (the probe-wiring
+        # 0.0.0.0 case) they would let any network peer drain — or
+        # resize — the whole cluster.  Serve them there only behind
+        # the explicit opt-in knob; the read-only endpoints stay up
+        # either way.
         if os.environ.get(
             "BYTEWAX_TPU_ALLOW_REMOTE_STOP", "0"
         ) in ("", "0"):
             logger.warning(
-                "POST /stop disabled on non-loopback bind %s; set "
+                "POST /stop and /reconfigure disabled on "
+                "non-loopback bind %s; set "
                 "BYTEWAX_TPU_ALLOW_REMOTE_STOP=1 to accept remote "
-                "stop requests (docs/deployment.md)",
+                "stop/reconfigure requests (docs/deployment.md)",
                 host,
             )
             stop_fn = None
+            reconfigure_fn = None
     handler = type(
         "_BoundHandler",
         (_Handler,),
@@ -237,6 +285,7 @@ def maybe_start_server(
             "status_fn": staticmethod(status_fn),
             "health_fn": staticmethod(health_fn),
             "stop_fn": staticmethod(stop_fn),
+            "reconfigure_fn": staticmethod(reconfigure_fn),
         },
     )
     try:

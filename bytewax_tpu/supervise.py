@@ -19,19 +19,29 @@ primitives the engine already has:
   grow/shrink samples inside the ``--autoscale MIN:MAX`` bounds and
   past the ``BYTEWAX_TPU_AUTOSCALE_COOLDOWN_S`` cooldown trigger a
   move (:func:`decide_scale` — flapping advice never does).
-- **Act**: a coordinated move is a graceful drain-to-stop
-  (``POST /stop`` — any one process's vote stops the whole cluster at
-  the next epoch close, snapshots committed, zero replayed epochs;
-  SIGTERM is the fallback, SIGKILL the
-  ``BYTEWAX_TPU_AUTOSCALE_STOP_TIMEOUT_S`` escalation) followed by a
-  relaunch at the new size with ``BYTEWAX_TPU_RESCALE=1``, so the
+- **Act**: a coordinated move defaults to the **live partial
+  rescale** (docs/recovery.md "Live partial rescale";
+  ``BYTEWAX_TPU_AUTOSCALE_LIVE=0`` opts out): the joiner boots while
+  the cluster keeps serving, the membership change is posted
+  (``POST /reconfigure``) and agreed on an epoch-close sync round,
+  survivors re-enter run startup in-process, the retiree exits after
+  the agreed close, and the store migration rewrites only
+  changed-route keys.  A live move that cannot complete falls back
+  to the legacy whole-cluster path: graceful drain-to-stop
+  (``POST /stop`` — any one process's vote stops the whole cluster
+  at the next epoch close, snapshots committed, zero replayed
+  epochs; SIGTERM is the fallback, SIGKILL the
+  ``BYTEWAX_TPU_AUTOSCALE_STOP_TIMEOUT_S`` escalation — extended
+  while a child reports the ``migrating`` health state) followed by
+  a relaunch at the new size with ``BYTEWAX_TPU_RESCALE=1``, so the
   startup migration re-shards the keyed state (docs/recovery.md).
 
-Process-local by contract: the supervisor is HTTP polls and OS
-process management only — it never constructs a comm mesh, never
-touches a send primitive or a sync round, and never initializes jax
-(the children import the dataflow).  ``tests/test_comm_invariants.py``
-pins this, and the contract analyzer proves it over the call graph.
+Process-local by contract: the supervisor is HTTP polls, a
+connect-and-close listener probe, and OS process management only —
+it never constructs a comm mesh, never touches a send primitive or a
+sync round, and never initializes jax (the children import the
+dataflow).  ``tests/test_comm_invariants.py`` pins this, and the
+contract analyzer proves it over the call graph.
 """
 
 import argparse
@@ -142,6 +152,52 @@ def _post_stop(port: int) -> bool:
         return False
 
 
+def _post_reconfigure(
+    port: int, addresses: List[str], wpp: Optional[int]
+) -> bool:
+    """``POST /reconfigure`` one child's pending membership target
+    (docs/recovery.md "Live partial rescale"); True when the child
+    acknowledged.  Idempotent — the live move re-posts every watch
+    tick until the cluster-wide agreement lands."""
+    body: Dict[str, Any] = {"addresses": addresses}
+    if wpp is not None:
+        body["workers_per_process"] = wpp
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/reconfigure",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=_HTTP_TIMEOUT_S) as rsp:
+            return json.loads(rsp.read() or b"{}").get(
+                "reconfiguring", False
+            )
+    except (urllib.error.URLError, OSError, ValueError):
+        return False
+
+
+def _comm_port_listening(address: str) -> bool:
+    """Whether something is LISTENING on a cluster comm address — the
+    probe the live move uses to know a joining process has reached
+    its mesh handshake (its listener binds before anything else; the
+    supervisor's own port holder never listens, so a refused connect
+    means the child is not there yet).  The joiner's accept loop
+    tolerates the immediately-closed probe connection."""
+    host, _, port = address.rpartition(":")
+    try:
+        sock = socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=0.5
+        )
+    except OSError:
+        return False
+    try:
+        sock.close()
+    except OSError:
+        pass
+    return True
+
+
 def _get_status(port: int) -> Optional[Dict[str, Any]]:
     try:
         with urllib.request.urlopen(
@@ -249,6 +305,23 @@ class ClusterSupervisor:
             )
             or 60
         )
+        #: Live partial rescale (docs/recovery.md): a scale move is an
+        #: epoch-boundary membership change — the joiner boots while
+        #: the cluster keeps serving, survivors re-enter run startup
+        #: in-process, and only changed-route keys migrate.  Default
+        #: on; ``BYTEWAX_TPU_AUTOSCALE_LIVE=0`` forces every move
+        #: down the legacy whole-cluster drain-to-stop + relaunch
+        #: path (also the automatic fallback when a live move cannot
+        #: complete).
+        self.live = os.environ.get(
+            "BYTEWAX_TPU_AUTOSCALE_LIVE", "1"
+        ) not in ("", "0")
+        #: Diagnostics of the most recent completed live move
+        #: (tests/bench): action, sizes, surviving pids, and a
+        #: surviving child's epoch sampled before/after — epochs
+        #: advancing across the move proves the non-moving workers
+        #: kept closing epochs while it happened.
+        self.last_live_move: Optional[Dict[str, Any]] = None
         # Relaunch flap control: the burst-scoped restart-budget
         # pattern the in-process supervisor uses — capped jittered
         # exponential backoff that resets after a healthy window.
@@ -401,11 +474,27 @@ class ClusterSupervisor:
                 return False
         return True
 
+    def _any_migrating(self) -> bool:
+        """Whether any live child reports the ``migrating`` health
+        state — a rescale migration (or a peer waiting behind one) in
+        progress.  That is live progress, not a wedged child: the
+        stop/retire escalation ladders extend their deadlines instead
+        of SIGKILLing a mid-migration store transaction."""
+        for rank, p in enumerate(self.children):
+            if p.poll() is not None:
+                continue
+            health = _get_health((self.api_base_port or 0) + rank)
+            if health is not None and health.get("state") == "migrating":
+                return True
+        return False
+
     def _stop_cluster(self) -> None:
         """Coordinated graceful stop: one ``POST /stop`` is enough
         (the vote rides the epoch-close sync round cluster-wide);
         SIGTERM every child as the fallback, escalating to SIGKILL
-        after the stop timeout."""
+        after the stop timeout.  A child mid-migration extends the
+        escalation deadline (bounded) — killing the store transaction
+        would only force the next generation to redo it."""
         posted = False
         for rank in range(len(self.children)):
             if self.children[rank].poll() is not None:
@@ -420,7 +509,17 @@ class ClusterSupervisor:
                         p.terminate()
                     except OSError:
                         pass
-        if not self._wait_children(self.stop_timeout_s):
+        stopped = self._wait_children(self.stop_timeout_s)
+        extensions = 0
+        while not stopped and extensions < 5 and self._any_migrating():
+            logger.info(
+                "children still migrating; extending graceful-stop "
+                "wait (%d)",
+                extensions + 1,
+            )
+            extensions += 1
+            stopped = self._wait_children(self.stop_timeout_s)
+        if not stopped:
             logger.warning(
                 "graceful stop timed out after %.0fs; escalating",
                 self.stop_timeout_s,
@@ -479,6 +578,31 @@ class ClusterSupervisor:
         return self._backoff.next_delay()
 
     def _scale_to(self, target: int, reason: str = "") -> None:
+        """One confirmed scale move.  The live partial-rescale path is
+        the default (docs/recovery.md "Live partial rescale"): the
+        cluster keeps serving while the membership change rides an
+        epoch close and only changed-route keys migrate.  Anything
+        that keeps a live move from completing — a joiner that never
+        reaches its handshake, a child whose control plane is gone,
+        the agreement not landing before the timeout — falls back to
+        the legacy whole-cluster drain-to-stop + relaunch, which is
+        also what ``BYTEWAX_TPU_AUTOSCALE_LIVE=0`` forces."""
+        if self.live and self.recovery_dir is not None:
+            try:
+                if self._scale_to_live(target, reason):
+                    return
+            except Exception:  # noqa: BLE001 - fall back, never die
+                logger.exception("live scale move failed")
+            logger.warning(
+                "live scale move did not complete; falling back to "
+                "the drain-to-stop path"
+            )
+        self._scale_to_restart(target, reason)
+
+    def _scale_to_restart(self, target: int, reason: str = "") -> None:
+        """The legacy stop-the-world move: coordinated graceful drain
+        of the WHOLE cluster, then a relaunch at the new size (the
+        startup migration re-shards the keyed state)."""
         action = "grow" if target > self.current else "shrink"
         logger.warning(
             "autoscale %s: %d -> %d process(es) (%s)",
@@ -501,6 +625,177 @@ class ClusterSupervisor:
         self._last_scale_at = time.monotonic()
         self._generation += 1
         self._launch(target)
+
+    def _live_move_done(self, old: int, target: int) -> bool:
+        """Whether the posted membership change has fully landed: all
+        retirees exited cleanly, and every member of the new cluster
+        reports ready at the new process count."""
+        for rank in range(target, old):
+            if self.children[rank].poll() is None:
+                return False
+        want_count = max(target, 1)
+        for rank in range(target):
+            health = _get_health((self.api_base_port or 0) + rank)
+            if health is None or not health.get("ready"):
+                return False
+            status = _get_status((self.api_base_port or 0) + rank)
+            if (
+                status is None
+                or status.get("proc_count") != want_count
+            ):
+                return False
+        return True
+
+    def _scale_to_live(self, target: int, reason: str = "") -> bool:
+        """The live partial-rescale move (docs/recovery.md): spawn the
+        joiner (grow) while the cluster keeps serving, wait until it
+        reaches its mesh handshake, then post the new membership to
+        every existing child — the change agrees on an epoch-close
+        sync round, survivors re-enter run startup in-process, the
+        retiree (shrink) exits after the agreed close, and the store
+        migration moves only changed-route keys.  True when the move
+        fully landed; False (after cleaning up any joiner) tells the
+        caller to fall back to the drain-to-stop path."""
+        action = "grow" if target > self.current else "shrink"
+        old = self.current
+        logger.warning(
+            "autoscale %s (live): %d -> %d process(es) (%s)",
+            action,
+            old,
+            target,
+            reason or "hint",
+        )
+        # Survivors keep their comm slots; grow appends freshly-held
+        # ports (from 1 process there is no mesh yet — all slots are
+        # fresh).  A 1-address list below means "no mesh" to the
+        # children, same as the launch path's empty list.
+        new_addresses = list(self.addresses[:target])
+        while len(new_addresses) < max(target, 2) and target > 1:
+            s = self._hold_port()
+            self._holders.append(s)
+            new_addresses.append(
+                f"127.0.0.1:{s.getsockname()[1]}"
+            )
+        move: Dict[str, Any] = {
+            "action": action,
+            "from_procs": old,
+            "to_procs": target,
+            "pids_before": [p.pid for p in self.children],
+            "epoch_before": (
+                (_get_status(self.api_base_port or 0) or {}).get(
+                    "epoch"
+                )
+            ),
+        }
+        self._generation += 1
+        self.addresses = new_addresses
+
+        def abort_live() -> bool:
+            # Reap this attempt's joiners before falling back: a
+            # handshake-blocked joiner has no run loop to drain, so
+            # leaving it in self.children would make the fallback's
+            # graceful stop burn its whole timeout waiting on a
+            # process that can never exit cooperatively.
+            for p in self.children[old:]:
+                if p.poll() is None:
+                    try:
+                        p.terminate()
+                    except OSError:
+                        pass
+            for p in self.children[old:]:
+                try:
+                    p.wait(timeout=_TERM_GRACE_S)
+                except subprocess.TimeoutExpired:
+                    try:
+                        p.kill()
+                    except OSError:
+                        pass
+            del self.children[old:]
+            return False
+
+        # Joiners boot while the old cluster keeps processing — their
+        # interpreter/jax startup is OUTSIDE the service interruption.
+        for rank in range(old, target):
+            self.children.append(self._spawn_child(rank))
+        deadline = time.monotonic() + self.stop_timeout_s
+        for rank in range(old, target):
+            while not _comm_port_listening(new_addresses[rank]):
+                if (
+                    self.children[rank].poll() is not None
+                    or time.monotonic() > deadline
+                ):
+                    logger.warning(
+                        "joiner %d never reached its mesh handshake",
+                        rank,
+                    )
+                    return abort_live()
+                time.sleep(0.05)
+        # Post the target to every pre-move child (the retiree too:
+        # its vote is part of the agreement).  Re-post every tick —
+        # idempotent — until the move lands, so one lost POST just
+        # defers the agreement to a later epoch close.  Fresh budget:
+        # the joiner's interpreter/jax boot above must not eat the
+        # agreement-and-rebuild window (a modest stop timeout sized
+        # for the drain path would otherwise make every live move
+        # fall back before it could land).
+        deadline = time.monotonic() + self.stop_timeout_s
+        extensions = 0
+        while True:
+            for rank in range(old):
+                if self.children[rank].poll() is None:
+                    _post_reconfigure(
+                        (self.api_base_port or 0) + rank,
+                        new_addresses,
+                        self.wpp,
+                    )
+            if self._live_move_done(old, target):
+                break
+            if time.monotonic() > deadline:
+                if extensions < 5 and self._any_migrating():
+                    # A migration in flight is live progress, not a
+                    # wedge: extend (bounded — a store transaction
+                    # hung on dead storage must still fall back
+                    # eventually) rather than abandon a mid-move
+                    # cluster.
+                    extensions += 1
+                    deadline = time.monotonic() + self.stop_timeout_s
+                    continue
+                logger.warning(
+                    "live move did not land within %.0fs",
+                    self.stop_timeout_s,
+                )
+                return abort_live()
+            time.sleep(0.2)
+        # Retirees exited cleanly; drop them and their comm slots.
+        self.children = self.children[:max(target, 1)]
+        for s in self._holders[target:]:
+            try:
+                s.close()
+            except OSError:
+                pass
+        del self._holders[target:]
+        move["pids_after"] = [p.pid for p in self.children]
+        move["epoch_after"] = (
+            (_get_status(self.api_base_port or 0) or {}).get("epoch")
+        )
+        self.last_live_move = move
+        _flight.note_autoscale(
+            action, old, target, f"live:{reason or 'hint'}"
+        )
+        self.actions.append((action, old, target))
+        self._history.clear()
+        self._last_scale_at = time.monotonic()
+        self.current = target
+        self._all_ready = False
+        self._last_sample_marker = None
+        logger.warning(
+            "live %s complete: %d -> %d process(es), surviving "
+            "children untouched",
+            action,
+            old,
+            target,
+        )
+        return True
 
     def request_stop(self) -> None:
         """Ask the supervisor to gracefully stop the cluster and

@@ -83,7 +83,13 @@ def test_control_frame_inventory_is_pinned():
     # deliberately added no frame kinds: supervised-restart signaling
     # rides socket closes plus per-frame generation fencing.  The
     # residency PR added none either: eviction/restore/spill are
-    # process-local tier movement — nothing rides the mesh.)
+    # process-local tier movement — nothing rides the mesh.  The
+    # live-rescale PR added none either, deliberately: the
+    # membership-change proposal is a field in the EXISTING
+    # epoch-close "fstat" gsync payload (like the stop vote), the
+    # join/retire handshake is the existing generation-fenced mesh
+    # handshake re-entered at run startup, and keyed state moves
+    # through the shared recovery store — never the wire.)
     assert contracts.CONTROL_FRAMES == {
         "deliver",
         "route",
@@ -370,7 +376,7 @@ def test_worker_lane_inventory_is_pinned():
 
 
 def test_knob_catalog_is_pinned():
-    """The knob inventory: exactly today's 50 BYTEWAX_TPU_* knobs,
+    """The knob inventory: exactly today's 51 BYTEWAX_TPU_* knobs,
     each with a default and a doc anchor.  Adding a knob requires
     updating contracts.KNOBS, this list, docs/configuration.md, and
     the anchor doc — BTX-KNOB enforces the rest (literal reads,
@@ -379,12 +385,16 @@ def test_knob_catalog_is_pinned():
     supervisor (bytewax_tpu/supervise.py) and
     BYTEWAX_TPU_ALLOW_REMOTE_STOP (the POST /stop non-loopback
     opt-in in engine/webserver.py), all anchored at
-    docs/deployment.md."""
+    docs/deployment.md.  The live-rescale PR added exactly one:
+    BYTEWAX_TPU_AUTOSCALE_LIVE (default on — a scale move is an
+    epoch-boundary membership change with delta-only migration; 0
+    forces the legacy whole-cluster drain-to-stop + relaunch)."""
     assert sorted(contracts.KNOBS) == [
         "BYTEWAX_TPU_ACCEL",
         "BYTEWAX_TPU_ALLOW_REMOTE_STOP",
         "BYTEWAX_TPU_AUTOSCALE_COOLDOWN_S",
         "BYTEWAX_TPU_AUTOSCALE_HYSTERESIS",
+        "BYTEWAX_TPU_AUTOSCALE_LIVE",
         "BYTEWAX_TPU_AUTOSCALE_POLL_S",
         "BYTEWAX_TPU_AUTOSCALE_STOP_TIMEOUT_S",
         "BYTEWAX_TPU_COMPILE_CACHE",
@@ -432,7 +442,7 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_TRACE_DIR",
         "BYTEWAX_TPU_WIRE",
     ]
-    assert len(contracts.KNOBS) == 50
+    assert len(contracts.KNOBS) == 51
     for name, (default, doc) in contracts.KNOBS.items():
         assert isinstance(default, str), name
         assert doc.startswith("docs/") and doc.endswith(".md"), name
@@ -441,14 +451,18 @@ def test_knob_catalog_is_pinned():
 
 
 def test_supervisor_is_process_local():
-    """The autoscaling-loop PR pin: the outer cluster supervisor
-    (bytewax_tpu/supervise.py) and the graceful-stop surfaces are
-    HTTP + OS process management only.  The frame-kind inventory
-    above is byte-identical (the stop vote rides the EXISTING
-    epoch-close gsync round — no new kinds), no allowlist grew to
-    admit the supervisor, and none of its functions call a raw send
-    primitive, a ship method, or a sync round — so it can never
-    reach the send surface or early-exit a collective tier."""
+    """The autoscaling-loop PR pin (extended by the live-rescale PR):
+    the outer cluster supervisor (bytewax_tpu/supervise.py) and the
+    graceful-stop/live-reconfigure surfaces are HTTP + OS process
+    management only.  The frame-kind inventory above is
+    byte-identical (the stop vote AND the membership-change proposal
+    ride the EXISTING epoch-close gsync round — no new kinds; the
+    live move's only new supervisor surfaces are a POST /reconfigure
+    and a connect-and-close listener probe, both plain sockets/HTTP,
+    never mesh frames), no allowlist grew to admit the supervisor,
+    and none of its functions call a raw send primitive, a ship
+    method, or a sync round — so it can never reach the send surface
+    or early-exit a collective tier."""
     modules = {"bytewax_tpu.supervise"}
     allowlisted = (
         set().union(*contracts.SEND_ALLOWED.values())

@@ -242,6 +242,103 @@ def test_webserver_stop_endpoint_and_draining(tmp_path, monkeypatch):
         srv.shutdown()
 
 
+def test_webserver_reconfigure_endpoint(tmp_path, monkeypatch):
+    # POST /reconfigure (docs/recovery.md "Live partial rescale")
+    # records the pending membership target; malformed bodies are a
+    # 400, not a 500 (the plane never dies), and without a
+    # reconfigure_fn the path stays a 404.
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_ENABLED", "1")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_PORT", "0")
+    from bytewax_tpu.engine.webserver import maybe_start_server
+
+    got = []
+    srv = maybe_start_server(
+        _sum_flow([("a", 1.0)], []),
+        reconfigure_fn=lambda addrs, wpp: got.append((addrs, wpp)),
+    )
+    assert srv is not None
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        body = json.dumps(
+            {
+                "addresses": ["127.0.0.1:9001", "127.0.0.1:9002"],
+                "workers_per_process": 2,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            base + "/reconfigure", data=body, method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=5) as rsp:
+            assert json.loads(rsp.read())["reconfiguring"] is True
+        assert got == [(["127.0.0.1:9001", "127.0.0.1:9002"], 2)]
+
+        req = urllib.request.Request(
+            base + "/reconfigure",
+            data=json.dumps({"addresses": "nope"}).encode(),
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc_info.value.code == 400
+        assert len(got) == 1  # the bad body recorded nothing
+    finally:
+        srv.shutdown()
+
+    srv = maybe_start_server(_sum_flow([("a", 1.0)], []))
+    assert srv is not None
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/reconfigure",
+            data=b"{}",
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc_info.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_health_reports_migrating_during_pending_rescale(tmp_path):
+    # The /healthz `migrating` state (docs/recovery.md "Live partial
+    # rescale"): a driver built against a store written at a
+    # different worker count reports state=migrating (not a bare
+    # starting/503) until the startup migration completes — external
+    # supervisors must read it as live progress.  Built through the
+    # REAL resume path: a run at 2 lanes populates the store, then a
+    # driver at 3 lanes with rescale forced on is constructed (the
+    # construction computes the rescale view; run() would migrate).
+    from bytewax_tpu.engine.driver import _Driver, cluster_main
+
+    db = tmp_path / "db"
+    db.mkdir()
+    init_db_dir(db, 1)
+    inp = [(f"k{i % 4}", float(i)) for i in range(16)]
+    cluster_main(
+        _sum_flow(inp, []),
+        [],
+        0,
+        worker_count_per_proc=2,
+        epoch_interval=ZERO_TD,
+        recovery_config=RecoveryConfig(str(db)),
+    )
+    drv = _Driver(
+        _sum_flow(inp, []),
+        worker_count=3,
+        epoch_interval=ZERO_TD,
+        recovery_config=RecoveryConfig(str(db)),
+        force_rescale=True,
+    )
+    try:
+        health = drv._health()
+        assert health["state"] == "migrating"
+        assert health["ready"] is False
+        assert drv._migrating is True
+    finally:
+        drv.store.close()
+
+
 def test_webserver_remote_stop_requires_opt_in(tmp_path, monkeypatch):
     # POST /stop is the plane's one mutating endpoint: on a
     # non-loopback bind (the k8s probe-wiring case) it is disabled

@@ -570,11 +570,19 @@ def test_dlq_truncate_for_resume_unit(tmp_path):
 
 class _TwoPartSource(FixedPartitionedSource):
     """p_good streams n items; p_bad fails its first ``fail_polls``
-    polls with a typed transient error, then streams its items."""
+    polls with a typed transient error, then streams its items.
 
-    def __init__(self, n, fail_polls):
+    ``good_delay_ms`` paces p_good's emissions via ``next_awake`` so
+    its stream deterministically outlasts p_bad's retry/quarantine
+    window — without it the assertion "epochs keep closing while
+    p_bad is parked" races the microsecond-scale run loop (p_good can
+    drain its handful of items before p_bad's first backoff even
+    expires)."""
+
+    def __init__(self, n, fail_polls, good_delay_ms=0.0):
         self._n = n
         self._fail_polls = fail_polls
+        self._good_delay_ms = good_delay_ms
         self.bad_fails = {"left": fail_polls}
 
     def list_parts(self):
@@ -586,6 +594,7 @@ class _TwoPartSource(FixedPartitionedSource):
         class Part(StatefulSourcePartition):
             def __init__(self):
                 self._i = resume or 0
+                self._awake = None
 
             def next_batch(self):
                 if name == "p_bad" and src.bad_fails["left"] > 0:
@@ -594,7 +603,16 @@ class _TwoPartSource(FixedPartitionedSource):
                 if self._i >= src._n:
                     raise StopIteration()
                 self._i += 1
+                if name == "p_good" and src._good_delay_ms:
+                    from datetime import datetime, timezone
+
+                    self._awake = datetime.now(
+                        timezone.utc
+                    ) + timedelta(milliseconds=src._good_delay_ms)
                 return [(name, self._i)]
+
+            def next_awake(self):
+                return self._awake
 
             def snapshot(self):
                 return self._i
@@ -610,7 +628,7 @@ def test_quarantine_parks_partition_keeps_rest_flowing(monkeypatch):
     _io_env(monkeypatch, retries=1, backoff="0.002")
     monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
     n = 8
-    src = _TwoPartSource(n, fail_polls=4)
+    src = _TwoPartSource(n, fail_polls=4, good_delay_ms=3)
     out = []
     flow = Dataflow("quarantine_df")
     s = op.input("inp", flow, src)
@@ -640,6 +658,75 @@ def test_quarantine_parks_partition_keeps_rest_flowing(monkeypatch):
         )
         == 0
     )
+
+
+def test_quarantine_resets_on_runtime_close_and_hands_off_offset(
+    tmp_path, monkeypatch
+):
+    # The live-rescale quarantine fix (docs/recovery.md "Live partial
+    # rescale"): a partition still PARKED when its runtime is torn
+    # down (graceful stop here; a rescale rebuild walks the same
+    # close path) must not leave a phantom
+    # bytewax_quarantined_partitions gauge on the old owner — and the
+    # next owner resumes it from the store's frozen last-good-offset
+    # snapshot instead of re-reading from zero.
+    monkeypatch.setenv("BYTEWAX_TPU_QUARANTINE", "1")
+    _io_env(monkeypatch, retries=1, backoff="0.002")
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    flight.RECORDER.activate(True)
+    from bytewax_tpu.engine import driver as _driver
+
+    db = tmp_path / "db"
+    db.mkdir()
+    init_db_dir(db, 1)
+    rc = RecoveryConfig(str(db))
+    n = 8
+    # p_bad never heals during run 1: it stays parked at its last
+    # good offset (0) while p_good streams out, then a graceful stop
+    # drains the run with the partition STILL quarantined.
+    src = _TwoPartSource(n, fail_polls=10_000)
+    seen = {"count": 0}
+
+    def trig(item):
+        seen["count"] += 1
+        if seen["count"] == n:
+            _driver.request_stop()
+        return item
+
+    out = []
+    flow = Dataflow("q_reset_df")
+    s = op.input("inp", flow, src)
+    s = op.map("trig", s, trig)
+    op.output("out", s, TestingSink(out))
+    status = run_main(flow, epoch_interval=ZERO_TD, recovery_config=rc)
+    assert status is not None  # graceful stop, not EOF
+    assert sorted(out) == [("p_good", i) for i in range(1, n + 1)]
+    # The runtime teardown zeroed the step's quarantine gauge even
+    # though the partition never healed — no phantom on the old
+    # owner.
+    assert (
+        flight.RECORDER.counters.get(
+            "quarantined_partitions[q_reset_df.inp]"
+        )
+        == 0
+    )
+    events = flight.RECORDER.tail(512)
+    assert any(e["kind"] == "quarantine" for e in events)
+
+    # Run 2 ("the new owner"): the partition is healthy now and must
+    # resume from the FROZEN offset — p_bad emits all its rows
+    # exactly once, p_good replays nothing (offset ladder handed
+    # over through the store).
+    src2 = _TwoPartSource(n, fail_polls=0)
+    out2 = []
+    flow2 = Dataflow("q_reset_df")
+    s2 = op.input("inp", flow2, src2)
+    op.output("out", s2, TestingSink(out2))
+    status2 = run_main(
+        flow2, epoch_interval=ZERO_TD, recovery_config=rc
+    )
+    assert status2 is None
+    assert sorted(out2) == [("p_bad", i) for i in range(1, n + 1)]
 
 
 def test_file_source_itemized_dlq_refused():

@@ -164,6 +164,113 @@ def test_rescale_mid_migration_crash_rolls_back_whole(
     store.close()
 
 
+# -- delta-only (partial) migration ------------------------------------
+
+
+def test_rescale_partial_rewrites_only_moved_routes(tmp_path):
+    # The live-rescale delta mode: a key whose home lane does not
+    # change under the old→new modulus is NEVER touched — proven via
+    # sqlite total_changes, not just the returned count.
+    keys = [f"k{i:03d}" for i in range(200)]
+    moved = [k for k in keys if route_of(k, 2) != route_of(k, 3)]
+    unmoved = [k for k in keys if route_of(k, 2) == route_of(k, 3)]
+    assert moved and unmoved  # the fixture really has both kinds
+    init_db_dir(tmp_path, 1)
+    con = sqlite3.connect(tmp_path / "part-0.sqlite3")
+    con.executemany(
+        "INSERT INTO snaps (step_id, state_key, epoch, ser_change, "
+        "route) VALUES ('df.s', ?, 1, x'00', ?)",
+        [(k, route_of(k, 2)) for k in keys],
+    )
+    before = con.total_changes
+    assert (
+        rescale_snaps_rows(con, 3, page_size=16, partial=True)
+        == len(moved)
+    )
+    # Exactly the moved rows were written; unmoved rows never were.
+    assert con.total_changes - before == len(moved)
+    for key, route in con.execute(
+        "SELECT state_key, route FROM snaps"
+    ):
+        assert route == route_of(key, 3)
+    # Idempotent AND write-free on a store already at the new
+    # modulus: the second pass touches nothing at all.
+    before = con.total_changes
+    assert rescale_snaps_rows(con, 3, page_size=16, partial=True) == 0
+    assert con.total_changes == before
+    # Full mode on the same store rewrites everything (the legacy
+    # count), so the two modes stay interchangeable semantically.
+    assert rescale_snaps_rows(con, 3, page_size=16) == len(keys)
+    con.close()
+
+
+def test_rescale_partial_heals_legacy_and_mixed_stamps(tmp_path):
+    # Crash-healing: rows whose stamps are legacy (-1) or mixed
+    # (a half-committed earlier migration) never compare equal to
+    # the new route, so the delta pass always rewrites them — even
+    # when the key's home lane did not move.
+    keys = [f"u{i:02d}" for i in range(30)]
+    init_db_dir(tmp_path, 1)
+    con = sqlite3.connect(tmp_path / "part-0.sqlite3")
+    for epoch in (1, 2):
+        con.executemany(
+            "INSERT INTO snaps (step_id, state_key, epoch, "
+            "ser_change, route) VALUES ('df.s', ?, ?, x'00', ?)",
+            [(k, epoch, route_of(k, 3)) for k in keys],
+        )
+    stale = keys[:7]
+    con.executemany(
+        "UPDATE snaps SET route = -1 WHERE state_key = ? AND epoch = 1",
+        [(k,) for k in stale[:4]],
+    )
+    con.executemany(
+        "UPDATE snaps SET route = 99 WHERE state_key = ? AND epoch = 2",
+        [(k,) for k in stale[4:]],
+    )
+    # Already at the 3-lane modulus except the stale stamps: the
+    # delta pass rewrites exactly those keys.
+    assert (
+        rescale_snaps_rows(con, 3, page_size=8, partial=True)
+        == len(stale)
+    )
+    for key, route in con.execute(
+        "SELECT state_key, route FROM snaps"
+    ):
+        assert route == route_of(key, 3)
+    con.close()
+
+
+def test_rescale_partial_crash_rolls_back_whole(
+    tmp_path, monkeypatch
+):
+    # The pinned rescale_migrate site on the NEW delta path: an
+    # injected crash inside the all-partition transaction leaves the
+    # store exactly as it was, and the retry — the supervisor's
+    # re-entry semantics — migrates the same delta cleanly.
+    keys = [f"k{i:02d}" for i in range(40)]
+    moved = [k for k in keys if route_of(k, 2) != route_of(k, 3)]
+    store = _seed_store(tmp_path, worker_count=2, keys=keys)
+    monkeypatch.setenv(
+        "BYTEWAX_TPU_FAULTS", "rescale_migrate:crash:*:x1"
+    )
+    faults.configure(0)
+    with pytest.raises(faults.InjectedCrash):
+        store.rescale(3, ex_num=0, partial=True)
+    for part in sorted(Path(tmp_path).glob("part-*.sqlite3")):
+        con = sqlite3.connect(part)
+        for key, route in con.execute(
+            "SELECT state_key, route FROM snaps"
+        ):
+            assert route == route_of(key, 2), "rollback was not whole"
+        con.close()
+    # The retry migrates exactly the delta; re-running it migrates
+    # nothing (and the store is fully at the new modulus).
+    assert store.rescale(3, ex_num=0, partial=True) == len(moved)
+    assert store.rescale(3, ex_num=0, partial=True) == 0
+    assert store.resume_from(worker_count=3).stored_worker_counts == (3,)
+    store.close()
+
+
 # -- row-format pin: recovery partitions and the spill tier ------------
 
 
@@ -207,6 +314,17 @@ def test_spill_rows_share_snaps_format_and_migration(tmp_path):
     con = sqlite3.connect(spill._path)
     assert rescale_snaps_rows(con, 7) == 20
     con.close()
+    # The delta-only mode rides the same shared routine (the raw
+    # pass above was never committed — its connection closed without
+    # one — so the store is still at the 5-lane modulus): already-at-
+    # target rewrites nothing, a real move rewrites exactly the
+    # changed-route keys.
+    assert spill.rescale(5, partial=True) == 0
+    spill_keys = [f"u{i}" for i in range(20)]
+    spill_moved = [
+        k for k in spill_keys if route_of(k, 7) != route_of(k, 5)
+    ]
+    assert spill.rescale(7, partial=True) == len(spill_moved)
     spill.close()
 
 
@@ -429,6 +547,197 @@ def _host_ema_oracle(rows, alpha=0.3):
         ema = s / (1.0 - (1.0 - alpha) ** count)
         out.append((key, (value, ema)))
     return out
+
+
+# -- live partial rescale: in-process reconfiguration ------------------
+
+
+@pytest.mark.parametrize(
+    "n_from,n_to",
+    [(2, 3), (3, 2)],
+    ids=["grow-2to3", "shrink-3to2"],
+)
+def test_live_reconfigure_in_process_exactly_once(
+    tmp_path, monkeypatch, n_from, n_to
+):
+    # A RUNNING flow takes a live reconfigure request mid-stream
+    # (docs/recovery.md "Live partial rescale"): the change agrees at
+    # the next epoch close, the driver unwinds to the run-startup
+    # re-entry IN-PROCESS (one cluster_main call spans both shapes),
+    # the startup migration runs delta-only, and the completed output
+    # equals the host oracle exactly-once in both directions.
+    from bytewax_tpu.engine.driver import request_reconfigure
+
+    n_keys, n_rows = 48, 384
+    inp = [
+        (f"u{i % n_keys:02d}", float(i % 11)) for i in range(n_rows)
+    ]
+    half = n_rows // 2
+    items = inp[:half] + [("reconf", -1.0)] + inp[half:]
+    db = tmp_path / "db"
+    db.mkdir()
+    init_db_dir(db, 2)
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    flight.RECORDER.activate(True)
+
+    fired = [False]
+
+    def trig(kv):
+        if not fired[0] and kv[1] == -1.0:
+            fired[0] = True
+            request_reconfigure([], workers_per_process=n_to)
+        return kv
+
+    out = []
+    flow = Dataflow("live_df")
+    s = op.input("inp", flow, TestingSource(items, batch_size=4))
+    s = op.map("trig", s, trig)
+    scored = op.stateful_map("ema", s, xla.ema(0.3))
+    op.output("out", scored, TestingSink(out))
+    rescales_before = flight.RECORDER.counters.get("rescale_count", 0)
+    status = cluster_main(
+        flow,
+        [],
+        0,
+        worker_count_per_proc=n_from,
+        epoch_interval=ZERO_TD,
+        recovery_config=RecoveryConfig(str(db)),
+    )
+    assert status is None  # ran to EOF at the new size
+    assert fired[0]
+    # Oracle over the full stream (the trigger sentinel flows through
+    # the EMA like any other keyed item).
+    assert _canon(out) == _canon(_host_ema_oracle(items)), (
+        f"keyed state lost or duplicated across the live "
+        f"{n_from}->{n_to} lane move"
+    )
+    # The move was the in-process re-entry + a DELTA migration, not
+    # a full rewrite: strictly fewer keys migrated than the store
+    # holds (the unmoved-route keys were skipped).
+    assert (
+        flight.RECORDER.counters.get("rescale_count", 0)
+        == rescales_before + 1
+    )
+    events = flight.RECORDER.tail(1 << 14)
+    resc = [e for e in events if e["kind"] == "rescale"][-1]
+    assert resc["to_count"] == n_to
+    total_keys = 0
+    for part in sorted(Path(db).glob("part-*.sqlite3")):
+        con = sqlite3.connect(part)
+        total_keys += con.execute(
+            "SELECT COUNT(DISTINCT state_key) FROM snaps"
+        ).fetchone()[0]
+        con.close()
+    assert 0 < resc["keys"] < total_keys, (
+        f"migrated {resc['keys']} of {total_keys} keys: not a delta"
+    )
+    assert any(e["kind"] == "reconfigure" for e in events)
+
+
+def test_live_reconfigure_refused_without_recovery_store(
+    monkeypatch,
+):
+    # A membership change without a recovery store would discard all
+    # keyed state and replay the sources: the agreement refuses (and
+    # consumes the request) instead of rebuilding into nothing.
+    from bytewax_tpu.engine.driver import request_reconfigure
+
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    flight.RECORDER.activate(True)
+    inp = [(f"k{i % 4}", float(i)) for i in range(64)]
+    items = inp[:32] + [("reconf", -1.0)] + inp[32:]
+    fired = [False]
+
+    def trig(kv):
+        if not fired[0] and kv[1] == -1.0:
+            fired[0] = True
+            request_reconfigure([], workers_per_process=3)
+        return kv
+
+    out = []
+    flow = Dataflow("live_nostore_df")
+    s = op.input("inp", flow, TestingSource(items, batch_size=4))
+    s = op.map("trig", s, trig)
+    scored = op.stateful_map("ema", s, xla.ema(0.3))
+    op.output("out", scored, TestingSink(out))
+    reconfs_before = flight.RECORDER.counters.get(
+        "reconfigure_count", 0
+    )
+    status = cluster_main(
+        flow,
+        [],
+        0,
+        worker_count_per_proc=2,
+        epoch_interval=ZERO_TD,
+        recovery_config=None,
+    )
+    assert status is None and fired[0]
+    # No reconfiguration happened; the run completed at 2 lanes with
+    # untouched output.
+    assert (
+        flight.RECORDER.counters.get("reconfigure_count", 0)
+        == reconfs_before
+    )
+    assert _canon(out) == _canon(_host_ema_oracle(items))
+
+
+def test_live_reconfigure_migration_crash_retries_in_process(
+    tmp_path, monkeypatch
+):
+    # Crash-mid-partial-migration on the LIVE path: the agreed
+    # reconfiguration's first in-process re-entry crashes at the
+    # pinned rescale_migrate site (inside the store transaction,
+    # before any row moves); the in-process supervisor retries the
+    # re-entry WITH the agreed target, the rolled-back delta
+    # migration re-runs, and the completed output is exactly-once.
+    from bytewax_tpu.engine.driver import request_reconfigure
+
+    inp = [(f"k{i % 8}", float(i)) for i in range(96)]
+    half = len(inp) // 2
+    items = inp[:half] + [("reconf", -1.0)] + inp[half:]
+    db = tmp_path / "db"
+    db.mkdir()
+    init_db_dir(db, 1)
+    monkeypatch.setenv(
+        "BYTEWAX_TPU_FAULTS", "rescale_migrate:crash:*:x1"
+    )
+    monkeypatch.setenv("BYTEWAX_TPU_MAX_RESTARTS", "2")
+    monkeypatch.setenv("BYTEWAX_TPU_RESTART_BACKOFF_S", "0.05")
+    faults.reset()
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    flight.RECORDER.activate(True)
+
+    fired = [False]
+
+    def trig(kv):
+        if not fired[0] and kv[1] == -1.0:
+            fired[0] = True
+            request_reconfigure([], workers_per_process=3)
+        return kv
+
+    out = []
+    flow = Dataflow("live_crash_df")
+    s = op.input("inp", flow, TestingSource(items, batch_size=4))
+    s = op.map("trig", s, trig)
+    scored = op.stateful_map("ema", s, xla.ema(0.3))
+    op.output("out", scored, TestingSink(out))
+    restarts_before = flight.RECORDER.counters.get(
+        "worker_restart_count", 0
+    )
+    status = cluster_main(
+        flow,
+        [],
+        0,
+        worker_count_per_proc=2,
+        epoch_interval=ZERO_TD,
+        recovery_config=RecoveryConfig(str(db)),
+    )
+    assert status is None
+    assert (
+        flight.RECORDER.counters.get("worker_restart_count", 0)
+        == restarts_before + 1
+    )
+    assert _canon(out) == _canon(_host_ema_oracle(items))
 
 
 def test_rescale_resume_migration_crash_retries_under_supervisor(

@@ -228,6 +228,7 @@ def _make_sup(
     max_procs,
     procs,
     hint_fn,
+    extra_env=None,
 ):
     flow_py = tmp_path / f"{name}.py"
     out = tmp_path / f"{name}_out.txt"
@@ -252,7 +253,7 @@ def _make_sup(
         recovery_dir=str(db),
         snapshot_interval_s=0,
         backup_interval_s=0,
-        env=_child_env(cap, delay_ms),
+        env={**_child_env(cap, delay_ms), **(extra_env or {})},
         hint_fn=hint_fn,
         log_dir=str(tmp_path / f"{name}_logs"),
         workdir=str(tmp_path),
@@ -277,11 +278,13 @@ def test_autoscale_elasticity_exactly_once(
     tmp_path, monkeypatch, p_from, p_to, advice
 ):
     # A running stateful cluster receives a grow (resp. shrink)
-    # decision for K consecutive polls: the supervisor gracefully
+    # decision for K consecutive polls on the LEGACY restart path
+    # (BYTEWAX_TPU_AUTOSCALE_LIVE=0): the supervisor gracefully
     # drains it (stop vote on the epoch-close round, snapshots
     # committed), relaunches at the new size with the startup
     # migration, and the completed run's output equals the host
     # oracle exactly-once.
+    monkeypatch.setenv("BYTEWAX_TPU_AUTOSCALE_LIVE", "0")
     name = f"auto_{p_from}to{p_to}"
     cap = 500
     sup, out = _make_sup(
@@ -308,6 +311,121 @@ def test_autoscale_elasticity_exactly_once(
     assert all(a[0] != "relaunch" for a in sup.actions)
     assert sorted(out.read_text().split()) == _seq_oracle(cap), (
         f"output diverged from oracle across the {p_from}->{p_to} move"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "p_from,p_to,advice",
+    [(2, 3, "grow"), (3, 2, "shrink")],
+    ids=["grow-2to3", "shrink-3to2"],
+)
+def test_live_rescale_moves_without_bouncing_survivors(
+    tmp_path, monkeypatch, p_from, p_to, advice
+):
+    # The live partial-rescale path (the default; docs/recovery.md
+    # "Live partial rescale"): the membership change rides an epoch
+    # close — the joiner boots while the cluster keeps serving, the
+    # survivors re-enter run startup IN-PROCESS (same pids before and
+    # after), the retiree exits cleanly after the agreed close, and
+    # the completed run's output equals the host oracle exactly-once
+    # in both directions.  Non-moving workers must close at least one
+    # epoch DURING the move (the supervisor samples a survivor's
+    # epoch before spawning/posting and after completion).
+    name = f"live_{p_from}to{p_to}"
+    cap = 500
+    sup, out = _make_sup(
+        tmp_path,
+        monkeypatch,
+        name=name,
+        cap=cap,
+        delay_ms=8,
+        min_procs=min(p_from, p_to),
+        max_procs=max(p_from, p_to),
+        procs=p_from,
+        hint_fn=lambda: advice,
+    )
+    with sup:
+        rc = sup.run()
+    logs = _child_logs(tmp_path, name)
+    assert rc == 0, logs[-3000:]
+    assert (advice, p_from, p_to) in sup.actions
+    assert sup.current == p_to
+    move = sup.last_live_move
+    assert move is not None, (
+        "the move fell back to the restart path:\n" + logs[-3000:]
+    )
+    # Survivors were never bounced: every pre-move pid that survived
+    # the resize is still the same OS process afterwards.
+    surviving = min(p_from, p_to)
+    assert (
+        move["pids_after"][:surviving]
+        == move["pids_before"][:surviving]
+    )
+    # The non-moving workers kept closing epochs during the move:
+    # the agreed reconfiguration itself rides an epoch close, so the
+    # survivor's epoch strictly advances between the two samples.
+    assert move["epoch_before"] is not None
+    assert move["epoch_after"] is not None
+    assert move["epoch_after"] > move["epoch_before"], move
+    # In-process re-entry, not a relaunch — and the delta migration
+    # ran (the rescale log line comes from the surviving proc 0 /
+    # the rebuilt coordinator, not a fresh process).
+    assert "live reconfigure agreed" in logs, logs[-3000:]
+    assert "rescaled recovery store" in logs, logs[-3000:]
+    assert all(a[0] != "relaunch" for a in sup.actions)
+    assert sorted(out.read_text().split()) == _seq_oracle(cap), (
+        f"output diverged from oracle across the live "
+        f"{p_from}->{p_to} move"
+    )
+
+
+@pytest.mark.slow
+def test_live_rescale_crash_mid_partial_migration_exactly_once(
+    tmp_path, monkeypatch
+):
+    # Chaos on the LIVE move, through the real pinned fault site: the
+    # coordinator (proc 0, the one process that runs the delta
+    # migration) takes an injected CRASH at rescale_migrate inside
+    # the store transaction during its in-process re-entry.  The
+    # rolled-back migration retries under the in-process supervisor
+    # WITH the agreed membership (proc 0 never leaves the process);
+    # the NON-coordinator peers — blocked in the post-"fcfg" gsync
+    # wait behind the migration — observe the torn mesh, restart
+    # in-process against the new address list, and the re-formed
+    # cluster completes the move: output equals the host oracle
+    # exactly-once.
+    name = "live_crash"
+    cap = 500
+    sup, out = _make_sup(
+        tmp_path,
+        monkeypatch,
+        name=name,
+        cap=cap,
+        delay_ms=8,
+        min_procs=2,
+        max_procs=3,
+        procs=2,
+        hint_fn=lambda: "grow",
+        extra_env={
+            "BYTEWAX_TPU_FAULTS": "rescale_migrate:crash:*:0:x1",
+            "BYTEWAX_TPU_MAX_RESTARTS": "3",
+            "BYTEWAX_TPU_RESTART_BACKOFF_S": "0.1",
+        },
+    )
+    with sup:
+        rc = sup.run()
+    logs = _child_logs(tmp_path, name)
+    assert rc == 0, logs[-3000:]
+    assert ("grow", 2, 3) in sup.actions
+    # The crash really fired mid-move and was healed by the
+    # in-process supervisor — not by the outer relaunch path.
+    assert "supervised restart" in logs, logs[-3000:]
+    assert "rescaled recovery store" in logs, logs[-3000:]
+    assert all(a[0] != "relaunch" for a in sup.actions)
+    assert sorted(out.read_text().split()) == _seq_oracle(cap), (
+        "output diverged from oracle across the crash-mid-migration "
+        "live move"
     )
 
 
