@@ -1250,6 +1250,308 @@ def _run_cluster_columnar_shuffle():
     return results
 
 
+_COLLECTIVE_OVERLAP_CHILD = '''
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import bytewax_tpu.operators as op
+from bytewax_tpu import xla
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.engine.arrays import ArrayBatch
+from bytewax_tpu.engine.driver import cluster_main
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+from bytewax_tpu.testing import TestingSink
+
+pid = int(sys.argv[1])
+addrs = sys.argv[2].split(",")
+warm_addrs = sys.argv[3].split(",")
+polls = int(sys.argv[4])
+rows_per_poll = int(sys.argv[5])
+n_keys = int(sys.argv[6])
+pace_s = float(sys.argv[7])
+out_path = sys.argv[8]
+
+from datetime import timedelta
+
+
+def part_batches(worker_index, count):
+    """Pre-built columnar batches with small integer-valued floats:
+    per-key sums stay exact in the f32 accumulator, so the parent
+    asserts byte-identical oracle equality in any fold order."""
+    base = worker_index * 13
+    rows = count * rows_per_poll
+    idx = np.arange(rows)
+    keys = np.array([f"k{r % n_keys:05d}" for r in idx])
+    vals = ((base + idx) % 997).astype(np.float64)
+    return [
+        ArrayBatch(
+            {
+                "key": keys[i : i + rows_per_poll],
+                "value": vals[i : i + rows_per_poll],
+            }
+        )
+        for i in range(0, rows, rows_per_poll)
+    ]
+
+
+class _Part(StatelessSourcePartition):
+    """A paced (arrival-limited) source — the realistic streaming
+    shape: batches land every ``pace_s`` with idle gaps between
+    them.  The lock-step tier burns those gaps blocked in the
+    epoch-close collective; the overlapped tier runs the collective
+    INSIDE them."""
+
+    def __init__(self, worker_index, count, paced):
+        self._batches = part_batches(worker_index, count)
+        self._pace = pace_s if paced else 0.0
+
+    def next_batch(self):
+        if not self._batches:
+            raise StopIteration()
+        if self._pace:
+            time.sleep(self._pace)
+        return self._batches.pop(0)
+
+
+class Src(DynamicSource):
+    def __init__(self, count, paced=True):
+        self._count = count
+        self._paced = paced
+
+    def build(self, step_id, worker_index, worker_count):
+        return _Part(worker_index, self._count, self._paced)
+
+
+def flow_of(src, out):
+    flow = Dataflow("collective_overlap_bench")
+    s = op.input("inp", flow, src)
+    summed = op.reduce_final("sum", s, xla.SUM)
+    op.output("out", summed, TestingSink(out))
+    return flow
+
+
+# Warmup: compiles the exchange shapes and forms/tears one mesh, so
+# the timed window measures the steady-state overlap (not compiles).
+cluster_main(
+    flow_of(Src(2, paced=False), []), warm_addrs, pid,
+    epoch_interval=timedelta(seconds=0.1),
+)
+out = []
+t0 = time.perf_counter()
+cluster_main(
+    flow_of(Src(polls), out), addrs, pid,
+    epoch_interval=timedelta(seconds=0.1),
+)
+dt = time.perf_counter() - t0
+with open(out_path, "w") as f:
+    json.dump({"dt": dt, "out": out}, f)
+'''
+
+
+def _run_collective_overlap():
+    """2-proc global-mesh keyed aggregation (BYTEWAX_TPU_DISTRIBUTED
+    + GlobalAggState), overlapped vs lock-step collective tier
+    (docs/performance.md "Overlapped collectives").
+
+    Each process ingests a PACED columnar stream (batches arrive
+    every ``pace_s`` — the arrival-limited deployment shape) while
+    every epoch close flushes the buffered rows through the
+    collective exchange.  Lock-step, the close blocks the run loop
+    for the whole exchange, so every epoch pays ``arrivals +
+    collective``; with ``BYTEWAX_TPU_GSYNC_OVERLAP=1`` epoch N's
+    exchange runs on the collective lane inside epoch N+1's arrival
+    gaps, so the steady state pays ``max(arrivals, collective)`` —
+    a mechanism that holds even on a single-core box (the lane's
+    exchange runs while the paced source sleeps).  The merged output
+    is asserted equal to the host oracle on EVERY rep
+    (integer-valued floats: exact in any fold order).
+
+    Returns ``{mode: events_per_sec}`` for ``lockstep``/``overlap``.
+    """
+    import socket
+    import tempfile
+
+    import numpy as np
+
+    polls = int(os.environ.get("BENCH_COLLECTIVE_POLLS", 24))
+    rows_per_poll = int(
+        os.environ.get("BENCH_COLLECTIVE_ROWS_PER_POLL", 64000)
+    )
+    pace_s = float(os.environ.get("BENCH_COLLECTIVE_PACE_S", 0.05))
+    n_keys = 1024
+    n_rows = 2 * polls * rows_per_poll
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    # Host oracle: per-key sums over both processes' rows (exactly
+    # the arrays the children pre-build).
+    sums = {}
+    total = polls * rows_per_poll
+    idx = np.arange(total)
+    key_ids = idx % n_keys
+    for wi in (0, 1):
+        vals = ((wi * 13 + idx) % 997).astype(np.float64)
+        binned = np.bincount(key_ids, weights=vals, minlength=n_keys)
+        for k in range(n_keys):
+            key = f"k{k:05d}"
+            sums[key] = sums.get(key, 0.0) + float(binned[k])
+
+    results = {}
+    with tempfile.TemporaryDirectory() as td:
+        child_py = os.path.join(td, "overlap_child.py")
+        with open(child_py, "w") as f:
+            f.write(_COLLECTIVE_OVERLAP_CHILD)
+
+        def one_run(mode, rep_i):
+            addrs = ",".join(
+                f"127.0.0.1:{free_port()}" for _ in range(2)
+            )
+            warm = ",".join(
+                f"127.0.0.1:{free_port()}" for _ in range(2)
+            )
+            env = dict(os.environ)
+            env["PYTHONPATH"] = (
+                os.path.dirname(os.path.abspath(__file__))
+                + os.pathsep
+                + env.get("PYTHONPATH", "")
+            )
+            env["BYTEWAX_TPU_PLATFORM"] = "cpu"
+            env["BYTEWAX_TPU_ACCEL"] = "1"
+            env["BYTEWAX_TPU_DISTRIBUTED"] = "1"
+            env["BYTEWAX_TPU_GLOBAL_EXCHANGE"] = "1"
+            env["BYTEWAX_TPU_GSYNC_OVERLAP"] = (
+                "1" if mode == "overlap" else "0"
+            )
+            # Batch-granular ingest: the coalescer would swallow the
+            # whole source in one poll and collapse the run into one
+            # EOF flush — the bench needs per-epoch rounds.
+            env["BYTEWAX_TPU_INGEST_TARGET_ROWS"] = "0"
+            # NO persistent compile cache here: concurrent cache
+            # writes from the two distributed-runtime children can
+            # corrupt the CPU client's heap (observed as glibc
+            # aborts); the warm run absorbs the compiles instead.
+            env.pop("BYTEWAX_TPU_COMPILE_CACHE", None)
+            env.pop("BYTEWAX_TPU_FAULTS", None)
+            procs = [
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        child_py,
+                        str(pid),
+                        addrs,
+                        warm,
+                        str(polls),
+                        str(rows_per_poll),
+                        str(n_keys),
+                        str(pace_s),
+                        os.path.join(td, f"{mode}_{rep_i}_{pid}.json"),
+                    ],
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                )
+                for pid in (0, 1)
+            ]
+            for p in procs:
+                try:
+                    _out, err = p.communicate(timeout=600)
+                except subprocess.TimeoutExpired:
+                    for q in procs:
+                        q.kill()
+                    msg = f"{mode} collective bench timed out"
+                    raise RuntimeError(msg) from None
+                if p.returncode != 0:
+                    msg = (
+                        f"{mode} collective child failed "
+                        f"(rc {p.returncode}): {err.decode()[-2000:]}"
+                    )
+                    raise RuntimeError(msg)
+            reports = []
+            for pid in (0, 1):
+                with open(
+                    os.path.join(td, f"{mode}_{rep_i}_{pid}.json")
+                ) as f:
+                    reports.append(json.load(f))
+            merged = {}
+            for rep in reports:
+                for k, v in rep["out"]:
+                    if k in merged:
+                        msg = f"key {k} emitted on both processes"
+                        raise AssertionError(msg)
+                    merged[k] = v
+            if merged != sums:
+                bad = sum(
+                    1 for k in sums if merged.get(k) != sums[k]
+                )
+                msg = (
+                    f"{mode} collective output diverged from the "
+                    f"host oracle ({bad} of {len(sums)} keys differ)"
+                )
+                raise AssertionError(msg)
+            return n_rows / max(rep["dt"] for rep in reports)
+
+        # Oracle asserted on every rep; best-of-2 for the rate.
+        for mode in ("lockstep", "overlap"):
+            results[mode] = max(
+                one_run(mode, i) for i in range(2)
+            )
+    return results
+
+
+def _run_gsync_bytes_per_round():
+    """Bytes one gsync aggregate-exchange round puts on the wire,
+    quantized vs exact (docs/performance.md "Overlapped
+    collectives"): the stats-shape partial columns (key + min/max/sum
+    float64 + count int64) for a representative key cardinality,
+    framed by ``engine/wire.py``'s aggregate codec under each
+    ``BYTEWAX_TPU_GSYNC_QUANT`` mode.  Counts are asserted byte-exact
+    through the int8/bf16 round trips in-bench.
+
+    Returns ``{mode: bytes}`` plus the int8/exact ratio.
+    """
+    import numpy as np
+
+    from bytewax_tpu.engine import wire
+
+    n_keys = int(os.environ.get("BENCH_GSYNC_KEYS", 65536))
+    rng = np.random.RandomState(1711)
+    cols = {
+        "key": np.array([f"user-{i:08d}" for i in range(n_keys)]),
+        "min": rng.randn(n_keys) * 100.0,
+        "max": rng.randn(n_keys) * 100.0 + 500.0,
+        "sum": rng.randn(n_keys) * 1e4,
+        "count": rng.randint(1, 100_000, size=n_keys).astype(
+            np.int64
+        ),
+    }
+    out = {}
+    for mode in ("off", "bf16", "int8"):
+        frames = wire.encode_agg(cols, mode)
+        out[mode] = sum(len(f) for f in frames)
+        dec = {}
+        for frame in frames:
+            for name, arr in wire.decode_agg(frame).items():
+                dec.setdefault(name, []).append(arr)
+        count = np.concatenate(dec["count"])
+        if not np.array_equal(count, cols["count"]):
+            msg = f"count column not exact under {mode}"
+            raise AssertionError(msg)
+        keys = np.concatenate(dec["key"])
+        if not np.array_equal(keys, cols["key"]):
+            msg = f"key column not exact under {mode}"
+            raise AssertionError(msg)
+    return out
+
+
 def _run_rescale_resume():
     """Stop-at-N → first-epoch-close-at-M wall time, in seconds.
 
@@ -2101,6 +2403,37 @@ def main() -> None:
     except Exception as ex:  # noqa: BLE001 - bench must still report
         extra["cluster_columnar_events_per_sec"] = None
         extra["cluster_columnar_error"] = str(ex)[:200]
+
+    # Overlapped collectives (docs/performance.md "Overlapped
+    # collectives"): the 2-proc global-mesh keyed aggregation with
+    # the exchange double-buffered onto the collective lane vs the
+    # lock-step tier — host oracle asserted in-bench on every rep.
+    try:
+        ovl = _run_collective_overlap()
+        extra["collective_lockstep_events_per_sec"] = round(
+            ovl["lockstep"]
+        )
+        extra["collective_overlap_events_per_sec"] = round(
+            ovl["overlap"]
+        )
+        extra["collective_overlap"] = round(
+            ovl["overlap"] / ovl["lockstep"], 2
+        )
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["collective_overlap"] = None
+        extra["collective_overlap_error"] = str(ex)[:200]
+
+    # Quantized gsync aggregate frames: bytes per exchange round,
+    # quantized vs exact (counts asserted byte-exact in-bench).
+    try:
+        gsync_bytes = _run_gsync_bytes_per_round()
+        extra["gsync_bytes_per_round"] = gsync_bytes
+        extra["gsync_bytes_int8_vs_exact"] = round(
+            gsync_bytes["int8"] / gsync_bytes["off"], 3
+        )
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["gsync_bytes_per_round"] = None
+        extra["gsync_bytes_error"] = str(ex)[:200]
 
     # Elastic rescale-on-resume: stop a 2-lane flow, relaunch at 3
     # lanes with the store migration (docs/recovery.md) — the pause

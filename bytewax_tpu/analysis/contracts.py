@@ -52,15 +52,21 @@ SHIP_METHODS = frozenset({"ship_deliver", "ship_route", "ship_flush"})
 #: The columnar wire codec (``engine/wire.py``; docs/performance.md
 #: "Columnar exchange"): pure encode/decode plus the route
 #: accumulator — no sockets, no frames of its own.  Only the comm/
-#: driver pair may call into it (resolved calls into the module from
-#: anywhere else are a BTX-SEND finding): payload encoding is part of
-#: the send surface, and a third caller framing its own payloads
-#: would be a covert channel around the counted ship surfaces.
+#: driver pair — and, since the overlapped-collectives PR, the
+#: global-mesh collective tier (``engine/sharded_state.py``, whose
+#: quantized partial-aggregate frames ride the existing gsync
+#: payload and are encoded/decoded by this codec; docs/performance.md
+#: "Overlapped collectives") — may call into it (resolved calls into
+#: the module from anywhere else are a BTX-SEND finding): payload
+#: encoding is part of the send surface, and another caller framing
+#: its own payloads would be a covert channel around the counted
+#: ship surfaces.
 WIRE_MODULE = "bytewax_tpu.engine.wire"
 WIRE_ALLOWED_MODULES = frozenset(
     {
         "bytewax_tpu.engine.comm",
         "bytewax_tpu.engine.driver",
+        "bytewax_tpu.engine.sharded_state",
         "bytewax_tpu.engine.wire",
     }
 )
@@ -418,9 +424,13 @@ DRAIN_POINT_METHOD_NAMES = frozenset(
 #: Functions whose direct gsync call is exempt from the
 #: flush-before-sync ordering check, with the reason pinned here:
 #: - GlobalAggState.flush: the collective tier never enters the
-#:   pipeline at all, and its only caller (pre_close) flushes every
-#:   pipeline first — the driver also drains all ops before the
-#:   pre_close pass at epoch close.
+#:   per-delivery dispatch pipeline, and its only caller (pre_close)
+#:   flushes every pipeline first — the driver also drains all ops
+#:   before the pre_close pass at epoch close.  Since the
+#:   overlapped-collectives PR it ALSO fences its own exchange lane
+#:   (``self.fence()``) lexically before the rounds — the resolver's
+#:   flush walk can't see through that indirection, hence the pin
+#:   stays, with both orderings re-checked here.
 #: - _Driver.run / _Driver._startup_rescale: run-startup rounds
 #:   ("fcfg", "rescaled") fire before any delivery has been
 #:   dispatched, so no pipeline can hold work yet.
@@ -613,6 +623,8 @@ KNOBS: Dict[str, Tuple[str, str]] = {
         "0",
         "docs/configuration.md",
     ),
+    "BYTEWAX_TPU_GSYNC_OVERLAP": ("0", "docs/performance.md"),
+    "BYTEWAX_TPU_GSYNC_QUANT": ("off", "docs/performance.md"),
     "BYTEWAX_TPU_HB_S": ("0", "docs/recovery.md"),
     "BYTEWAX_TPU_HEARTBEAT_S": ("30", "docs/profiling.md"),
     "BYTEWAX_TPU_HOST_STATE_BUDGET": ("", "docs/state-residency.md"),

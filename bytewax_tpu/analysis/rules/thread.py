@@ -24,10 +24,18 @@ detector:
    deliberately-shared flight-ring/ledger append paths.
 
 Targets owned by a ``global_exchange = True`` class are excluded
-from the walk: the collective tier never enters the pipeline (its
-flush is a cluster-ordered collective; the driver's dispatch path
-returns before ``push`` when the aggregation is global), so the
-name-fallback edge into it is a known over-approximation.
+from the walk: the collective tier never enters the per-delivery
+dispatch pipeline (its flush is a cluster-ordered collective; the
+driver's dispatch path returns before ``push`` when the aggregation
+is global), so the name-fallback edge into it is a known
+over-approximation.  The tier's OWN overlapped exchange lane
+(docs/performance.md "Overlapped collectives") submits sealed tasks
+(``GlobalAggState.flush.<locals>.exchange_task``/``merge_task``) —
+those roots ARE traced (their direct calls must stay clean), while
+their edges back into the owning class fall under the same
+exclusion: the lane is fenced at the ordered points, and everything
+it touches (``_fields``/``_host_fields``) is lane-owned between seal
+and fence by construction.
 """
 
 import ast

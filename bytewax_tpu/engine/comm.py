@@ -90,6 +90,11 @@ class Comm:
         self._peer_gen: dict = {}
         #: Frames discarded by generation fencing (observability).
         self.fenced_frames = 0
+        #: Per-mesh wire vocab cache (engine/wire.py): lives and dies
+        #: with this Comm, so a restarted generation (new mesh, new
+        #: session on both sides) re-ships vocabs from scratch and a
+        #: fenced dead-generation frame can never resolve against it.
+        self._wire_session = _wire.WireSession()
         self._socks: dict = {}
         self._rx_buf: dict = {}
         self._paused: set = set()
@@ -269,7 +274,8 @@ class Comm:
         # Payload encoding is owned by engine/wire.py: columnar
         # framing for codable record-batch payloads, whole-frame
         # pickle otherwise (docs/performance.md "Columnar exchange").
-        payload = _wire.encode(msg)
+        # The session arms the per-(peer, stream) vocab cache.
+        payload = _wire.encode(msg, self._wire_session, dest)
         data = memoryview(
             _LEN.pack(len(payload)) + _GEN.pack(self.generation) + payload
         )
@@ -335,7 +341,7 @@ class Comm:
                 self.fenced_frames += 1
                 _flight.note_fenced(peer, gen)
                 continue
-            msg = _wire.decode(frame)
+            msg = _wire.decode(frame, self._wire_session, peer)
             if msg == _HB:
                 continue  # liveness only; never delivered
             out.append((peer, msg))

@@ -1512,8 +1512,25 @@ class _StatefulBatchRt(_OpRt):
             self._pipe.drop_pending()
             self._pipe.shutdown()
             self._pipe = None
+        # The global tier's overlapped collective lane tears down
+        # with the dispatch pipelines (clean exits have already
+        # fenced it; a fault unwind waits out the in-flight round).
+        if self.agg is not None:
+            lane_shutdown = getattr(self.agg, "lane_shutdown", None)
+            if lane_shutdown is not None:
+                lane_shutdown()
 
     pipeline_shutdown = _pipe_shutdown
+
+    def collective_fence(self) -> None:
+        """Drain the global tier's overlapped exchange lane (no-op
+        for every other tier).  Called from the run-ending epoch
+        close — a stop/reconfigure agreement means no next close will
+        fence it, so the round must land before teardown."""
+        if self.agg is not None:
+            fence = getattr(self.agg, "fence", None)
+            if fence is not None:
+                fence()
 
     def queued(self) -> bool:
         # In-flight pipeline work counts as queued: the epoch barrier
@@ -2990,8 +3007,24 @@ class _Driver:
 
     def ship_deliver(self, op_idx: int, port: str, entry: Entry) -> None:
         """Send an entry to the process owning its worker lane; it is
-        injected into the same op's input queue there."""
-        dest = self.owner_proc(entry[0])
+        injected into the same op's input queue there.
+
+        Like ``ship_route``: zero-row slices never hit the wire, and
+        non-empty keyed split slices accumulate per (peer, op, port,
+        lane) in the ship accumulator — coalescing under the same
+        ``can_merge`` rules — and go out as merged frames at the next
+        ``ship_flush`` (poll boundary / drain point)."""
+        w, items = entry
+        try:
+            if len(items) == 0:
+                return
+        except TypeError:
+            pass
+        dest = self.owner_proc(w)
+        acc = self._ship_acc
+        if acc is not None:
+            acc.add_deliver(dest, op_idx, port, w, items)
+            return
         self.sent[dest] += 1
         self.comm.send(dest, ("deliver", op_idx, port, entry))
 
@@ -3020,7 +3053,8 @@ class _Driver:
         self.comm.send(dest, ("route", stream_id, entry))
 
     def ship_flush(self) -> None:
-        """Put every accumulated routed frame on the wire.  Drain-point
+        """Put every accumulated frame — routed slices and keyed
+        split deliveries alike — on the wire.  Drain-point
         machinery (BTX-DRAIN): called from the run loop's poll
         boundary, epoch-close entry, and the EOF ladder — never from a
         per-batch path — so the sent counts the quiescence reports
@@ -3036,9 +3070,17 @@ class _Driver:
             frame = acc.peek()
             if frame is None:
                 return
-            dest, stream_id, w, items = frame
-            self.sent[dest] += 1
-            self.comm.send(dest, ("route", stream_id, (w, items)))
+            key, items = frame
+            if key[0] == "route":
+                _kind, dest, stream_id, w = key
+                self.sent[dest] += 1
+                self.comm.send(dest, ("route", stream_id, (w, items)))
+            else:
+                _kind, dest, op_idx, port, w = key
+                self.sent[dest] += 1
+                self.comm.send(
+                    dest, ("deliver", op_idx, port, (w, items))
+                )
             acc.pop()
 
     def resume_state(self, step_id: str, state_key: str) -> Optional[Any]:
@@ -3253,6 +3295,16 @@ class _Driver:
             self._stop_agreed = True
         elif pending_reconfig is not None:
             self._agree_reconfigure(pending_reconfig)
+        if self._stop_agreed or self._reconfig_agreed is not None:
+            # Run-ending close: no next close will fence the global
+            # tier's overlapped exchange round, so land it HERE —
+            # every process agreed the same ending close, so the
+            # fence is symmetric and the teardown never races an
+            # in-flight collective.
+            for rt in self.rts:
+                fence = getattr(rt, "collective_fence", None)
+                if fence is not None:
+                    fence()
         self.epoch += 1
         _faults.set_epoch(self.epoch)
         _flight.RECORDER.record("epoch_open", epoch=self.epoch)

@@ -102,10 +102,12 @@ _LEDGER_BUF = 32
 #: this the dump is truncated, never the ledger sums).
 _SPAN_CAP = 4096
 
-#: Ledger phases recorded off the main thread (the pipeline worker's
-#: device phase overlaps host phases by design): excluded from the
-#: close-window breakdown and from main-thread sum checks.
-_OFF_THREAD_PHASES = frozenset({"device"})
+#: Phases recorded off the main thread (pipeline-worker lanes): they
+#: overlap the close window rather than occupying it, so the sealed
+#: close breakdown excludes them.  ``collective_lane`` is the
+#: overlapped global-exchange round (docs/performance.md "Overlapped
+#: collectives").
+_OFF_THREAD_PHASES = frozenset({"device", "collective_lane"})
 
 
 def _truthy(name: str) -> bool:
@@ -1004,7 +1006,7 @@ _FRACTION_BUCKETS = {
     "device": ("device",),
     "flush": ("flush", "close_flush"),
     "barrier": ("barrier",),
-    "gsync": ("gsync", "collective"),
+    "gsync": ("gsync", "collective", "collective_lane"),
     "snapshot": ("snapshot", "commit"),
     "residency": ("restore", "evict"),
 }

@@ -86,11 +86,24 @@ class DevicePipeline:
     raised is dropped from the queue (its ``finalize`` never runs).
     """
 
-    __slots__ = ("depth", "step_id", "_pending", "_pool")
+    __slots__ = ("depth", "step_id", "phase", "_pending", "_pool")
 
-    def __init__(self, step_id: str, depth: Optional[int] = None):
+    def __init__(
+        self,
+        step_id: str,
+        depth: Optional[int] = None,
+        phase: str = "device",
+    ):
         self.depth = pipeline_depth() if depth is None else max(1, depth)
         self.step_id = step_id
+        #: Ledger phase the worker's task time is attributed to.
+        #: ``"device"`` is the per-delivery dispatch pipeline;
+        #: ``"collective_lane"`` is the overlapped global-exchange
+        #: lane (docs/performance.md "Overlapped collectives") — its
+        #: seconds land in the ledger's gsync/collective bucket on
+        #: their own lane instead of inflating the main-thread close
+        #: window, so ``derive_rescale_hint``'s signals stay truthful.
+        self.phase = phase
         #: (future, finalize, submit_monotonic) in submission order.
         self._pending: deque = deque()
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -145,7 +158,7 @@ class DevicePipeline:
             # so the seconds charge the enclosing host frame instead of
             # double-counting against it as overlapped worker time.
             _flight.note_phase(
-                "device", self.step_id, dur, t0=t0, lane=0
+                self.phase, self.step_id, dur, t0=t0, lane=0
             )
             finalize(result)
             _flight.note_source_lag(
@@ -178,18 +191,30 @@ class DevicePipeline:
         finally:
             stalled = time.monotonic() - t0
             if stalled > 0.0005:
-                _flight.note_pipeline_stall(self.step_id, stalled)
-        # Ledger: the device phase's wall interval (worker lane — it
+                if self.phase == "device":
+                    _flight.note_pipeline_stall(self.step_id, stalled)
+                else:
+                    # Collective-fence waits are gsync pressure, not
+                    # device-flush pressure: keep them out of the
+                    # rescale hint's flush-stall signal (the wait is
+                    # already visible as main-thread collective time).
+                    _flight.RECORDER.count(
+                        "collective_fence_stall_seconds", stalled
+                    )
+        # Ledger: the worker phase's wall interval (worker lane — it
         # overlaps host time and never charges the enclosing phase),
         # then the host-side finalize (emission routing, touched-key
         # absorption: the readback surfacing point).
         _flight.note_phase(
-            "device", self.step_id, dev_dur, t0=dev_t0, lane=1
+            self.phase, self.step_id, dev_dur, t0=dev_t0, lane=1
         )
         tf = time.monotonic()
         finalize(result)
         now = time.monotonic()
-        _flight.note_phase("readback", self.step_id, now - tf, t0=tf)
+        if self.phase == "device":
+            _flight.note_phase(
+                "readback", self.step_id, now - tf, t0=tf
+            )
         # Ingest→emit latency of this delivery through the pipeline
         # (submit to finalized emissions).
         _flight.note_source_lag(

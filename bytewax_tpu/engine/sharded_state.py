@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from bytewax_tpu.engine import flight as _flight
+from bytewax_tpu.engine import wire as _wire
 from bytewax_tpu.engine.arrays import ArrayBatch, KeyEncoder, VocabMap
 from bytewax_tpu.engine.scan_accel import ScanUpdates
 from bytewax_tpu.engine.xla import (
@@ -50,6 +51,22 @@ __all__ = [
 
 _MIN_CAP_PER_SHARD = 128
 _MIN_ROWS_PER_SHARD = 64
+
+
+def _discard_result(_res) -> None:
+    """Collective-lane finalize: the sealed exchange task mutates the
+    state it owns in place; nothing surfaces at finalize."""
+
+
+def _gsync_overlap() -> bool:
+    """Whether the collective tier double-buffers its exchange rounds
+    (``BYTEWAX_TPU_GSYNC_OVERLAP``, default off — the lock-step tier,
+    byte-identical to the pre-overlap engine; docs/performance.md
+    "Overlapped collectives")."""
+    return os.environ.get("BYTEWAX_TPU_GSYNC_OVERLAP", "0") not in (
+        "",
+        "0",
+    )
 
 
 def _shard_devices() -> Optional[list]:
@@ -1006,6 +1023,47 @@ class GlobalAggState:
         self.dtype = None  # decided collectively at first flush
         self._round = 0
         self._steps: Dict[Tuple[int, int, Any], Any] = {}
+        #: Quantized aggregate exchange (docs/performance.md
+        #: "Overlapped collectives"): with ``BYTEWAX_TPU_GSYNC_QUANT``
+        #: armed, rows pre-reduce locally per key and the flush ships
+        #: block-scaled partial-aggregate columns inside the existing
+        #: gsync round (EQuARX, PAPERS.md) instead of raw rows through
+        #: the device all_to_all; every process merges the partials
+        #: host-side.  Cluster-wide agreement on the mode is checked
+        #: at every flush — a divergent knob fails typed, it can not
+        #: desynchronize the round sequence.
+        self._quant = _wire.gsync_quant()
+        #: Host-side merged partial fields (quant mode only), indexed
+        #: like the device blocks (``n_shards * cap_per_shard``).
+        self._host_fields: Optional[Dict[str, np.ndarray]] = None
+        #: Whether every merged flush so far was all-integer (quant
+        #: mode emits ints then, matching the exact tier's int lock).
+        self._quant_int = True
+        #: Overlapped exchange lane (docs/performance.md "Overlapped
+        #: collectives"): with ``BYTEWAX_TPU_GSYNC_OVERLAP=1`` the
+        #: sealed exchange for epoch N runs on this ordered
+        #: single-worker lane while the run loop computes epoch N+1;
+        #: only the NEXT flush (and any read of the global result)
+        #: fences on it.  The lane is ONE per driver, shared by every
+        #: global-exchange step: seal order is the agreed round order
+        #: (pre_close iterates steps identically everywhere, and each
+        #: flush fences the shared lane first), so the collective
+        #: programs still launch in an identical sequence
+        #: cluster-wide — one epoch behind the compute frontier.
+        #: Per-step lanes would break exactly that: two steps' rounds
+        #: on independent worker threads could launch their
+        #: collectives in a different relative order on each process.
+        #: Off (the default) keeps the lock-step tier byte-identical:
+        #: no lane is ever constructed.
+        self._lane = None
+        if _gsync_overlap():
+            if getattr(driver, "_gsync_lane", None) is None:
+                from bytewax_tpu.engine.pipeline import DevicePipeline
+
+                driver._gsync_lane = DevicePipeline(
+                    "gsync", depth=2, phase="collective_lane"
+                )
+            self._lane = driver._gsync_lane
 
     # -- placement -----------------------------------------------------------
 
@@ -1191,19 +1249,96 @@ class GlobalAggState:
             self._steps[key] = step
         return step
 
+    def fence(self) -> None:
+        """Wait out every in-flight overlapped exchange round on the
+        (driver-shared) collective lane.  The only fences
+        (docs/performance.md "Overlapped collectives"): the NEXT
+        flush (epoch N+1's close), any read of the global result
+        (finalize/EOF), and the run-ending close — nothing per-batch
+        ever blocks here.  With several global-exchange steps in one
+        flow, a later step's same-close flush drains the earlier
+        step's just-sealed round too (shared lane): rounds then
+        overlap only past the LAST step's seal — correct for any
+        step count, fully overlapped for the common single-step
+        flow, and crucially launch-ordered identically on every
+        process."""
+        if self._lane is not None:
+            self._lane.flush()
+
+    def lane_shutdown(self) -> None:
+        """Teardown (driver ``pipeline_shutdown``, fault unwinds):
+        wait for the lane worker to go quiet and stop it.  A clean
+        exit has already fenced (finalize and the run-ending close
+        drain the lane), so pending work here only exists on a fault
+        path — dropped, matching the dispatch pipelines.  The lane is
+        driver-shared: the first step's shutdown retires it for all
+        (drop_pending/shutdown are idempotent on a quiet lane), and
+        clearing the driver attribute makes a rebuilt driver start
+        fresh."""
+        lane, self._lane = self._lane, None
+        if lane is not None:
+            lane.drop_pending()
+            lane.shutdown()
+            if getattr(self.driver, "_gsync_lane", None) is lane:
+                self.driver._gsync_lane = None
+
+    def _note_flush(
+        self, n_local: int, total_rows: int, n_steps: int, detail: str
+    ) -> None:
+        """Record one sealed-and-launched exchange round (flight ring
+        + the debug marker)."""
+        _flight.RECORDER.record(
+            "global_flush",
+            rows=n_local,
+            total_rows=total_rows,
+            steps=n_steps,
+        )
+        if os.environ.get("BYTEWAX_TPU_GLOBAL_EXCHANGE_DEBUG") == "1":
+            import sys
+
+            print(
+                f"global-exchange: proc {self.driver.proc_id} flushed "
+                f"{n_local}/{total_rows} rows over {self.n_shards} "
+                f"shards in {n_steps} step(s), {detail}",
+                file=sys.stderr,
+                flush=True,
+            )
+
     def flush(self) -> None:
         """One collective exchange+fold round.  EVERY process must
         call this the same number of times in the same global order
         (epoch close / the EOF ladder guarantee it); rounds where the
         whole cluster has nothing buffered skip the device step but
-        still run the (cheap) metadata sync."""
+        still run the (cheap) metadata sync.
+
+        With ``BYTEWAX_TPU_GSYNC_OVERLAP=1`` the exchange phase is
+        sealed into an immutable task and launched on the ordered
+        collective lane — the metadata rounds still run HERE, at the
+        globally-ordered point, so every process executes the
+        identical sequence of sync rounds and seals the identical
+        sequence of collective programs, one epoch behind the compute
+        frontier.  With ``BYTEWAX_TPU_GSYNC_QUANT`` armed, buffered
+        rows pre-reduce locally per key and quantized
+        partial-aggregate frames ride the metadata round
+        (engine/wire.py) instead of raw rows riding the device
+        all_to_all; the merge is a host-side fold of the decoded
+        partials."""
         import jax
         import jax.numpy as jnp
 
         driver = self.driver
+        # Fence first: the previous epoch's overlapped round must
+        # complete before this close launches the next one (one round
+        # in flight at a time — the lane's task order IS the round
+        # order every process agrees on).
+        self.fence()
         n_local = int(sum(len(a) for a in self._buf_vals))
         local_new = sorted(
             k for k in self._dense_keys if k not in self.key_to_kid
+        )
+        quant = self._quant
+        frames = (
+            self._local_partial_frames() if quant != "off" else None
         )
         # Every process performs the same global sequence of sync
         # rounds (epoch close / EOF ladder ordering), so a driver-wide
@@ -1211,17 +1346,51 @@ class GlobalAggState:
         tag = ("gagg", driver.next_gsync_tag())
         self._round += 1
         replies = driver.global_sync(
-            tag, (local_new, n_local, self._buf_all_int)
+            tag, (local_new, n_local, self._buf_all_int, quant, frames)
         )
+        modes = {r[3] for r in replies.values()}
+        if len(modes) != 1:
+            msg = (
+                "cluster processes disagree on BYTEWAX_TPU_GSYNC_QUANT "
+                f"({sorted(modes)}); the quantized aggregate exchange "
+                "must be armed identically on every process"
+            )
+            raise RuntimeError(msg)
         merged_new = sorted(
-            {k for new, _n, _ai in replies.values() for k in new}
+            {k for new, *_rest in replies.values() for k in new}
         )
-        total_rows = sum(n for _new, n, _ai in replies.values())
-        all_int = all(ai for _new, _n, ai in replies.values())
+        total_rows = sum(r[1] for r in replies.values())
+        all_int = all(r[2] for r in replies.values())
         self._assign_kids(merged_new)
         if total_rows == 0:
             self._buf_ids.clear()
             self._buf_vals.clear()
+            return
+        if quant != "off":
+            # Quantized host exchange: the partial frames already
+            # rode the round; seal the (deterministically ordered)
+            # merge and launch it.
+            self._buf_ids.clear()
+            self._buf_vals.clear()
+            self._quant_int = self._quant_int and all_int
+            peer_frames = [replies[pid][4] for pid in sorted(replies)]
+            n_frames = sum(len(f or ()) for f in peer_frames)
+
+            def merge_task():
+                self._merge_partials(peer_frames)
+
+            # Launch: inline (lock-step) or on the overlapped lane —
+            # the direct push site is what BTX-THREAD traces.
+            if self._lane is None:
+                merge_task()
+            else:
+                self._lane.push(merge_task, _discard_result)
+            self._note_flush(
+                n_local,
+                total_rows,
+                1,
+                f"{n_frames} quantized partial frame(s) [{quant}]",
+            )
             return
         if self.dtype is None:
             self.dtype = jnp.int32 if all_int else jnp.float32
@@ -1240,7 +1409,7 @@ class GlobalAggState:
         # fixed-shape steps so ONE compiled program is reused across
         # chunks, flushes, and epochs, and exchange buffers stay
         # bounded regardless of how much an epoch buffered.
-        max_rows = max(n for _new, n, _ai in replies.values())
+        max_rows = max(n for _new, n, *_rest in replies.values())
         chunk_pd = min(
             _pow2(
                 -(-max_rows // self.local_devs),
@@ -1305,38 +1474,118 @@ class GlobalAggState:
         _flight.note_transfer(
             "h2d", kids_p.nbytes + vals_p.nbytes + valid_p.nbytes
         )
-        _flight.RECORDER.record(
-            "global_flush",
-            rows=n_local,
-            total_rows=total_rows,
-            steps=n_steps,
-        )
         step = self._step_for(chunk_pd, capacity)
         global_rows = chunk_pd * self.n_shards
+        sharding = self._sharding
+        val_dtype = np.dtype(self.dtype)
 
-        def garr(local, dtype):
-            return jax.make_array_from_process_local_data(
-                self._sharding, local.astype(dtype), (global_rows,)
-            )
+        def exchange_task():
+            # Sealed device phase: identical program sequence on every
+            # process's lane (seal order is the agreed round order).
+            def garr(local, dtype):
+                return jax.make_array_from_process_local_data(
+                    sharding, local.astype(dtype), (global_rows,)
+                )
 
-        for c in range(n_steps):
-            sl = slice(c * chunk_rows, (c + 1) * chunk_rows)
-            self._fields = step(
-                self._fields,
-                garr(kids_p[sl], np.int32),
-                garr(vals_p[sl], np.dtype(self.dtype)),
-                garr(valid_p[sl], bool),
-            )
-        if os.environ.get("BYTEWAX_TPU_GLOBAL_EXCHANGE_DEBUG") == "1":
-            import sys
+            for c in range(n_steps):
+                sl = slice(c * chunk_rows, (c + 1) * chunk_rows)
+                self._fields = step(
+                    self._fields,
+                    garr(kids_p[sl], np.int32),
+                    garr(vals_p[sl], val_dtype),
+                    garr(valid_p[sl], bool),
+                )
 
-            print(
-                f"global-exchange: proc {driver.proc_id} flushed "
-                f"{n_local}/{total_rows} rows over {self.n_shards} "
-                f"shards in {n_steps} step(s), capacity {capacity}",
-                file=sys.stderr,
-                flush=True,
-            )
+        if self._lane is None:
+            exchange_task()
+        else:
+            self._lane.push(exchange_task, _discard_result)
+        self._note_flush(
+            n_local, total_rows, n_steps, f"capacity {capacity}"
+        )
+
+    def _local_partial_frames(self) -> List[bytes]:
+        """Pre-reduce this process's buffered rows per key and frame
+        the partial-aggregate columns for the gsync round: one
+        ``key`` column (exact) plus one column per state field —
+        ``count`` and all-integer partials exact, float partials
+        block-quantized per the armed mode (engine/wire.py)."""
+        if not self._dense_keys or not self._buf_ids:
+            return []
+        ids = np.concatenate(self._buf_ids)
+        vals = np.concatenate(self._buf_vals)
+        if not len(ids):
+            return []
+        # Remap to the TOUCHED dense ids only: work and allocation
+        # scale with this flush's rows and distinct keys, never with
+        # the full accumulated key history (a trickle stream over a
+        # large vocabulary would otherwise pay O(total keys) per
+        # epoch close).
+        uniq, inv = np.unique(ids, return_inverse=True)
+        n_touched = len(uniq)
+        dense_keys = self._dense_keys
+        cols: Dict[str, np.ndarray] = {
+            "key": np.array([dense_keys[i] for i in uniq.tolist()])
+        }
+        counts = np.bincount(inv, minlength=n_touched)
+        for name, (_init, op) in self.kind.fields.items():
+            if name == "count":
+                arr = counts.astype(np.int64)
+            else:
+                if op == "add":
+                    arr = np.bincount(
+                        inv, weights=vals, minlength=n_touched
+                    )
+                elif op == "min":
+                    arr = np.full(n_touched, np.inf)
+                    np.minimum.at(arr, inv, vals)
+                else:
+                    arr = np.full(n_touched, -np.inf)
+                    np.maximum.at(arr, inv, vals)
+                if self._buf_all_int:
+                    # All-integer rows: partials ship as exact int64
+                    # (the codec never quantizes integer columns), so
+                    # integer workloads stay lossless under int8/bf16.
+                    arr = np.rint(arr).astype(np.int64)
+            cols[name] = arr
+        return _wire.encode_agg(cols, self._quant)
+
+    def _merge_partials(self, frames_by_proc: List[Any]) -> None:
+        """Merge every process's decoded partial frames into the
+        host-side field blocks (the quantized exchange; runs on the
+        collective lane under overlap).  Every process iterates peers
+        in the same sorted order, so the merged floats are identical
+        cluster-wide — same values, same addition order."""
+        if self._host_fields is None:
+            size = self.n_shards * self.cap_per_shard
+            self._host_fields = {
+                name: np.full(size, init, dtype=np.float64)
+                for name, (init, _op) in self.kind.fields.items()
+            }
+        kid_map = self.key_to_kid
+        for frames in frames_by_proc:
+            for frame in frames or ():
+                cols = _wire.decode_agg(frame)
+                keys = cols.get("key")
+                if keys is None or not len(keys):
+                    continue
+                gidx = np.fromiter(
+                    (
+                        self._global_idx(kid_map[k])
+                        for k in keys.tolist()
+                    ),
+                    dtype=np.int64,
+                    count=len(keys),
+                )
+                for name, (_init, op) in self.kind.fields.items():
+                    vals = np.asarray(cols[name], dtype=np.float64)
+                    tgt = self._host_fields[name]
+                    if op == "add":
+                        np.add.at(tgt, gidx, vals)
+                    elif op == "min":
+                        np.minimum.at(tgt, gidx, vals)
+                    else:
+                        np.maximum.at(tgt, gidx, vals)
 
     # -- recovery / emission --------------------------------------------------
 
@@ -1364,44 +1613,82 @@ class GlobalAggState:
             out[name] = blocks
         return out
 
+    def _exactify(self, val: Any) -> Any:
+        """Re-integerize a quant-mode final value when every merged
+        flush was all-integer, matching the exact tier's int lock
+        (``8`` out, never ``8.0``)."""
+        if not self._quant_int:
+            return val
+        if self.kind_name in ("sum", "min", "max"):
+            return int(val)
+        if self.kind_name == "stats":
+            mn, mean, mx, count = val
+            return (int(mn), mean, int(mx), count)
+        return val
+
     def finalize(self) -> List[Tuple[str, Any]]:
         """Flush any tail rows (collective — the EOF ladder has every
-        process in this call), then emit ``(key, final)`` for the
-        keys whose owner shard lives on THIS process (lane-aligned
-        placement makes those exactly this process's emission keys),
-        sorted by key."""
+        process in this call), fence any overlapped round (the global
+        result is about to be read), then emit ``(key, final)`` for
+        the keys whose owner shard lives on THIS process
+        (lane-aligned placement makes those exactly this process's
+        emission keys), sorted by key."""
         self.flush()
-        if self._fields is None or not self.key_to_kid:
-            self.key_to_kid.clear()
-            return []
-        blocks = self._local_host_fields()
-        first_field = next(iter(self.kind.fields))
-        #: block start -> membership test happens once per key.
-        starts = sorted(blocks[first_field])
+        self.fence()
+        out: List[Tuple[str, Any]] = []
+        if self._quant != "off":
+            if self._host_fields is not None and self.key_to_kid:
+                my_shards = set(
+                    self._proc_shards[self.driver.proc_id]
+                )
+                for key in sorted(self.key_to_kid):
+                    kid = self.key_to_kid[key]
+                    if kid % self.n_shards not in my_shards:
+                        continue  # another process's shard emits it
+                    out.append(
+                        (
+                            key,
+                            self._exactify(
+                                _final_of(
+                                    self.kind_name,
+                                    self._host_fields,
+                                    self._global_idx(kid),
+                                )
+                            ),
+                        )
+                    )
+        elif self._fields is not None and self.key_to_kid:
+            blocks = self._local_host_fields()
+            first_field = next(iter(self.kind.fields))
+            #: block start -> membership test happens once per key.
+            starts = sorted(blocks[first_field])
 
-        out = []
-        for key in sorted(self.key_to_kid):
-            gidx = self._global_idx(self.key_to_kid[key])
-            start = next(
-                (
-                    s
-                    for s in starts
-                    if s <= gidx < s + len(blocks[first_field][s])
-                ),
-                None,
-            )
-            if start is None:
-                continue  # another process's shard emits it
-            flat = {
-                name: blocks[name][start][gidx - start : gidx - start + 1]
-                for name in self.kind.fields
-            }
-            out.append((key, _final_of(self.kind_name, flat, 0)))
+            for key in sorted(self.key_to_kid):
+                gidx = self._global_idx(self.key_to_kid[key])
+                start = next(
+                    (
+                        s
+                        for s in starts
+                        if s <= gidx < s + len(blocks[first_field][s])
+                    ),
+                    None,
+                )
+                if start is None:
+                    continue  # another process's shard emits it
+                flat = {
+                    name: blocks[name][start][
+                        gidx - start : gidx - start + 1
+                    ]
+                    for name in self.kind.fields
+                }
+                out.append((key, _final_of(self.kind_name, flat, 0)))
         self.key_to_kid.clear()
         self._shard_fill = [0] * self.n_shards
         self._fields = None
+        self._host_fields = None
         self.dtype = None
         self._buf_all_int = True
+        self._quant_int = True
         self._dense_keys = []
         self._dense_map = {}
         self._vocab = VocabMap(dtype=np.int32)

@@ -10,7 +10,8 @@ existing ``ship_deliver``/``ship_route`` payloads — zero new frame
 kinds, zero new send surface, and the count-matched epoch barrier
 counts exactly the frames that hit the socket.
 
-Two pieces live here (docs/performance.md "Columnar exchange"):
+Three pieces live here (docs/performance.md "Columnar exchange" and
+"Overlapped collectives"):
 
 - **The codec** (:func:`encode` / :func:`decode`): a ``deliver`` /
   ``route`` payload carrying an :class:`ArrayBatch` whose columns are
@@ -26,14 +27,34 @@ Two pieces live here (docs/performance.md "Columnar exchange"):
   :class:`~bytewax_tpu.errors.WireFormatError` instead of guessing.
 
 - **Per-peer accumulation** (:class:`RouteAccumulator`): ``ship_route``
-  slices for the same (peer, stream, lane) accumulate and coalesce
-  under the ingest coalescer's ``can_merge``/``merge_batches`` rules
-  (engine/batching.py) until a poll boundary, so small routed slices
-  amortize syscalls and per-frame headers.  The driver flushes it
-  unconditionally before every drain point (``_Driver.ship_flush``,
-  a BTX-DRAIN drain-only operation), so the generation-tagged
-  count-matched barrier and epoch quiescence see exactly the frames
-  they count.
+  slices for the same (peer, stream, lane) — and ``ship_deliver``
+  keyed split slices for the same (peer, op, port, lane) — accumulate
+  and coalesce under the ingest coalescer's
+  ``can_merge``/``merge_batches`` rules (engine/batching.py) until a
+  poll boundary, so small routed slices amortize syscalls and
+  per-frame headers.  The driver flushes it unconditionally before
+  every drain point (``_Driver.ship_flush``, a BTX-DRAIN drain-only
+  operation), so the generation-tagged count-matched barrier and
+  epoch quiescence see exactly the frames they count.
+
+- **The quantized aggregate codec** (:func:`encode_agg` /
+  :func:`decode_agg`): the global-mesh collective tier's per-key
+  partial-aggregate columns frame as a versioned header + per-column
+  buffers where float columns are block-scaled down to int8 or bf16
+  (EQuARX-style quantized all-reduce, PAPERS.md) per
+  ``BYTEWAX_TPU_GSYNC_QUANT`` — integer and ``count`` columns are
+  NEVER quantized (exact), and oversized column sets chunk into
+  bounded frames.  The frames ride INSIDE the existing ``gsync``
+  payload (pickled bytes — no new frame kinds); an unknown version
+  or quant code raises a typed :class:`WireFormatError`, so
+  mixed-version clusters fail loudly instead of folding garbage.
+
+A vocab/schema cache rides the columnar framing when the comm layer
+arms a :class:`WireSession` (one per mesh, reset with it on every
+restart generation): an unchanged ``key_vocab`` for one (peer,
+stream) ships once with a generation tag and subsequent frames carry
+only the tag, invalidated whenever the vocab object or its length
+moves.  ``BYTEWAX_TPU_WIRE=pickle`` bypasses all of it.
 
 This module is pure encode/decode and in-memory accumulation — no
 sockets, no comm frames.  It is callable only from the allowlisted
@@ -62,8 +83,12 @@ from bytewax_tpu.errors import WireFormatError
 
 __all__ = [
     "RouteAccumulator",
+    "WireSession",
     "decode",
+    "decode_agg",
     "encode",
+    "encode_agg",
+    "gsync_quant",
     "reconfigure",
     "wire_mode",
 ]
@@ -73,7 +98,11 @@ __all__ = [
 #: encodings apart from the first bytes alone — the versioned
 #: fallback needs no out-of-band flag.
 _MAGIC = b"\xb5BXW"
-_VERSION = 1
+#: Version 2 added the per-(peer, stream) vocab generation cache
+#: (``_FLAG_VOCAB_GEN``/``_FLAG_VOCAB_REF``); a v1 decoder cannot
+#: parse those flags, so the version byte moved — mixed-version
+#: clusters fail typed and roll on ``BYTEWAX_TPU_WIRE=pickle``.
+_VERSION = 2
 
 _U8 = struct.Struct("<B")
 _U16 = struct.Struct("<H")
@@ -93,6 +122,12 @@ _COL_PICKLE = 1
 _FLAG_SCALE = 1
 _FLAG_VOCAB = 2
 _FLAG_VOCAB_PICKLED = 4
+#: The vocab body is followed by a u32 generation tag the receiver
+#: caches per (sender, stream) in its :class:`WireSession`.
+_FLAG_VOCAB_GEN = 8
+#: No vocab body at all: a u32 generation tag referencing the vocab
+#: the receiver cached from an earlier ``_FLAG_VOCAB_GEN`` frame.
+_FLAG_VOCAB_REF = 16
 
 #: Column buffers are padded to this alignment so the zero-copy
 #: ``np.frombuffer`` views start on aligned offsets (unaligned numpy
@@ -106,6 +141,7 @@ _ALIGN = 8
 _RAW_KINDS = frozenset("biufcmMSU")
 
 _mode_cache: Optional[str] = None
+_quant_cache: Optional[str] = None
 
 
 def wire_mode() -> str:
@@ -120,10 +156,60 @@ def wire_mode() -> str:
     return _mode_cache
 
 
+def gsync_quant() -> str:
+    """The armed gsync aggregate-exchange quantization
+    (``BYTEWAX_TPU_GSYNC_QUANT``): ``"off"`` (default — the exact
+    device all_to_all exchange), ``"bf16"``, or ``"int8"``
+    (block-scaled; docs/performance.md "Overlapped collectives").
+    Cached; re-read after :func:`reconfigure`."""
+    global _quant_cache
+    if _quant_cache is None:
+        raw = os.environ.get("BYTEWAX_TPU_GSYNC_QUANT", "off") or "off"
+        if raw not in ("off", "bf16", "int8"):
+            msg = (
+                f"BYTEWAX_TPU_GSYNC_QUANT={raw!r} is not valid; use "
+                "'off', 'bf16', or 'int8'"
+            )
+            raise ValueError(msg)
+        _quant_cache = raw
+    return _quant_cache
+
+
 def reconfigure() -> None:
-    """Drop the cached env knob (tests/bench tweak it mid-process)."""
-    global _mode_cache
+    """Drop the cached env knobs (tests/bench tweak them
+    mid-process)."""
+    global _mode_cache, _quant_cache
     _mode_cache = None
+    _quant_cache = None
+
+
+class WireSession:
+    """Per-mesh vocab/schema cache (one per :class:`~bytewax_tpu.
+    engine.comm.Comm`, so it resets with the mesh on every restart
+    generation and two in-process drivers never share one).
+
+    ``tx`` maps ``(peer, stream key)`` to ``(vocab object, length,
+    generation)`` — the strong reference pins the object so an
+    identity test can never alias a recycled ``id()``.  An encode
+    whose vocab matches by identity AND length ships only the
+    generation tag; a changed object or a longer (grown-in-place
+    list) vocab ships the full body under a fresh generation.  ``rx``
+    maps ``(peer, stream key)`` to the latest ``(generation, vocab)``
+    decoded from a defining frame; a reference to any other
+    generation raises :class:`WireFormatError` (the defining frame
+    was lost — a wedge the stall watchdog/supervisor already heals).
+    """
+
+    __slots__ = ("tx", "rx", "_gen")
+
+    def __init__(self):
+        self.tx: Dict[Tuple, Tuple[Any, int, int]] = {}
+        self.rx: Dict[Tuple, Tuple[int, Any]] = {}
+        self._gen = 0
+
+    def next_gen(self) -> int:
+        self._gen += 1
+        return self._gen
 
 
 # -- encode -----------------------------------------------------------------
@@ -136,9 +222,15 @@ def _pack_str(s: str) -> Optional[bytes]:
     return _U16.pack(len(raw)) + raw
 
 
-def _encode_columnar(msg: Any) -> Optional[bytes]:
+def _encode_columnar(
+    msg: Any,
+    session: Optional[WireSession] = None,
+    peer: Optional[int] = None,
+) -> Optional[bytes]:
     """The columnar framing of one ship payload, or None when the
-    payload is not a codable batch (the caller then pickles whole)."""
+    payload is not a codable batch (the caller then pickles whole).
+    With a session armed, vocab bodies are cached per (peer, stream)
+    under a generation tag — an unchanged vocab ships once."""
     if type(msg) is not tuple or not msg:
         return None
     if msg[0] == "deliver" and len(msg) == 4:
@@ -181,6 +273,32 @@ def _encode_columnar(msg: Any) -> Optional[bytes]:
     vocab = batch.key_vocab
     vocab_buf = b""
     vocab_desc = b""
+    gen_b = b""
+    pending_tx = None
+    if vocab is not None and session is not None and peer is not None:
+        # Vocab cache: key the stream by the same identity the frame
+        # header carries, so the receiver's lookup needs nothing
+        # beyond what it just decoded.  The defining entry commits
+        # only once the frame really encodes columnar — a fallback to
+        # pickle must not strand a generation the receiver never saw.
+        try:
+            vlen = len(vocab)
+        except TypeError:
+            vlen = -1
+        skey = (peer, kind) + tuple(meta)
+        ent = session.tx.get(skey)
+        if ent is not None and ent[0] is vocab and ent[1] == vlen:
+            # Unchanged vocab (same object, same length — the
+            # append-only contract makes content at an index
+            # immutable): ship only the generation tag.
+            flags |= _FLAG_VOCAB | _FLAG_VOCAB_REF
+            gen_b = _U32.pack(ent[2])
+            vocab = None
+        else:
+            gen = session.next_gen() & 0xFFFFFFFF
+            pending_tx = (skey, (vocab, vlen, gen))
+            flags |= _FLAG_VOCAB_GEN
+            gen_b = _U32.pack(gen)
     if vocab is not None:
         flags |= _FLAG_VOCAB
         if (
@@ -237,6 +355,7 @@ def _encode_columnar(msg: Any) -> Optional[bytes]:
     head.append(_U64.pack(nrows))
     head.append(_U8.pack(flags))
     head.append(scale_b)
+    head.append(gen_b)
     head.append(_U16.pack(len(cols)))
     head.extend(col_desc)
     head.append(vocab_desc)
@@ -250,18 +369,25 @@ def _encode_columnar(msg: Any) -> Optional[bytes]:
             off += pad
         parts.append(buf)
         off += len(buf)
+    if pending_tx is not None:
+        session.tx[pending_tx[0]] = pending_tx[1]
     return b"".join(parts)
 
 
-def encode(msg: Any) -> bytes:
+def encode(
+    msg: Any,
+    session: Optional[WireSession] = None,
+    peer: Optional[int] = None,
+) -> bytes:
     """Encode one mesh payload for the wire: columnar framing for
     codable ``deliver``/``route`` batch payloads, whole-frame pickle
     for everything else (and for everything under
-    ``BYTEWAX_TPU_WIRE=pickle``)."""
+    ``BYTEWAX_TPU_WIRE=pickle``).  ``session``/``peer`` (set by the
+    comm layer) arm the per-(peer, stream) vocab cache."""
     t0 = time.perf_counter()
     data = None
     if wire_mode() == "columnar":
-        data = _encode_columnar(msg)
+        data = _encode_columnar(msg, session, peer)
     if data is None:
         data = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
         codec = "pickle"
@@ -314,7 +440,11 @@ class _Reader:
         return start, end
 
 
-def _decode_columnar(data: bytes) -> Any:
+def _decode_columnar(
+    data: bytes,
+    session: Optional[WireSession] = None,
+    peer: Optional[int] = None,
+) -> Any:
     version = data[4]
     if version != _VERSION:
         msg = (
@@ -329,14 +459,21 @@ def _decode_columnar(data: bytes) -> Any:
     if kind == _KIND_DELIVER:
         op_idx = rd.take(_U32)
         port = rd.take_str()
+        skey_meta: Tuple = (op_idx, port)
     elif kind == _KIND_ROUTE:
         stream_id = rd.take_str()
+        skey_meta = (stream_id,)
     else:
         raise WireFormatError(f"unknown columnar frame kind {kind}")
     w = rd.take(_I64)
     nrows = rd.take(_U64)
     flags = rd.take(_U8)
     scale = rd.take(_F64) if flags & _FLAG_SCALE else None
+    vocab_gen = (
+        rd.take(_U32)
+        if flags & (_FLAG_VOCAB_GEN | _FLAG_VOCAB_REF)
+        else None
+    )
     ncols = rd.take(_U16)
     specs: List[Tuple[str, int, Optional[str], int]] = []
     for _ in range(ncols):
@@ -354,7 +491,7 @@ def _decode_columnar(data: bytes) -> Any:
                 f"unknown column encoding {colkind} in columnar frame"
             )
     vocab_spec: Optional[Tuple[Optional[str], int, int]] = None
-    if flags & _FLAG_VOCAB:
+    if flags & _FLAG_VOCAB and not flags & _FLAG_VOCAB_REF:
         if flags & _FLAG_VOCAB_PICKLED:
             vocab_spec = (None, 0, rd.take(_U64))
         else:
@@ -378,7 +515,23 @@ def _decode_columnar(data: bytes) -> Any:
         else:
             cols[name] = pickle.loads(data[start:end])
     vocab = None
-    if vocab_spec is not None:
+    if flags & _FLAG_VOCAB_REF:
+        if session is None or peer is None:
+            raise WireFormatError(
+                "columnar frame references a cached vocab but no "
+                "wire session is armed on this receiver"
+            )
+        ent = session.rx.get((peer, kind) + skey_meta)
+        if ent is None or ent[0] != vocab_gen:
+            msg = (
+                f"columnar frame references vocab generation "
+                f"{vocab_gen} from peer {peer} but this process "
+                f"holds {ent[0] if ent else 'none'}; the defining "
+                "frame was lost"
+            )
+            raise WireFormatError(msg)
+        vocab = ent[1]
+    elif vocab_spec is not None:
         dt, nvocab, nbytes = vocab_spec
         start, end = rd.take_buf(nbytes)
         if dt is None:
@@ -387,18 +540,33 @@ def _decode_columnar(data: bytes) -> Any:
             vocab = np.frombuffer(
                 data, dtype=np.dtype(dt), count=nvocab, offset=start
             )
+        if vocab_gen is not None and session is not None and peer is not None:
+            # Cache a COMPACT copy, never the frombuffer view: the
+            # view would pin the entire defining frame's bytes (which
+            # may carry megabytes of column data) for as long as the
+            # generation lives.  The defining batch gets the same
+            # copy, so ref-resolved batches share its identity.
+            if isinstance(vocab, np.ndarray):
+                vocab = vocab.copy()
+            session.rx[(peer, kind) + skey_meta] = (vocab_gen, vocab)
     batch = ArrayBatch(cols, key_vocab=vocab, value_scale=scale)
     if kind == _KIND_DELIVER:
         return ("deliver", op_idx, port, (w, batch))
     return ("route", stream_id, (w, batch))
 
 
-def decode(data: bytes) -> Any:
+def decode(
+    data: bytes,
+    session: Optional[WireSession] = None,
+    peer: Optional[int] = None,
+) -> Any:
     """Decode one received mesh frame: columnar frames rebuild their
-    :class:`ArrayBatch` zero-copy, anything else is a pickle."""
+    :class:`ArrayBatch` zero-copy, anything else is a pickle.
+    ``session``/``peer`` (set by the comm layer) resolve and refresh
+    the per-(peer, stream) vocab cache."""
     t0 = time.perf_counter()
     if data[:4] == _MAGIC:
-        msg = _decode_columnar(data)
+        msg = _decode_columnar(data, session, peer)
         codec = "columnar"
     else:
         msg = pickle.loads(data)
@@ -407,21 +575,287 @@ def decode(data: bytes) -> Any:
     return msg
 
 
+# -- quantized gsync aggregate frames ---------------------------------------
+
+#: Aggregate-frame magic (distinct from the columnar data magic so a
+#: mis-routed buffer fails typed instead of mis-parsing).
+_AGG_MAGIC = b"\xb5BXQ"
+_AGG_VERSION = 1
+
+#: Per-column encodings inside an aggregate frame.
+_AGG_RAW = 0  # exact bytes (integer/count/bool/fixed-width columns)
+_AGG_BF16 = 1  # float32 rounded-to-nearest to its upper 16 bits
+_AGG_INT8 = 2  # block-scaled int8 (EQuARX-style)
+_AGG_UTF8 = 3  # unicode (U-dtype) cells packed as UTF-8 bytes (exact)
+
+#: Values per int8 quantization block: each block carries one f32
+#: scale (max|block| / 127), so overhead is 4 bytes per 1024 values
+#: and a single outlier cannot flatten the whole column's resolution.
+_QBLOCK = 1024
+
+#: Rows per aggregate frame: oversized partial-column sets chunk into
+#: bounded frames so encode scratch (and any future streaming decode)
+#: stays bounded regardless of key cardinality.
+_AGG_CHUNK_ROWS = 1 << 16
+
+
+def _quantize_int8(col: np.ndarray) -> Tuple[bytes, bytes]:
+    """Block-scaled int8: returns (scales f32 buffer, int8 buffer).
+    Error bound per value: ``max|block| / 254`` (half a quantization
+    step of ``scale = max|block| / 127``)."""
+    vals = np.ascontiguousarray(col, dtype=np.float32)
+    n = len(vals)
+    nblocks = -(-n // _QBLOCK) if n else 0
+    padded = np.zeros(nblocks * _QBLOCK, dtype=np.float32)
+    padded[:n] = vals
+    blocks = padded.reshape(nblocks, _QBLOCK)
+    scales = (
+        np.abs(blocks).max(axis=1) / 127.0
+        if nblocks
+        else np.empty(0, dtype=np.float32)
+    ).astype(np.float32)
+    safe = np.where(scales > 0, scales, 1.0)
+    q = np.clip(
+        np.rint(blocks / safe[:, None]), -127, 127
+    ).astype(np.int8)
+    return scales.tobytes(), q.reshape(-1)[:n].tobytes()
+
+
+def _dequantize_int8(
+    scales: np.ndarray, q: np.ndarray
+) -> np.ndarray:
+    out = q.astype(np.float64)
+    if len(scales):
+        out *= np.repeat(scales.astype(np.float64), _QBLOCK)[: len(q)]
+    return out
+
+
+def encode_agg(
+    cols: Dict[str, np.ndarray], quant: Optional[str] = None
+) -> List[bytes]:
+    """Frame one set of per-key partial-aggregate columns for the
+    gsync exchange, chunked into bounded frames.
+
+    Float columns quantize per ``quant`` (default: the armed
+    :func:`gsync_quant`): ``int8`` block-scales them (≈8x smaller
+    than f64), ``bf16`` truncates to bfloat16 (≈4x), ``off`` ships
+    exact bytes.  Integer (``count``), bool, datetime, and
+    fixed-width string columns ALWAYS ship exact — quantizing a count
+    would corrupt means and exactly-once accounting.  The frames ride
+    inside the existing ``gsync`` control payload: no new comm frame
+    kinds, nothing uncounted on the mesh.
+    """
+    if quant is None:
+        quant = gsync_quant()
+    if quant not in ("off", "bf16", "int8"):
+        raise ValueError(f"unknown gsync quant mode {quant!r}")
+    names = list(cols)
+    if not names:
+        return [_encode_agg_chunk({}, quant)]
+    nrows = len(np.asarray(cols[names[0]]))
+    out = []
+    for lo in range(0, max(nrows, 1), _AGG_CHUNK_ROWS):
+        chunk = {
+            name: np.asarray(col)[lo : lo + _AGG_CHUNK_ROWS]
+            for name, col in cols.items()
+        }
+        out.append(_encode_agg_chunk(chunk, quant))
+    return out
+
+
+def _encode_agg_chunk(cols: Dict[str, np.ndarray], quant: str) -> bytes:
+    head: List[bytes] = [
+        _AGG_MAGIC,
+        _U8.pack(_AGG_VERSION),
+        _U16.pack(len(cols)),
+    ]
+    bufs: List[bytes] = []
+    for name, col in cols.items():
+        arr = np.asarray(col)
+        name_b = _pack_str(name)
+        if name_b is None:
+            raise ValueError(f"aggregate column name {name!r} too long")
+        nrows = len(arr)
+        quantize = (
+            quant != "off"
+            and arr.dtype.kind == "f"
+            # The count role is exact by contract whatever its dtype.
+            and name != "count"
+        )
+        if quantize and quant == "int8":
+            scales_b, q_b = _quantize_int8(arr)
+            head.append(
+                name_b
+                + _U8.pack(_AGG_INT8)
+                + _U64.pack(nrows)
+                + _U64.pack(len(scales_b))
+            )
+            bufs.append(scales_b)
+            bufs.append(q_b)
+        elif quantize:  # bf16
+            as32 = np.ascontiguousarray(arr, dtype=np.float32)
+            u = as32.view(np.uint32)
+            # Round-to-nearest-even (not truncation): halves the
+            # worst-case relative error to 2**-8.
+            hi = (
+                (
+                    u.astype(np.uint64)
+                    + 0x7FFF
+                    + ((u >> 16) & 1)
+                )
+                >> 16
+            ).astype(np.uint16)
+            head.append(
+                name_b + _U8.pack(_AGG_BF16) + _U64.pack(nrows)
+            )
+            bufs.append(hi.tobytes())
+        elif arr.dtype.kind == "U":
+            # Unicode key columns pack as UTF-8 (exact, ~4x smaller
+            # than the U dtype's fixed 4-byte code points).
+            packed = np.char.encode(arr, "utf-8")
+            dt_b = _pack_str(packed.dtype.str)
+            if dt_b is None:
+                raise ValueError(
+                    f"aggregate column {name!r} dtype string too long"
+                )
+            buf = np.ascontiguousarray(packed).tobytes()
+            head.append(
+                name_b
+                + _U8.pack(_AGG_UTF8)
+                + dt_b
+                + _U64.pack(nrows)
+                + _U64.pack(len(buf))
+            )
+            bufs.append(buf)
+        else:
+            if arr.dtype.kind not in _RAW_KINDS or arr.dtype.itemsize == 0:
+                raise ValueError(
+                    f"aggregate column {name!r} has un-frameable "
+                    f"dtype {arr.dtype}"
+                )
+            if arr.dtype.kind in "iu" and arr.dtype.itemsize > 1 and nrows:
+                # Exact integer narrowing: counts and all-integer
+                # partials ship in the smallest signed width that
+                # holds their range (lossless — round-trips compare
+                # equal by value; the merge upcasts to f64 anyway).
+                lo, hi = int(arr.min()), int(arr.max())
+                for cand in (np.int8, np.int16, np.int32):
+                    info = np.iinfo(cand)
+                    if info.min <= lo and hi <= info.max:
+                        arr = arr.astype(cand)
+                        break
+            dt_b = _pack_str(arr.dtype.str)
+            if dt_b is None:
+                raise ValueError(
+                    f"aggregate column {name!r} dtype string too long"
+                )
+            buf = np.ascontiguousarray(arr).tobytes()
+            head.append(
+                name_b
+                + _U8.pack(_AGG_RAW)
+                + dt_b
+                + _U64.pack(nrows)
+                + _U64.pack(len(buf))
+            )
+            bufs.append(buf)
+    parts = [b"".join(head)]
+    off = len(parts[0])
+    for buf in bufs:
+        pad = -off % _ALIGN
+        if pad:
+            parts.append(b"\x00" * pad)
+            off += pad
+        parts.append(buf)
+        off += len(buf)
+    return b"".join(parts)
+
+
+def decode_agg(data: bytes) -> Dict[str, np.ndarray]:
+    """Decode one aggregate frame back into per-key partial columns
+    (quantized float columns dequantize to float64; exact columns
+    rebuild zero-copy).  Unknown magic/version/encoding raises a
+    typed :class:`WireFormatError` — a mixed cluster fails loudly."""
+    if data[:4] != _AGG_MAGIC:
+        raise WireFormatError("not a gsync aggregate frame")
+    version = data[4]
+    if version != _AGG_VERSION:
+        msg = (
+            f"gsync aggregate frame version {version} is not "
+            f"supported by this process (speaks {_AGG_VERSION}); "
+            "mixed-version clusters must run "
+            "BYTEWAX_TPU_GSYNC_QUANT=off during the rollout"
+        )
+        raise WireFormatError(msg)
+    rd = _Reader(data, 5)
+    ncols = rd.take(_U16)
+    specs: List[Tuple[str, int, Optional[str], int, int]] = []
+    for _ in range(ncols):
+        name = rd.take_str()
+        enc = rd.take(_U8)
+        if enc in (_AGG_RAW, _AGG_UTF8):
+            dt = rd.take_str()
+            nrows = rd.take(_U64)
+            specs.append((name, enc, dt, nrows, rd.take(_U64)))
+        elif enc == _AGG_BF16:
+            specs.append((name, enc, None, rd.take(_U64), 0))
+        elif enc == _AGG_INT8:
+            nrows = rd.take(_U64)
+            specs.append((name, enc, None, nrows, rd.take(_U64)))
+        else:
+            raise WireFormatError(
+                f"unknown aggregate column encoding {enc}"
+            )
+    cols: Dict[str, np.ndarray] = {}
+    for name, enc, dt, nrows, extra in specs:
+        if enc in (_AGG_RAW, _AGG_UTF8):
+            dtype = np.dtype(dt)
+            start, _end = rd.take_buf(nrows * dtype.itemsize)
+            col = np.frombuffer(
+                data, dtype=dtype, count=nrows, offset=start
+            )
+            if enc == _AGG_UTF8:
+                col = np.char.decode(col, "utf-8")
+            cols[name] = col
+        elif enc == _AGG_BF16:
+            start, _end = rd.take_buf(nrows * 2)
+            hi = np.frombuffer(
+                data, dtype=np.uint16, count=nrows, offset=start
+            )
+            as32 = (hi.astype(np.uint32) << 16).view(np.float32)
+            cols[name] = as32.astype(np.float64)
+        else:  # _AGG_INT8
+            start, _end = rd.take_buf(extra)
+            scales = np.frombuffer(
+                data, dtype=np.float32, count=extra // 4, offset=start
+            )
+            qstart, _qend = rd.take_buf(nrows)
+            q = np.frombuffer(
+                data, dtype=np.int8, count=nrows, offset=qstart
+            )
+            cols[name] = _dequantize_int8(scales, q)
+    return cols
+
+
 # -- per-peer route accumulation --------------------------------------------
 
 
 class RouteAccumulator:
-    """Per-(peer process, stream, lane) coalescing of routed slices.
+    """Per-peer coalescing of shipped slices: ``ship_route`` slices
+    bucket by (peer process, stream, lane) and ``ship_deliver`` keyed
+    split slices by (peer process, op, port, lane).
 
-    ``add`` appends a slice to the bucket's current *run* when the
-    ingest coalescer's ``can_merge`` rules allow it (same columns,
-    same scale, same vocab identity — exactly the merges no consumer
-    can observe); an incompatible slice starts a new run.  Each run
-    becomes ONE wire frame at flush.
+    ``add``/``add_deliver`` append a slice to the bucket's current
+    *run* when the ingest coalescer's ``can_merge`` rules allow it
+    (same columns, same scale, same vocab identity — exactly the
+    merges no consumer can observe); an incompatible slice starts a
+    new run.  Each run becomes ONE wire frame at flush, in global
+    first-seen bucket order across both kinds.
 
     Flush protocol (``_Driver.ship_flush``): ``peek`` exposes the
-    oldest run merged into its frame payload, the caller sends it and
-    counts it, and only then ``pop``s — so a fault fired inside
+    oldest run merged into its frame payload as ``(bucket key,
+    items)`` — the key is kind-tagged, ``("route", dest, stream_id,
+    w)`` or ``("deliver", dest, op_idx, port, w)`` — the caller sends
+    it and counts it, and only then ``pop``s; a fault fired inside
     ``comm.send`` (the pinned chaos site) unwinds with the run still
     in the pending set, never silently dropping accumulated rows.
     Rows only ever wait within one poll iteration: the driver flushes
@@ -431,12 +865,11 @@ class RouteAccumulator:
     __slots__ = ("_runs", "_order", "_head")
 
     def __init__(self):
-        self._runs: Dict[Tuple[int, str, int], List[List[Any]]] = {}
-        self._order: Deque[Tuple[int, str, int]] = deque()
-        self._head: Optional[Tuple[int, str, int, Any]] = None
+        self._runs: Dict[Tuple, List[List[Any]]] = {}
+        self._order: Deque[Tuple] = deque()
+        self._head: Optional[Tuple[Tuple, Any]] = None
 
-    def add(self, dest: int, stream_id: str, w: int, items: Any) -> None:
-        key = (dest, stream_id, w)
+    def _add(self, key: Tuple, items: Any) -> None:
         runs = self._runs.get(key)
         if runs is None:
             runs = []
@@ -449,6 +882,16 @@ class RouteAccumulator:
         # A peeked-but-unsent head may alias the run just extended.
         self._head = None
 
+    def add(self, dest: int, stream_id: str, w: int, items: Any) -> None:
+        """Accumulate one routed slice."""
+        self._add(("route", dest, stream_id, w), items)
+
+    def add_deliver(
+        self, dest: int, op_idx: int, port: str, w: int, items: Any
+    ) -> None:
+        """Accumulate one keyed-split delivery slice."""
+        self._add(("deliver", dest, op_idx, port, w), items)
+
     def pending(self) -> bool:
         return bool(self._order)
 
@@ -460,16 +903,15 @@ class RouteAccumulator:
         iteration)."""
         return sum(len(runs) for runs in list(self._runs.values()))
 
-    def peek(self) -> Optional[Tuple[int, str, int, Any]]:
-        """The oldest pending frame as ``(dest, stream_id, w, items)``
-        with its run merged, or None; stays pending until :meth:`pop`."""
+    def peek(self) -> Optional[Tuple[Tuple, Any]]:
+        """The oldest pending frame as ``(bucket key, items)`` with
+        its run merged, or None; stays pending until :meth:`pop`."""
         if self._head is not None:
             return self._head
         if not self._order:
             return None
         key = self._order[0]
-        dest, stream_id, w = key
-        self._head = (dest, stream_id, w, merge_batches(self._runs[key][0]))
+        self._head = (key, merge_batches(self._runs[key][0]))
         return self._head
 
     def pop(self) -> None:

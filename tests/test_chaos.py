@@ -692,3 +692,82 @@ def test_cluster_chaos_soak_columnar_wire(tmp_path):
     assert sorted(
         Path(out_path).read_text().split()
     ) == _columnar_seq_oracle(cap)
+
+
+# -- overlapped collectives: faults during an in-flight round ----------
+
+
+def test_cluster_overlapped_round_comm_fault_exactly_once(tmp_path):
+    """An injected comm fault while an overlapped collective round is
+    in flight (BYTEWAX_TPU_GSYNC_OVERLAP=1: epoch N's exchange runs
+    on the collective lane while epoch N+1 computes) must unwind
+    restartable — the teardown waits the lane quiet, both processes
+    re-form the mesh under their supervisors — and the completed run
+    emits the oracle exactly once.  The crash fires inside comm.send
+    BEFORE the round payload leaves, so the unwind is symmetric: a
+    round is sealed cluster-wide or nowhere (docs/performance.md
+    "Overlapped collectives")."""
+    from tests.test_cluster import (
+        _GX_PACED_FLOW,
+        _gx_paced_oracle,
+    )
+
+    flow_py = tmp_path / "gx_chaos.py"
+    out_path = str(tmp_path / "gx_chaos_out.txt")
+    flow_py.write_text(_GX_PACED_FLOW.format(out_path=out_path))
+    env = _env(
+        {
+            "BYTEWAX_TPU_ACCEL": "1",
+            "BYTEWAX_TPU_DISTRIBUTED": "1",
+            "BYTEWAX_TPU_GLOBAL_EXCHANGE": "1",
+            "BYTEWAX_TPU_GLOBAL_EXCHANGE_DEBUG": "1",
+            "BYTEWAX_TPU_GSYNC_OVERLAP": "1",
+            # Batch-granular ingest so the run spans several epochs
+            # (several in-flight rounds), not one EOF burst.
+            "BYTEWAX_TPU_INGEST_TARGET_ROWS": "0",
+            "GX_PACE_S": "0.1",
+            "GX_BATCHES": "5",
+            # Crash worker 1 inside a comm send at epoch 3: rounds
+            # for earlier epochs have been sealed and are running on
+            # the collective lanes.  x1 so the restarted generation
+            # runs clean; no recovery store — the global tier's
+            # sources replay from scratch and the aggregation emits
+            # only at EOF, so the final output is exactly-once.
+            "BYTEWAX_TPU_FAULTS": "comm.send:crash:3:1:x1",
+            "BYTEWAX_TPU_MAX_RESTARTS": "3",
+            "BYTEWAX_TPU_RESTART_BACKOFF_S": "0.1",
+            "BYTEWAX_TPU_EPOCH_STALL_S": "15",
+        }
+    )
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.testing",
+            f"{flow_py}:flow",
+            "-p",
+            "2",
+            "-s",
+            "0.2",
+        ],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "supervised restart" in res.stderr, res.stderr[-3000:]
+    # Rounds really overlapped before and after the restart.
+    assert res.stderr.count("global-exchange:") >= 2, res.stderr[-2000:]
+    got = {}
+    for line in Path(out_path).read_text().split():
+        key, mn, mean, mx, count = line.split(";")
+        assert key not in got, f"key {key} emitted twice"
+        got[key] = (float(mn), float(mean), float(mx), int(count))
+    oracle = _gx_paced_oracle(batches=5)
+    assert set(got) == set(oracle)
+    for k, (mn, mean, mx, count) in oracle.items():
+        assert got[k][0] == mn and got[k][2] == mx
+        assert got[k][3] == count
+        assert abs(got[k][1] - mean) < 1e-6

@@ -1164,3 +1164,264 @@ def test_cluster_wire_crash_replay_exactly_once(tmp_path, wire_mode):
     assert sorted(
         Path(out_path).read_text().split()
     ) == _columnar_seq_oracle(cap)
+
+
+# -- overlapped collectives + quantized aggregate exchange -------------
+
+_GX_PACED_FLOW = '''
+import os
+
+import bytewax_tpu.operators as op
+from bytewax_tpu import xla
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+
+
+class _Part(StatelessSourcePartition):
+    """Paced batches so the run spans several epochs (several
+    collective flush rounds), not one EOF burst."""
+
+    def __init__(self, worker_index):
+        import time
+
+        base = worker_index * 1000
+        self._sleep = float(os.environ.get("GX_PACE_S", "0"))
+        self._time = time
+        self._batches = [
+            [
+                (f"k{{i % 7}}", float(base + b * 100 + i))
+                for i in range(100)
+            ]
+            for b in range(int(os.environ.get("GX_BATCHES", "4")))
+        ]
+
+    def next_batch(self):
+        if not self._batches:
+            raise StopIteration()
+        if self._sleep:
+            self._time.sleep(self._sleep)
+        return self._batches.pop(0)
+
+
+class Src(DynamicSource):
+    def build(self, step_id, worker_index, worker_count):
+        return _Part(worker_index)
+
+
+flow = Dataflow("gx_paced_df")
+s = op.input("inp", flow, Src())
+st = xla.stats_final("stats", s)
+fmt = op.map(
+    "fmt",
+    st,
+    lambda kv: (
+        kv[0],
+        f"{{kv[0]}};{{kv[1][0]}};{{kv[1][1]:.6f}};{{kv[1][2]}};{{kv[1][3]}}",
+    ),
+)
+vals = op.map_value("val", fmt, lambda v: v)
+op.output("out", vals, FileSink({out_path!r}))
+'''
+
+
+def _gx_paced_oracle(batches=4):
+    rows = {}
+    for base in (0, 1000):
+        for b in range(batches):
+            for i in range(100):
+                rows.setdefault(f"k{i % 7}", []).append(
+                    float(base + b * 100 + i)
+                )
+    return {
+        k: (min(g), sum(g) / len(g), max(g), len(g))
+        for k, g in rows.items()
+    }
+
+
+def _run_gx_paced(tmp_path, name, extra_env, timeout=240):
+    flow_py = tmp_path / f"{name}.py"
+    out_path = str(tmp_path / f"{name}_out.txt")
+    flow_py.write_text(_GX_PACED_FLOW.format(out_path=out_path))
+    env = _env()
+    env["BYTEWAX_TPU_ACCEL"] = "1"
+    env["BYTEWAX_TPU_DISTRIBUTED"] = "1"
+    env["BYTEWAX_TPU_GLOBAL_EXCHANGE"] = "1"
+    env["BYTEWAX_TPU_GLOBAL_EXCHANGE_DEBUG"] = "1"
+    # Keep ingest batch-granular: the coalescer would swallow the
+    # whole paced source inside one poll and collapse the run into a
+    # single EOF flush — these tests need SEVERAL epoch-close rounds.
+    env["BYTEWAX_TPU_INGEST_TARGET_ROWS"] = "0"
+    env.update(extra_env)
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.testing",
+            f"{flow_py}:flow",
+            "-p",
+            "2",
+            "-s",
+            "0.2",
+        ],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    got = {}
+    for line in Path(out_path).read_text().split():
+        key, mn, mean, mx, count = line.split(";")
+        assert key not in got, f"key {key} emitted twice"
+        got[key] = (float(mn), float(mean), float(mx), int(count))
+    return got, res.stderr
+
+
+def test_cluster_gsync_overlap_matches_lockstep_and_oracle(tmp_path):
+    """BYTEWAX_TPU_GSYNC_OVERLAP=1: the sealed exchange runs on the
+    collective lane one epoch behind the compute frontier, and the
+    final output is BYTE-IDENTICAL to the lock-step tier and the
+    host oracle — overlap changes when the collective runs, never
+    what it computes (docs/performance.md "Overlapped
+    collectives")."""
+    env = {"GX_PACE_S": "0.12", "GX_BATCHES": "4"}
+    lockstep, _ = _run_gx_paced(
+        tmp_path, "gx_lockstep", dict(env, BYTEWAX_TPU_GSYNC_OVERLAP="0")
+    )
+    overlap, stderr = _run_gx_paced(
+        tmp_path, "gx_overlap", dict(env, BYTEWAX_TPU_GSYNC_OVERLAP="1")
+    )
+    # Both processes sealed collective rounds (several epochs).
+    assert stderr.count("global-exchange: proc 0 flushed") >= 1
+    assert stderr.count("global-exchange: proc 1 flushed") >= 1
+    assert overlap == lockstep
+    oracle = _gx_paced_oracle()
+    assert set(overlap) == set(oracle)
+    for k, (mn, mean, mx, count) in oracle.items():
+        assert overlap[k][0] == mn and overlap[k][2] == mx
+        assert overlap[k][3] == count
+        assert abs(overlap[k][1] - mean) < 1e-6
+
+
+@pytest.mark.parametrize("quant", ["int8", "bf16"])
+def test_cluster_gsync_quant_bounds_and_exact_counts(tmp_path, quant):
+    """BYTEWAX_TPU_GSYNC_QUANT: the quantized partial exchange
+    produces counts EXACTLY equal to the exact tier's and floats
+    within the codec's documented bounds — composed with overlap or
+    not.  (The two runs are not compared to each other: the
+    epoch-boundary split of rows across flush rounds is wall-clock
+    dependent, so per-round quantization error differs run to run;
+    the invariants are the bounds and the exact counts.)"""
+    env = {"GX_PACE_S": "0.1", "GX_BATCHES": "3"}
+    quant_env = dict(env, BYTEWAX_TPU_GSYNC_QUANT=quant)
+    got, _ = _run_gx_paced(tmp_path, f"gx_{quant}", quant_env)
+    both, _ = _run_gx_paced(
+        tmp_path,
+        f"gx_{quant}_ovl",
+        dict(quant_env, BYTEWAX_TPU_GSYNC_OVERLAP="1"),
+    )
+    oracle = _gx_paced_oracle(batches=3)
+    assert set(got) == set(oracle)
+    assert set(both) == set(oracle)
+    for k, (mn, mean, mx, count) in oracle.items():
+        gmn, gmean, gmx, gcount = got[k]
+        assert gcount == count  # counts exact, always
+        assert both[k][3] == count  # under overlap too
+        # min/max partials: one value per key per flush round, so
+        # the error never accumulates — bounded by one quantization
+        # step of the block max (values span up to ~1400).
+        tol = (1400.0 / 254.0) if quant == "int8" else 1400.0 * 2.0**-8
+        assert abs(gmn - mn) <= tol, (k, quant)
+        assert abs(gmx - mx) <= tol, (k, quant)
+        # sum partials accumulate one quantization error per flush
+        # round, and the epoch split is timing-dependent — assert a
+        # loose-but-meaningful relative bound on the mean (the exact
+        # per-round bound is pinned by the codec property test in
+        # tests/test_wire.py).
+        assert abs(gmean - mean) <= 0.05 * max(abs(mean), 1.0), (
+            k,
+            quant,
+        )
+
+
+def test_cluster_gsync_quant_divergence_fails_typed(tmp_path):
+    """A cluster where processes disagree on the quant mode must
+    fail loudly at the first flush (the mode rides the round
+    payload), never desynchronize the round sequence."""
+    flow_py = tmp_path / "gx_div.py"
+    out_path = str(tmp_path / "gx_div_out.txt")
+    flow_py.write_text(_GX_PACED_FLOW.format(out_path=out_path))
+    spawn_py = tmp_path / "spawn_div.py"
+    spawn_py.write_text(
+        '''
+import os, subprocess, sys, socket
+
+def free_port():
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]; s.close(); return p
+
+addrs = ";".join(f"127.0.0.1:{free_port()}" for _ in range(2))
+procs = []
+for pid, quant in ((0, "int8"), (1, "off")):
+    env = dict(os.environ)
+    env["BYTEWAX_TPU_GSYNC_QUANT"] = quant
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "bytewax_tpu.run",
+         sys.argv[1] + ":flow", "-a", addrs, "-i", str(pid),
+         "-s", "0.2"],
+        env=env, stderr=subprocess.PIPE, text=True,
+    ))
+errs = [p.communicate(timeout=150)[1] for p in procs]
+codes = [p.returncode for p in procs]
+sys.stderr.write("\\n".join(errs))
+sys.exit(0 if any(c != 0 for c in codes) else 3)
+'''
+    )
+    env = _env()
+    env["BYTEWAX_TPU_ACCEL"] = "1"
+    env["BYTEWAX_TPU_DISTRIBUTED"] = "1"
+    env["GX_BATCHES"] = "2"
+    res = subprocess.run(
+        [sys.executable, str(spawn_py), str(flow_py)],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=200,
+    )
+    assert res.returncode == 0, (res.returncode, res.stderr[-3000:])
+    assert "disagree on BYTEWAX_TPU_GSYNC_QUANT" in res.stderr
+
+
+def test_gsync_overlap_knob_inert_without_global_mesh(
+    entry_point, tmp_path, monkeypatch
+):
+    """Overlap/quant only renegotiate the cluster-spanning collective
+    tier: under all three in-process entry points (no global mesh)
+    the knobs are inert and a keyed aggregation equals the host
+    oracle bit for bit."""
+    import bytewax_tpu.operators as op
+    from bytewax_tpu import xla as bxla
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.testing import TestingSink, TestingSource
+    from datetime import timedelta
+
+    monkeypatch.setenv("BYTEWAX_TPU_GSYNC_OVERLAP", "1")
+    monkeypatch.setenv("BYTEWAX_TPU_GSYNC_QUANT", "int8")
+    from bytewax_tpu.engine import wire as _wire
+
+    _wire.reconfigure()
+    items = [(f"k{i % 5}", float(i)) for i in range(200)]
+    out = []
+    flow = Dataflow("gsync_inert_df")
+    s = op.input("inp", flow, TestingSource(items, batch_size=16))
+    summed = op.reduce_final("sum", s, bxla.SUM)
+    op.output("out", summed, TestingSink(out))
+    entry_point(flow, epoch_interval=timedelta(seconds=0))
+    _wire.reconfigure()
+    oracle = {}
+    for k, v in items:
+        oracle[k] = oracle.get(k, 0.0) + v
+    assert dict(out) == oracle

@@ -166,8 +166,12 @@ def test_send_surface_allowlist_is_pinned():
     # The columnar-exchange PR grew the ship surface by exactly one
     # method: ship_flush, the route-accumulator drain (frames ship
     # and count ONLY there or in the direct ship paths) — and made
-    # the wire codec module part of the send surface: only the comm/
-    # driver pair (and the module itself) may call into it.
+    # the wire codec module part of the send surface.  The
+    # overlapped-collectives PR widened the codec's callers by
+    # exactly one module: engine/sharded_state.py, whose quantized
+    # partial-aggregate frames (encode_agg/decode_agg) ride the
+    # EXISTING gsync payload — no new frame kinds, no new ship
+    # methods, nothing uncounted on the mesh.
     assert contracts.SHIP_METHODS == {
         "ship_deliver",
         "ship_route",
@@ -177,6 +181,7 @@ def test_send_surface_allowlist_is_pinned():
     assert contracts.WIRE_ALLOWED_MODULES == {
         "bytewax_tpu.engine.comm",
         "bytewax_tpu.engine.driver",
+        "bytewax_tpu.engine.sharded_state",
         "bytewax_tpu.engine.wire",
     }
     assert contracts.GSYNC_CALLER_MODULES == {
@@ -319,12 +324,20 @@ def test_worker_lane_inventory_is_pinned():
     project = _project()
     roots = worker_lane_roots(project)
     driver = "bytewax_tpu.engine.driver"
-    # Exactly the three device-tier submission shapes: the window
-    # task, the scan task, and the keyed-aggregation fold lambda.
+    sharded = "bytewax_tpu.engine.sharded_state"
+    # Exactly the three device-tier submission shapes — the window
+    # task, the scan task, the keyed-aggregation fold lambda — plus
+    # the overlapped-collectives PR's two sealed exchange tasks on
+    # the global tier's collective lane (docs/performance.md
+    # "Overlapped collectives"): the exact device exchange and the
+    # quantized partial merge, both sealed at a globally-ordered
+    # flush and fenced at the next close/finalize.
     assert set(roots) == {
         f"{driver}:_StatefulBatchRt._push_window_task.<locals>.task",
         f"{driver}:_StatefulBatchRt._push_scan_task.<locals>.task",
         f"{driver}:_StatefulBatchRt._process_accel.<locals>.<lambda>",
+        f"{sharded}:GlobalAggState.flush.<locals>.exchange_task",
+        f"{sharded}:GlobalAggState.flush.<locals>.merge_task",
     }
     # The send surface, sync rounds, emission/routing, recovery
     # store, residency movement, and pipeline drains are main-only.
@@ -376,7 +389,7 @@ def test_worker_lane_inventory_is_pinned():
 
 
 def test_knob_catalog_is_pinned():
-    """The knob inventory: exactly today's 51 BYTEWAX_TPU_* knobs,
+    """The knob inventory: exactly today's 53 BYTEWAX_TPU_* knobs,
     each with a default and a doc anchor.  Adding a knob requires
     updating contracts.KNOBS, this list, docs/configuration.md, and
     the anchor doc — BTX-KNOB enforces the rest (literal reads,
@@ -388,7 +401,14 @@ def test_knob_catalog_is_pinned():
     docs/deployment.md.  The live-rescale PR added exactly one:
     BYTEWAX_TPU_AUTOSCALE_LIVE (default on — a scale move is an
     epoch-boundary membership change with delta-only migration; 0
-    forces the legacy whole-cluster drain-to-stop + relaunch)."""
+    forces the legacy whole-cluster drain-to-stop + relaunch).  The
+    overlapped-collectives PR added exactly two:
+    BYTEWAX_TPU_GSYNC_OVERLAP (default off — 1 double-buffers the
+    global tier's exchange rounds on the collective lane; 0 is the
+    lock-step tier, byte-identical to the pre-overlap engine) and
+    BYTEWAX_TPU_GSYNC_QUANT (default off — bf16/int8 block-scale the
+    gsync partial-aggregate frames; counts stay exact), both
+    anchored at docs/performance.md "Overlapped collectives"."""
     assert sorted(contracts.KNOBS) == [
         "BYTEWAX_TPU_ACCEL",
         "BYTEWAX_TPU_ALLOW_REMOTE_STOP",
@@ -414,6 +434,8 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_GC",
         "BYTEWAX_TPU_GLOBAL_EXCHANGE",
         "BYTEWAX_TPU_GLOBAL_EXCHANGE_DEBUG",
+        "BYTEWAX_TPU_GSYNC_OVERLAP",
+        "BYTEWAX_TPU_GSYNC_QUANT",
         "BYTEWAX_TPU_HB_S",
         "BYTEWAX_TPU_HEARTBEAT_S",
         "BYTEWAX_TPU_HOST_STATE_BUDGET",
@@ -442,7 +464,7 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_TRACE_DIR",
         "BYTEWAX_TPU_WIRE",
     ]
-    assert len(contracts.KNOBS) == 51
+    assert len(contracts.KNOBS) == 53
     for name, (default, doc) in contracts.KNOBS.items():
         assert isinstance(default, str), name
         assert doc.startswith("docs/") and doc.endswith(".md"), name
@@ -496,8 +518,9 @@ def test_wire_codec_is_pure_and_allowlisted():
     wire module's functions touch a raw send primitive, a ship
     method, or a sync round, and it never constructs a Comm.  The
     module itself is send-surface-adjacent: BTX-SEND restricts
-    resolved calls into it to the comm/driver pair
-    (``contracts.WIRE_ALLOWED_MODULES``, pinned in
+    resolved calls into it to the comm/driver pair plus the
+    global-mesh collective tier, whose quantized aggregate frames it
+    encodes (``contracts.WIRE_ALLOWED_MODULES``, pinned in
     test_send_surface_allowlist_is_pinned)."""
     project = _project()
     assert contracts.WIRE_MODULE in project.modules

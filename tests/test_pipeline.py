@@ -257,14 +257,17 @@ def test_pipeline_metrics_exposed(monkeypatch):
     assert "bytewax_pipeline_flush_stall_seconds" in text
 
 
-def test_global_exchange_tier_never_pipelines(monkeypatch):
-    """The collective global-exchange tier must stay synchronous
-    (depth 1 semantics): its flush is a cluster collective legal only
-    at globally-ordered points, so the driver never arms a pipeline
-    for it."""
+def test_global_exchange_tier_never_enters_dispatch_pipeline(monkeypatch):
+    """The collective global-exchange tier never enters the
+    per-delivery dispatch pipeline: its flush is a cluster collective
+    legal only at globally-ordered points, so the driver never arms a
+    ``_pipe`` for it.  (The tier's OWN overlapped exchange lane —
+    ``BYTEWAX_TPU_GSYNC_OVERLAP``, default off — is a different,
+    deliberately fenced surface: rounds are sealed at epoch close and
+    fenced at the next close/finalize, never per batch.)"""
     from bytewax_tpu.engine.pipeline import DevicePipeline as DP
 
-    assert DP.__init__.__defaults__ == (None,)
+    assert DP.__init__.__defaults__ == (None, "device")
     # Contract is structural: _StatefulBatchRt only builds a pipeline
     # for non-global tiers (see driver.__init__); pin the guard here
     # so a refactor can't silently drop it.
@@ -274,3 +277,9 @@ def test_global_exchange_tier_never_pipelines(monkeypatch):
 
     src = inspect.getsource(drv._StatefulBatchRt.__init__)
     assert "global_exchange" in src and "DevicePipeline" in src
+    # And with overlap off (the default), the global tier constructs
+    # no lane at all — byte-identical to the lock-step engine.
+    from bytewax_tpu.engine import sharded_state as ss
+
+    monkeypatch.delenv("BYTEWAX_TPU_GSYNC_OVERLAP", raising=False)
+    assert ss._gsync_overlap() is False

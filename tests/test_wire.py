@@ -271,8 +271,8 @@ def test_accumulator_merges_compatible_runs():
     acc.add(1, "s", 4, _vb([0], [1.0], vocab))
     acc.add(1, "s", 4, _vb([1], [2.0], vocab))
     acc.add(1, "s", 4, _vb([0], [3.0], vocab))
-    dest, sid, w, items = acc.peek()
-    assert (dest, sid, w) == (1, "s", 4)
+    key, items = acc.peek()
+    assert key == ("route", 1, "s", 4)
     assert len(items) == 3  # one frame for the whole run
     assert np.array_equal(items.cols["value"], [1.0, 2.0, 3.0])
     acc.pop()
@@ -289,16 +289,21 @@ def test_accumulator_keeps_incompatible_slices_apart():
     while acc.pending():
         frames.append(acc.peek())
         acc.pop()
-    assert [(f[0], f[2]) for f in frames] == [(1, 4), (1, 4), (1, 5), (2, 4)]
-    assert frames[0][3].value_scale is None
-    assert frames[1][3].value_scale == 0.1
+    assert [(f[0][1], f[0][3]) for f in frames] == [
+        (1, 4),
+        (1, 4),
+        (1, 5),
+        (2, 4),
+    ]
+    assert frames[0][1].value_scale is None
+    assert frames[1][1].value_scale == 0.1
 
 
 def test_accumulator_merges_item_lists_too():
     acc = wire.RouteAccumulator()
     acc.add(0, "s", 1, [("k", 1)])
     acc.add(0, "s", 1, [("k", 2), ("j", 3)])
-    assert acc.peek()[3] == [("k", 1), ("k", 2), ("j", 3)]
+    assert acc.peek()[1] == [("k", 1), ("k", 2), ("j", 3)]
     acc.pop()
     assert acc.peek() is None
 
@@ -319,9 +324,205 @@ def test_accumulator_peek_is_stable_until_pop():
 def test_accumulator_add_after_peek_invalidates_head():
     acc = wire.RouteAccumulator()
     acc.add(1, "s", 4, _vb([0], [1.0]))
-    assert len(acc.peek()[3]) == 1
+    assert len(acc.peek()[1]) == 1
     acc.add(1, "s", 4, _vb([1], [2.0]))
-    assert len(acc.peek()[3]) == 2  # re-merged, nothing stranded
+    assert len(acc.peek()[1]) == 2  # re-merged, nothing stranded
+
+
+def test_accumulator_deliver_buckets_coalesce_apart_from_route():
+    """The deliver leg (keyed split slices): same-(peer, op, port,
+    lane) slices coalesce into one frame, bucketed apart from route
+    slices and from other ports/ops, in global first-seen order."""
+    acc = wire.RouteAccumulator()
+    acc.add_deliver(1, 7, "up", 3, _vb([0], [1.0]))
+    acc.add(1, "s", 3, _vb([0], [2.0]))
+    acc.add_deliver(1, 7, "up", 3, _vb([1], [3.0]))
+    acc.add_deliver(1, 8, "up", 3, _vb([1], [4.0]))  # other op
+    frames = []
+    while acc.pending():
+        frames.append(acc.peek())
+        acc.pop()
+    assert [f[0] for f in frames] == [
+        ("deliver", 1, 7, "up", 3),
+        ("route", 1, "s", 3),
+        ("deliver", 1, 8, "up", 3),
+    ]
+    assert np.array_equal(frames[0][1].cols["value"], [1.0, 3.0])
+
+
+# -- the vocab/schema session cache -------------------------------------
+
+
+def test_vocab_session_ships_once_then_refs():
+    """An unchanged key_vocab for one (peer, stream) ships its body
+    once; subsequent frames carry only the generation tag and decode
+    against the receiver's cache — and the ref frames are materially
+    smaller than defining frames."""
+    tx, rx = wire.WireSession(), wire.WireSession()
+    vocab = np.array([f"key-{i:04d}" for i in range(512)])
+    b1 = _vb([0, 1], [1.0, 2.0], vocab)
+    b2 = _vb([2, 3], [3.0, 4.0], vocab)
+    d1 = wire.encode(("route", "s", (1, b1)), tx, 9)
+    d2 = wire.encode(("route", "s", (1, b2)), tx, 9)
+    assert len(d2) < len(d1) - len(vocab.tobytes()) // 2
+    got1 = wire.decode(d1, rx, 9)[2][1]
+    got2 = wire.decode(d2, rx, 9)[2][1]
+    assert np.array_equal(np.asarray(got1.key_vocab), vocab)
+    assert np.array_equal(np.asarray(got2.key_vocab), vocab)
+    assert got2.key_vocab is got1.key_vocab  # resolved from cache
+
+
+def test_vocab_session_invalidates_on_growth_and_scopes_streams():
+    """A vocab grown in place (same object, longer) re-defines under
+    a fresh generation; a different stream never shares an entry."""
+    tx, rx = wire.WireSession(), wire.WireSession()
+    vocab = ["a", "b"]
+    d1 = wire.encode(("route", "s", (0, _vb([0], [1.0], vocab))), tx, 3)
+    vocab.append("c")  # append-only in-place growth
+    d2 = wire.encode(("route", "s", (0, _vb([2], [2.0], vocab))), tx, 3)
+    assert wire.decode(d1, rx, 3)[2][1].key_vocab == ["a", "b"]
+    assert wire.decode(d2, rx, 3)[2][1].key_vocab == ["a", "b", "c"]
+    # Same vocab on ANOTHER stream: defines there too (scoped cache).
+    d3 = wire.encode(("route", "t", (0, _vb([0], [3.0], vocab))), tx, 3)
+    assert wire.decode(d3, rx, 3)[2][1].key_vocab == ["a", "b", "c"]
+
+
+def test_vocab_ref_without_defining_frame_raises_typed():
+    """A ref whose defining frame the receiver never saw (fresh
+    session — a restarted generation) fails typed, never resolves
+    against stale state."""
+    tx = wire.WireSession()
+    vocab = np.array(["a", "b"])
+    wire.encode(("route", "s", (0, _vb([0], [1.0], vocab))), tx, 1)
+    ref = wire.encode(("route", "s", (1, _vb([1], [2.0], vocab))), tx, 1)
+    with pytest.raises(WireFormatError, match="generation"):
+        wire.decode(ref, wire.WireSession(), 1)
+    with pytest.raises(WireFormatError, match="session"):
+        wire.decode(ref)  # no session at all
+
+
+def test_vocab_session_not_armed_without_session():
+    """Sessionless encode (tests, tools) always ships the full vocab
+    — byte-stable behavior for callers outside the comm layer."""
+    vocab = np.array(["a", "b"])
+    d1 = wire.encode(("route", "s", (0, _vb([0], [1.0], vocab))))
+    d2 = wire.encode(("route", "s", (1, _vb([1], [2.0], vocab))))
+    assert abs(len(d1) - len(d2)) <= 8  # both carry the body
+    assert wire.decode(d2)[2][1].key_vocab is not None
+
+
+# -- the quantized gsync aggregate codec --------------------------------
+
+
+def _partial_cols(n=2000, seed=11):
+    rng = np.random.RandomState(seed)
+    return {
+        "key": np.array([f"k{i:05d}" for i in range(n)]),
+        "min": rng.randn(n) * 100.0,
+        "max": rng.randn(n) * 100.0 + 500.0,
+        "sum": rng.randn(n) * 1e4,
+        "count": rng.randint(1, 1000, size=n).astype(np.int64),
+    }
+
+
+@pytest.mark.parametrize("quant", ["off", "bf16", "int8"])
+def test_agg_codec_roundtrip_bounds(quant):
+    """The quantized aggregate codec's accuracy contract
+    (docs/performance.md "Overlapped collectives"): float columns
+    round-trip within the documented bound — int8 within half a
+    quantization step of the block max, bf16 within 2**-8 relative —
+    and exact columns (key strings, counts) are byte-exact under
+    EVERY mode."""
+    cols = _partial_cols()
+    frames = wire.encode_agg(cols, quant)
+    dec = {}
+    for frame in frames:
+        for name, arr in wire.decode_agg(frame).items():
+            dec.setdefault(name, []).append(arr)
+    dec = {k: np.concatenate(v) for k, v in dec.items()}
+    assert np.array_equal(dec["key"], cols["key"])
+    # Counts are exact by VALUE under every mode (the codec may
+    # narrow the integer width losslessly).
+    assert dec["count"].dtype.kind == "i"
+    assert np.array_equal(dec["count"], cols["count"])  # exact, always
+    for name in ("min", "max", "sum"):
+        orig, got = cols[name], dec[name]
+        if quant == "off":
+            assert np.array_equal(got, orig)
+        elif quant == "int8":
+            # Per 1024-value block: |err| <= max|block| / 254.
+            nb = -(-len(orig) // 1024)
+            padded = np.zeros(nb * 1024)
+            padded[: len(orig)] = orig
+            bound = np.repeat(
+                np.abs(padded.reshape(nb, 1024)).max(axis=1) / 254.0,
+                1024,
+            )[: len(orig)]
+            assert np.all(np.abs(got - orig) <= bound + 1e-9), name
+        else:  # bf16
+            denom = np.maximum(np.abs(orig), 1e-30)
+            assert np.all(np.abs(got - orig) / denom <= 2.0**-8), name
+
+
+def test_agg_codec_all_int_columns_exact_under_int8():
+    """Integer partial columns (all-integer workloads) never
+    quantize: int8 mode ships them byte-exact."""
+    cols = {
+        "key": np.array(["a", "b", "c"]),
+        "sum": np.array([10**12, -(10**12), 7], dtype=np.int64),
+        "count": np.array([3, 4, 5], dtype=np.int64),
+    }
+    (frame,) = wire.encode_agg(cols, "int8")
+    dec = wire.decode_agg(frame)
+    assert np.array_equal(dec["sum"], cols["sum"])
+    assert np.array_equal(dec["count"], cols["count"])
+
+
+def test_agg_codec_int8_shrinks_floats():
+    """The bytes win the bench reports: int8 frames for float-heavy
+    partial columns are well under half the exact framing."""
+    cols = _partial_cols(n=8192)
+    exact = sum(len(f) for f in wire.encode_agg(cols, "off"))
+    int8 = sum(len(f) for f in wire.encode_agg(cols, "int8"))
+    bf16 = sum(len(f) for f in wire.encode_agg(cols, "bf16"))
+    # The key/count columns ship exact in every mode; the three f64
+    # columns shrink 8x (int8) / 4x (bf16).
+    assert int8 <= 0.5 * exact
+    assert bf16 < exact
+
+
+def test_agg_codec_chunks_oversized_column_sets():
+    n = (1 << 16) + 123  # one full chunk + a tail
+    cols = {
+        "key": np.array([f"k{i}" for i in range(n)]),
+        "sum": np.arange(n, dtype=np.float64),
+    }
+    frames = wire.encode_agg(cols, "off")
+    assert len(frames) == 2
+    dec = np.concatenate(
+        [wire.decode_agg(f)["sum"] for f in frames]
+    )
+    assert np.array_equal(dec, cols["sum"])
+
+
+def test_agg_codec_unknown_version_raises_typed():
+    (frame,) = wire.encode_agg({"sum": np.arange(4.0)}, "int8")
+    bad = bytearray(frame)
+    bad[4] = 99
+    with pytest.raises(WireFormatError, match="version 99"):
+        wire.decode_agg(bytes(bad))
+    with pytest.raises(WireFormatError, match="aggregate"):
+        wire.decode_agg(b"\x80nonsense")
+
+
+def test_gsync_quant_knob_is_validated(monkeypatch):
+    monkeypatch.setenv("BYTEWAX_TPU_GSYNC_QUANT", "int4")
+    wire.reconfigure()
+    with pytest.raises(ValueError, match="int4"):
+        wire.gsync_quant()
+    monkeypatch.setenv("BYTEWAX_TPU_GSYNC_QUANT", "bf16")
+    wire.reconfigure()
+    assert wire.gsync_quant() == "bf16"
 
 
 # -- the driver's zero-row skip + in-process exchange parity ------------
