@@ -459,6 +459,88 @@ def _run_windowing_session(n_rows: int, batch_rows: int) -> float:
     return n_rows / dt
 
 
+def _run_flowmap_overhead():
+    """Flow-map observability overhead (docs/observability.md "Flow
+    map"): the pipelined windowed bench with the API server up and a
+    thread polling ``GET /graph`` continuously, vs idle — the flow
+    map must stay ledger-cheap (dict adds sealed per epoch), so the
+    polled run is asserted within 3% of the idle run.  Returns
+    ``(overhead_pct, polls, bottleneck_step)``; the bottleneck is the
+    derived attribution over the run's sealed records."""
+    import threading
+    import urllib.request
+
+    rows = 1 << 21
+    idle = max(
+        _run_windowing_columnar(rows, 1 << 19, accel=True, depth=2)
+        for _ in range(2)
+    )
+
+    port = 13990
+    os.environ["BYTEWAX_DATAFLOW_API_ENABLED"] = "1"
+    os.environ["BYTEWAX_DATAFLOW_API_PORT"] = str(port)
+    stop = threading.Event()
+    seen = {"polls": 0, "bottleneck": None}
+
+    def _poll():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/graph", timeout=1
+                ) as resp:
+                    doc = json.loads(resp.read())
+                seen["polls"] += 1
+                if doc.get("bottleneck"):
+                    seen["bottleneck"] = doc["bottleneck"]["step"]
+            except Exception:  # noqa: BLE001 - server cycles per rep
+                pass
+            stop.wait(0.05)
+
+    poller = threading.Thread(target=_poll, daemon=True)
+    poller.start()
+    try:
+        polled = max(
+            _run_windowing_columnar(
+                rows, 1 << 19, accel=True, depth=2
+            )
+            for _ in range(2)
+        )
+    finally:
+        stop.set()
+        poller.join(timeout=5)
+        os.environ.pop("BYTEWAX_DATAFLOW_API_ENABLED", None)
+        os.environ.pop("BYTEWAX_DATAFLOW_API_PORT", None)
+
+    overhead_pct = (idle - polled) / idle * 100.0
+    assert overhead_pct < 3.0, (
+        f"flow-map polling cost {overhead_pct:.1f}% "
+        f"({idle:.0f} -> {polled:.0f} events/s)"
+    )
+
+    bottleneck = seen["bottleneck"]
+    if bottleneck is None:
+        # Single-epoch EOF runs seal after the last poll window:
+        # derive from the sealed ledger directly (same pure
+        # attribution /graph uses).
+        from bytewax_tpu.engine import flight, flowmap
+
+        ledger = flight.RECORDER.last_ledger or {}
+        steps = {}
+        for phase_steps in ledger.get("phases", {}).values():
+            for step, s in phase_steps.items():
+                if step == "*":
+                    continue
+                ent = steps.setdefault(step, {})
+                ent["busy_s"] = ent.get("busy_s", 0.0) + s
+        for step, depth in ledger.get(
+            "queue_depth_at_drain", {}
+        ).items():
+            steps.setdefault(step, {})["queue_depth"] = depth
+        bn = flowmap.derive_bottleneck(steps)
+        bottleneck = bn[0] if bn else None
+    return overhead_pct, seen["polls"], bottleneck
+
+
 def _run_window_close_p99(n_batches: int = 200, batch_size: int = 1000):
     """p99 window-close latency: wall time from the source emitting
     the batch whose events push the watermark past a window's close to
@@ -2342,6 +2424,19 @@ def main() -> None:
     # attributed time, so BENCH_* files track the measured bottleneck
     # round over round, not just the close latency.
     extra["epoch_phase_fractions"] = flight.ledger_fractions()
+
+    # Flow-map observability cost (docs/observability.md "Flow
+    # map"): the pipelined windowed bench with /graph polled
+    # continuously vs idle (< 3% asserted in-bench), plus the
+    # derived bottleneck attribution for the round.
+    try:
+        fm_pct, fm_polls, fm_bn = _run_flowmap_overhead()
+        extra["flowmap_overhead_pct"] = round(fm_pct, 2)
+        extra["flowmap_graph_polls"] = fm_polls
+        extra["bottleneck_step"] = fm_bn
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["flowmap_overhead_pct"] = None
+        extra["flowmap_overhead_error"] = str(ex)[:200]
 
     # Persistent-compile-cache cold vs warm start (fresh processes;
     # the warm figure is what a supervised restart or redeploy pays).

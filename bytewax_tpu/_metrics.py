@@ -42,6 +42,9 @@ __all__ = [
     "state_resident_keys",
     "state_spill_bytes",
     "step_demotion_count",
+    "step_device_bytes",
+    "step_rows_count",
+    "step_watermark_lag_seconds",
     "wire_bytes_count",
     "wire_codec_seconds",
     "worker_restart_count",
@@ -348,6 +351,40 @@ state_evictions_count = Counter(
 state_spill_bytes = Counter(
     "bytewax_state_spill_bytes",
     "Serialized bytes written to the disk spill store per step",
+    ["step_id"],
+)
+
+
+# -- flow-map families ---------------------------------------------------
+#
+# Fed by the live flow map (``engine/flowmap.py``, docs/observability.md
+# "Flow map"): per-step rows sealed once per epoch close (never a
+# per-batch labeled inc), watermark lag and device footprint sampled at
+# the close drain point.
+
+step_rows_count = Counter(
+    "bytewax_step_rows_count",
+    "Rows through each step per direction (direction=in is rows "
+    "delivered into the step, direction=out rows it emitted), "
+    "accumulated per batch on the main thread and sealed into the "
+    "family once per epoch close by the flow map",
+    ["step_id", "direction"],  # direction: in | out
+)
+
+step_watermark_lag_seconds = Gauge(
+    "bytewax_step_watermark_lag_seconds",
+    "Per-step watermark lag: how far the step's event-time watermark "
+    "trails wall clock (device window states sampled at the epoch-"
+    "close drain point; constant between events by construction)",
+    ["step_id"],
+)
+
+step_device_bytes = Gauge(
+    "bytewax_step_device_bytes",
+    "Device-resident state bytes per stateful step (slot-table "
+    "column buffers, sampled at the epoch-close drain point; see "
+    "bytewax_state_resident_keys for the key count under a "
+    "residency budget)",
     ["step_id"],
 )
 

@@ -34,6 +34,7 @@ from bytewax_tpu.engine import backoff as _backoff
 from bytewax_tpu.engine import batching as _batching
 from bytewax_tpu.engine import faults as _faults
 from bytewax_tpu.engine import flight as _flight
+from bytewax_tpu.engine import flowmap as _flowmap
 from bytewax_tpu.engine import wire as _wire
 from bytewax_tpu.engine.arrays import ArrayBatch, factorize_keys
 from bytewax_tpu.engine.dlq import DeadLetterQueue
@@ -411,6 +412,7 @@ def derive_rescale_hint(
     restores_per_close: float,
     spill_bytes_per_close: float = 0.0,
     phase_fractions: Optional[Dict[str, float]] = None,
+    bottleneck: Optional[Tuple[str, str]] = None,
 ) -> Tuple[str, List[str]]:
     """Pure rescale advice from the engine's load signals.
 
@@ -428,7 +430,26 @@ def derive_rescale_hint(
     available: device-or-flush-dominated epochs are their own grow
     reason, and barrier-dominated epochs veto grow (this process is
     waiting on its peers — more of it won't help) and count toward
-    shrink instead."""
+    shrink instead.
+
+    ``bottleneck`` is the flow map's step attribution
+    (:func:`bytewax_tpu.engine.flowmap.derive_bottleneck`), when one
+    was derived: a ``(step_id, why)`` pair appended verbatim as a
+    step-scoped reason, so the advice names WHERE the pressure is,
+    not just that there is some."""
+    def _scoped(
+        advice: str, reasons: List[str]
+    ) -> Tuple[str, List[str]]:
+        # The step attribution annotates WHATEVER the advice is — it
+        # names where the pressure sits but is never itself a grow
+        # trigger (a step dominating a quiet flow is normal).
+        if bottleneck is not None:
+            step_id, why = bottleneck
+            reasons = list(reasons) + [
+                f"bottleneck step {step_id!r}: {why}"
+            ]
+        return advice, reasons
+
     reasons: List[str] = []
     if (
         close_p99_s is not None
@@ -478,12 +499,17 @@ def derive_rescale_hint(
             # The attribution says this process spends its epochs
             # waiting for peers — its own loud signals are skew, not
             # saturation, and a grow would add more waiters.
-            return "hold", [
-                f"ledger: {barrier_frac:.0%} of attributed epoch "
-                "time is barrier wait — this process is ahead of "
-                "its peers; growing would add waiters, not throughput"
-            ] + reasons
-        return "grow", reasons
+            return _scoped(
+                "hold",
+                [
+                    f"ledger: {barrier_frac:.0%} of attributed epoch "
+                    "time is barrier wait — this process is ahead of "
+                    "its peers; growing would add waiters, not "
+                    "throughput"
+                ]
+                + reasons,
+            )
+        return _scoped("grow", reasons)
     if (
         worker_count > 1
         and epoch_interval_s > 0
@@ -494,18 +520,25 @@ def derive_rescale_hint(
         and restores_per_close < _HINT_QUIET_RESTORES
         and spill_bytes_per_close < _HINT_QUIET_SPILL_BYTES
     ):
-        return "shrink", [
-            f"epoch_close_p99 {close_p99_s:.3f}s is under "
-            f"{_HINT_QUIET_CLOSE_FRAC:.0%} of the epoch interval with "
-            "negligible pipeline stalls and residency pressure"
-        ]
+        return _scoped(
+            "shrink",
+            [
+                f"epoch_close_p99 {close_p99_s:.3f}s is under "
+                f"{_HINT_QUIET_CLOSE_FRAC:.0%} of the epoch interval "
+                "with negligible pipeline stalls and residency "
+                "pressure"
+            ],
+        )
     if barrier_bound and worker_count > 1:
-        return "shrink", [
-            f"ledger: {barrier_frac:.0%} of attributed epoch time "
-            "is barrier wait — the cluster is skewed or oversized "
-            "for the load; fewer processes may do"
-        ]
-    return "hold", reasons
+        return _scoped(
+            "shrink",
+            [
+                f"ledger: {barrier_frac:.0%} of attributed epoch time "
+                "is barrier wait — the cluster is skewed or oversized "
+                "for the load; fewer processes may do"
+            ],
+        )
+    return _scoped("hold", reasons)
 
 
 def _backoff_delay(
@@ -719,6 +752,9 @@ class _OpRt:
             c = item_inp_count.labels(self.op.step_id, str(w))
             self._m_inp[w] = c
         c.inc(n)
+        # Flow map: ledger-style dict add at a point the per-batch
+        # path already touches (main thread only; sealed per epoch).
+        _flowmap.FLOWMAP.add_rows(self.op.step_id, "in", n)
 
     def _count_out(self, w: int, n: int) -> None:
         c = self._m_out.get(w)
@@ -728,6 +764,7 @@ class _OpRt:
             c = item_out_count.labels(self.op.step_id, str(w))
             self._m_out[w] = c
         c.inc(n)
+        _flowmap.FLOWMAP.add_rows(self.op.step_id, "out", n)
 
     def queued(self) -> bool:
         return any(q for q in self.queues.values())
@@ -792,6 +829,7 @@ class _OpRt:
             return
         self._count_out(entry[0], len(entry[1]))
         stream = self.op.downs[port]
+        _flowmap.FLOWMAP.add_edge(stream.stream_id, len(entry[1]))
         self.driver.route(stream.stream_id, entry)
 
     # -- epoch snapshot hooks ---------------------------------------------
@@ -3027,6 +3065,13 @@ class _Driver:
             return
         self.sent[dest] += 1
         self.comm.send(dest, ("deliver", op_idx, port, entry))
+        rows, nbytes = _flowmap.payload_size(items)
+        _flowmap.FLOWMAP.add_wire(
+            dest,
+            f"{self.plan.ops[op_idx].step_id}.{port}",
+            rows,
+            nbytes,
+        )
 
     def ship_route(self, stream_id: str, entry: Entry) -> None:
         """Send an entry to its lane's owner, routed to the stream's
@@ -3051,6 +3096,8 @@ class _Driver:
         dest = self.owner_proc(w)
         self.sent[dest] += 1
         self.comm.send(dest, ("route", stream_id, entry))
+        rows, nbytes = _flowmap.payload_size(items)
+        _flowmap.FLOWMAP.add_wire(dest, stream_id, rows, nbytes)
 
     def ship_flush(self) -> None:
         """Put every accumulated frame — routed slices and keyed
@@ -3077,10 +3124,18 @@ class _Driver:
                 self.comm.send(dest, ("route", stream_id, (w, items)))
             else:
                 _kind, dest, op_idx, port, w = key
+                stream_id = f"{self.plan.ops[op_idx].step_id}.{port}"
                 self.sent[dest] += 1
                 self.comm.send(
                     dest, ("deliver", op_idx, port, (w, items))
                 )
+            # Flow map: per-peer traffic per stream, attributed at the
+            # drain point the frame actually leaves from (dict adds,
+            # sealed per epoch; sizes are the payload's own column
+            # buffers — the codec's exact wire split stays in
+            # bytewax_wire_bytes_count).
+            rows, nbytes = _flowmap.payload_size(items)
+            _flowmap.FLOWMAP.add_wire(dest, stream_id, rows, nbytes)
             acc.pop()
 
     def resume_state(self, step_id: str, state_key: str) -> Optional[Any]:
@@ -3149,6 +3204,11 @@ class _Driver:
         from bytewax_tpu._metrics import epoch_close_duration_seconds
 
         epoch_close_duration_seconds.observe(dt)
+        # Seal the flow map BEFORE the ledger seal: the Perfetto dump
+        # inside note_epoch_close reads the just-sealed record for its
+        # counter tracks, and next close's telemetry piggyback ships
+        # it cluster-wide (one epoch behind, exactly like the ledger).
+        self._flowmap_close(closing)
         _flight.RECORDER.note_epoch_close(closing, dt)
         # Rescale-hint history: one advice sample per wall-clock
         # second at most (interval-0 flows close per loop iteration;
@@ -3158,9 +3218,15 @@ class _Driver:
         now_hint = time.monotonic()
         if now_hint - self._last_hint_at >= 1.0:
             self._last_hint_at = now_hint
-            advice, _reasons, _signals = self._hint_advice()
+            advice, _reasons, signals = self._hint_advice()
+            bn = signals.get("bottleneck")
             self._hint_log.append(
-                {"epoch": closing, "advice": advice, "t": time.time()}
+                {
+                    "epoch": closing,
+                    "advice": advice,
+                    "bottleneck": bn["step"] if bn else None,
+                    "t": time.time(),
+                }
             )
         if self._gc_managed:
             # Deterministic collection points: the cycle collector is
@@ -3179,6 +3245,44 @@ class _Driver:
             if now_m - self._last_gc >= 1.0:
                 gc.collect()
                 self._last_gc = _time.monotonic()
+
+    def _flowmap_close(self, closing: int) -> None:
+        """Sample the close-time flow-map gauges (device-resident
+        footprint, per-step watermark lag) and seal this epoch's flow
+        record (docs/observability.md "Flow map").  Runs at the
+        epoch-close drain point on the main thread — pipelines are
+        quiesced, so the slot tables and watermark arrays are safe to
+        read."""
+        fm = _flowmap.FLOWMAP
+        for rt in self.rts:
+            states = [
+                s
+                for s in (
+                    getattr(rt, "agg", None),
+                    getattr(rt, "wagg", None),
+                    getattr(rt, "sagg", None),
+                )
+                if s is not None
+            ]
+            if not states:
+                continue
+            keys = 0
+            nbytes = 0
+            for st in states:
+                k, b = _flowmap.device_footprint(st)
+                keys = max(keys, k)
+                nbytes += b
+            if keys or nbytes:
+                fm.set_device(rt.op.step_id, keys, nbytes)
+            wagg = getattr(rt, "wagg", None)
+            if wagg is not None:
+                lag = _flowmap.watermark_lag_s(wagg)
+                if lag is not None:
+                    fm.set_lag(rt.op.step_id, lag)
+        fm.seal(
+            closing,
+            queue_depth=dict(_flight.RECORDER._flush_depth),
+        )
 
     def _close_epoch_inner(self, workers: Optional[range] = None) -> None:
         # The route accumulator flushes before anything else this
@@ -3719,6 +3823,7 @@ class _Driver:
         # Attribution-backed advice: the epoch ledger's measured
         # phase split, not just the loose rate signals.
         phase_fractions = _flight.ledger_fractions()
+        bottleneck = self._derive_bottleneck()
         advice, reasons = derive_rescale_hint(
             worker_count=self.worker_count,
             epoch_interval_s=interval_s,
@@ -3727,6 +3832,7 @@ class _Driver:
             restores_per_close=restores_per_close,
             spill_bytes_per_close=spill_bytes_per_close,
             phase_fractions=phase_fractions,
+            bottleneck=bottleneck,
         )
         signals = {
             "worker_count": self.worker_count,
@@ -3737,8 +3843,61 @@ class _Driver:
             "spill_bytes_per_close": round(spill_bytes_per_close, 1),
             "epoch_closes": int(counters.get("epoch_close_count", 0)),
             "phase_fractions": phase_fractions,
+            "bottleneck": (
+                {"step": bottleneck[0], "why": bottleneck[1]}
+                if bottleneck is not None
+                else None
+            ),
         }
         return advice, reasons, signals
+
+    def _step_edge_pairs(self) -> List[Tuple[str, str]]:
+        """(src_step, dst_step) pairs of the lowered topology, cached
+        — the plan never changes within a generation."""
+        pairs = self.__dict__.get("_step_edge_cache")
+        if pairs is None:
+            topo = _flowmap.topology(self.plan)
+            pairs = [
+                (e["src"], e["dst"])
+                for e in topo["edges"]
+                if e["src"] is not None
+            ]
+            self.__dict__["_step_edge_cache"] = pairs
+        return pairs
+
+    def _derive_bottleneck(self) -> Optional[Tuple[str, str]]:
+        """Step-scoped bottleneck attribution: the pure
+        :func:`bytewax_tpu.engine.flowmap.derive_bottleneck` over the
+        latest sealed epoch ledger (per-step busy seconds, drain-point
+        queue depths) and flow-map record (watermark lag), restricted
+        to THIS plan's step ids (the process-global recorders may
+        still carry a previous execution's steps).  Read racily off
+        whichever thread asks — observability, like every hint
+        signal."""
+        ledger = _flight.RECORDER.last_ledger or {}
+        fm = _flowmap.FLOWMAP.last or {}
+        ids = {op.step_id for op in self.plan.ops}
+        steps: Dict[str, Dict[str, Any]] = {}
+        for phase_steps in ledger.get("phases", {}).values():
+            for step, s in phase_steps.items():
+                if step in ids:
+                    ent = steps.setdefault(step, {})
+                    ent["busy_s"] = ent.get("busy_s", 0.0) + s
+        for step, depth in ledger.get(
+            "queue_depth_at_drain", {}
+        ).items():
+            if step in ids:
+                steps.setdefault(step, {})["queue_depth"] = depth
+        for step, sig in fm.get("steps", {}).items():
+            if step in ids and "watermark_lag_s" in sig:
+                steps.setdefault(step, {})["lag_s"] = sig[
+                    "watermark_lag_s"
+                ]
+        if not steps:
+            return None
+        return _flowmap.derive_bottleneck(
+            steps, self._step_edge_pairs()
+        )
 
     def _rescale_hint(self) -> Dict[str, Any]:
         """The ``/status`` rescale recommendation (docs/recovery.md):
@@ -3803,6 +3962,20 @@ class _Driver:
                     if self._ship_acc is not None
                     else 0
                 ),
+                # Per-kind pending breakdown: the generalized
+                # accumulator coalesces ship_deliver (peer, op, port,
+                # lane) buckets alongside the route buckets — both
+                # must be visible, not just the PR-12 route count.
+                "pending": (
+                    self._ship_acc.pending_status()
+                    if self._ship_acc is not None
+                    else None
+                ),
+                "session": (
+                    self.comm._wire_session.status()
+                    if self.comm is not None
+                    else None
+                ),
                 **_flight.wire_status(),
             },
             "epoch": self.epoch,
@@ -3831,6 +4004,70 @@ class _Driver:
                 str(pid): summary
                 for pid, summary in _flight.RECORDER.cluster.items()
             },
+        }
+
+    def _graph(self) -> Dict[str, Any]:
+        """Live ``GET /graph`` document (docs/observability.md "Flow
+        map"): the lowered topology — steps with their live tier,
+        edges with their ports — annotated with the latest sealed
+        flow-map record per process.  This process's record is read
+        directly; every peer's arrives on the EXISTING epoch-close
+        gsync telemetry piggyback (one epoch behind, like the
+        ledger), so any process serves the whole cluster with zero
+        new frame kinds.  Read racily off the API-server thread —
+        observability, not the epoch protocol."""
+        topo = _flowmap.topology(self.plan)
+        # Live tier overlay: the static plan cannot see the
+        # collective global-exchange state or runtime demotions.
+        tiers: Dict[str, str] = {}
+        for rt in self.rts:
+            if getattr(rt, "demoted", None):
+                tiers[rt.op.step_id] = "host"
+            elif getattr(
+                getattr(rt, "agg", None), "global_exchange", False
+            ):
+                tiers[rt.op.step_id] = "collective"
+        for node in topo["steps"]:
+            node["tier"] = tiers.get(node["step_id"], node["tier"])
+        sources: Dict[str, Any] = {}
+        local = _flowmap.FLOWMAP.summary()
+        if local is not None:
+            sources[str(self.proc_id)] = local
+        for pid, summary in _flight.RECORDER.cluster.items():
+            if not isinstance(summary, dict):
+                continue
+            fmr = summary.get("flowmap")
+            if fmr:
+                sources.setdefault(str(pid), fmr)
+        for node in topo["steps"]:
+            node["telemetry"] = {
+                pid: fmr["steps"][node["step_id"]]
+                for pid, fmr in sources.items()
+                if node["step_id"] in fmr.get("steps", {})
+            }
+        for edge in topo["edges"]:
+            edge["telemetry"] = {
+                pid: fmr["edges"][edge["stream_id"]]
+                for pid, fmr in sources.items()
+                if edge["stream_id"] in fmr.get("edges", {})
+            }
+        bottleneck = self._derive_bottleneck()
+        return {
+            "flow_id": self.plan.flow.flow_id,
+            "proc_id": self.proc_id,
+            "proc_count": self.proc_count,
+            "epoch": self.epoch,
+            "steps": topo["steps"],
+            "edges": topo["edges"],
+            "wire": {
+                pid: fmr.get("wire", {})
+                for pid, fmr in sources.items()
+            },
+            "bottleneck": (
+                {"step": bottleneck[0], "why": bottleneck[1]}
+                if bottleneck is not None
+                else None
+            ),
         }
 
     def _health(self) -> Dict[str, Any]:
@@ -3904,6 +4141,7 @@ class _Driver:
             reconfigure_fn=lambda addrs, wpp: request_reconfigure(
                 addrs, wpp, source="http"
             ),
+            graph_fn=self._graph,
         )
         try:
             if clustered:

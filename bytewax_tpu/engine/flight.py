@@ -343,6 +343,13 @@ class FlightRecorder:
                 "args": {"name": "device pipeline"},
             },
             {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 3,
+                "args": {"name": "collective lane"},
+            },
+            {
                 "name": f"epoch {epoch}",
                 "cat": "epoch",
                 "ph": "X",
@@ -361,10 +368,17 @@ class FlightRecorder:
                     "ts": t0 * 1e6,
                     "dur": gross * 1e6,
                     "pid": pid,
-                    "tid": 1 + lane,
+                    # The overlapped collectives' ordered lane gets
+                    # its own track: its spans overlap the NEXT
+                    # epoch's device work, so sharing the device
+                    # pipeline tid would render as nonsense nesting.
+                    "tid": (
+                        3 if phase == "collective_lane" else 1 + lane
+                    ),
                     "args": {"step_id": step},
                 }
             )
+        events.extend(self._counter_events(pid, epoch_t0, now))
         doc = {"traceEvents": events, "displayTimeUnit": "ms"}
         try:
             os.makedirs(self.trace_dir, exist_ok=True)
@@ -373,13 +387,64 @@ class FlightRecorder:
                 f"epoch-p{self.proc_id:02d}-{epoch:08d}.json",
             )
             with open(path, "w") as f:
-                json.dump(doc, f)
+                # Armed-only path, bounded spans: the JSON-safety
+                # sweep keeps a numpy scalar in a span arg from
+                # producing an unreadable trace file.
+                json.dump(_json_safe(doc), f)
         except OSError:
             import logging
 
             logging.getLogger(__name__).debug(
                 "could not write Perfetto trace for epoch %d", epoch
             )
+
+    def _counter_events(
+        self, pid: int, epoch_t0: float, now: float
+    ) -> List[Dict[str, Any]]:
+        """Perfetto counter tracks (``ph:"C"``) from the flow map's
+        just-sealed epoch record: per-step rows/s, queue depth at
+        drain, and watermark lag on the same timeline as the phase
+        spans.  Two monotone samples per track (epoch open and close)
+        so each epoch renders as a level, not a dot."""
+        from bytewax_tpu.engine.flowmap import FLOWMAP
+
+        record = FLOWMAP.last
+        if not record:
+            return []
+        events: List[Dict[str, Any]] = []
+
+        def track(name: str, values: Dict[str, Any]) -> None:
+            values = _json_safe(values)
+            for ts in (epoch_t0 * 1e6, now * 1e6):
+                events.append(
+                    {
+                        "name": name,
+                        "ph": "C",
+                        "ts": ts,
+                        "pid": pid,
+                        "args": values,
+                    }
+                )
+
+        for step, sig in record.get("steps", {}).items():
+            rates = {
+                d: sig[f"rate_{d}_per_s"]
+                for d in ("in", "out")
+                if f"rate_{d}_per_s" in sig
+            }
+            if rates:
+                track(f"rows/s {step}", rates)
+            if "queue_depth_at_drain" in sig:
+                track(
+                    f"queue {step}",
+                    {"depth": sig["queue_depth_at_drain"]},
+                )
+            if "watermark_lag_s" in sig:
+                track(
+                    f"lag {step}",
+                    {"seconds": sig["watermark_lag_s"]},
+                )
+        return events
 
     def note_epoch_close(self, epoch: int, seconds: float) -> None:
         self.count("epoch_close_count")
@@ -480,10 +545,54 @@ class FlightRecorder:
             }
         if self.last_ledger is not None:
             out["ledger"] = self.last_ledger
+        from bytewax_tpu.engine.flowmap import FLOWMAP
+
+        fm = FLOWMAP.summary()
+        if fm is not None:
+            out["flowmap"] = fm
         return out
 
 
 RECORDER = FlightRecorder()
+
+
+def _json_safe(obj: Any) -> Any:
+    """Recursively convert a telemetry document to plain JSON-able
+    types: numpy scalars to Python scalars, arrays to lists,
+    datetime64/datetime to ISO strings, non-finite floats to None,
+    non-string dict keys to strings.  Shared by the webserver
+    payloads, crash postmortems, and the Perfetto writer so every
+    observability surface is JSON-safe by construction — a numpy
+    scalar deep in a status section must never 500 ``/status``."""
+    import datetime as _dt
+    import math
+
+    import numpy as np
+
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, (np.datetime64, np.timedelta64)):
+        return str(obj)
+    if isinstance(obj, np.generic):
+        return _json_safe(obj.item())
+    if isinstance(obj, np.ndarray):
+        return [_json_safe(x) for x in obj.tolist()]
+    if isinstance(obj, (_dt.datetime, _dt.date, _dt.time)):
+        return obj.isoformat()
+    if isinstance(obj, _dt.timedelta):
+        return obj.total_seconds()
+    if isinstance(obj, dict):
+        return {
+            (k if isinstance(k, str) else str(k)): _json_safe(v)
+            for k, v in obj.items()
+        }
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [_json_safe(x) for x in obj]
+    if isinstance(obj, bytes):
+        return obj.decode("utf-8", "replace")
+    return str(obj)
 
 # Cached Prometheus label children (one labels() resolution per
 # distinct label set, not per event).
@@ -1069,7 +1178,9 @@ def write_postmortem(
             pm_dir, f"postmortem-{proc_id}-{generation}.json"
         )
         with open(path, "w") as f:
-            json.dump(doc, f, default=str)
+            # default=str stays as the backstop for exotic leaf types
+            # _json_safe has no rule for.
+            json.dump(_json_safe(doc), f, default=str)
     except OSError as ex:
         import logging
 

@@ -13,6 +13,11 @@ Reference parity (``/root/reference/src/webserver/mod.rs``): when
   tail, and — in clustered runs — the per-process summaries collected
   by the epoch-close gsync piggyback, so any process's ``/status``
   shows the whole cluster),
+- ``GET /graph`` — the lowered dataflow topology (steps, edges, the
+  host/device/collective tier per step) annotated with the flow-map's
+  live per-step/per-edge telemetry (docs/observability.md "Flow
+  map"); in clustered runs every process's rates/lags merge in via
+  the same epoch-close gsync piggyback as ``/status``,
 - ``GET /healthz`` — liveness (the server answering at all) +
   readiness (HTTP 200 once run startup — mesh handshake, the "fcfg"
   agreement round, any rescale migration, runtime builds — finished;
@@ -81,6 +86,7 @@ def thread_stacks() -> str:
 class _Handler(BaseHTTPRequestHandler):
     flow_json: str = "{}"
     status_fn: Optional[Callable[[], dict]] = None
+    graph_fn: Optional[Callable[[], dict]] = None
     health_fn: Optional[Callable[[], dict]] = None
     stop_fn: Optional[Callable[[], None]] = None
     reconfigure_fn: Optional[Callable[[list, Optional[int]], None]] = None
@@ -150,12 +156,27 @@ class _Handler(BaseHTTPRequestHandler):
             body = generate_python_metrics().encode()
             ctype = "text/plain; version=0.0.4"
         elif self.path == "/status":
+            from bytewax_tpu.engine.flight import _json_safe
+
             fn = type(self).status_fn
             try:
                 status = fn() if fn is not None else {}
             except Exception as ex:  # noqa: BLE001 - never 500 the plane
                 status = {"error": str(ex)}
-            body = json.dumps(status).encode()
+            # JSON-safe by construction: engine snapshots carry numpy
+            # scalars/arrays and datetime64 values straight out of the
+            # runtimes.
+            body = json.dumps(_json_safe(status)).encode()
+            ctype = "application/json"
+        elif self.path == "/graph":
+            from bytewax_tpu.engine.flight import _json_safe
+
+            fn = type(self).graph_fn
+            try:
+                graph = fn() if fn is not None else {}
+            except Exception as ex:  # noqa: BLE001 - never 500 the plane
+                graph = {"error": str(ex)}
+            body = json.dumps(_json_safe(graph)).encode()
             ctype = "application/json"
         elif self.path == "/healthz":
             fn = type(self).health_fn
@@ -210,6 +231,7 @@ def maybe_start_server(
     reconfigure_fn: Optional[
         Callable[[list, Optional[int]], None]
     ] = None,
+    graph_fn: Optional[Callable[[], dict]] = None,
 ) -> Optional[_ApiServer]:
     """Start the API server if ``BYTEWAX_DATAFLOW_API_ENABLED`` is
     set (to anything but ``0``); returns a handle to shut it down,
@@ -222,8 +244,10 @@ def maybe_start_server(
     ``POST /stop`` (a cooperative drain-to-stop request — 404 when
     absent); ``reconfigure_fn`` arms ``POST /reconfigure`` (a live
     membership-change request, docs/recovery.md "Live partial
-    rescale" — same loopback guard as ``/stop``); ``port_offset`` is
-    this process's rank among co-located cluster processes."""
+    rescale" — same loopback guard as ``/stop``); ``graph_fn``
+    returns the annotated topology for ``GET /graph`` (empty document
+    when absent); ``port_offset`` is this process's rank among
+    co-located cluster processes."""
     from bytewax_tpu.engine.flight import _truthy
 
     if not _truthy("BYTEWAX_DATAFLOW_API_ENABLED"):
@@ -283,6 +307,7 @@ def maybe_start_server(
         {
             "flow_json": flow_json,
             "status_fn": staticmethod(status_fn),
+            "graph_fn": staticmethod(graph_fn),
             "health_fn": staticmethod(health_fn),
             "stop_fn": staticmethod(stop_fn),
             "reconfigure_fn": staticmethod(reconfigure_fn),

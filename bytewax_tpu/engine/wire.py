@@ -211,6 +211,16 @@ class WireSession:
         self._gen += 1
         return self._gen
 
+    def status(self) -> Dict[str, int]:
+        """Vocab-session view for ``/status``: the latest generation
+        tag issued and how many (peer, stream) vocab cache entries
+        are armed on each side.  Racy read — observability only."""
+        return {
+            "generation": self._gen,
+            "tx_streams": len(self.tx),
+            "rx_streams": len(self.rx),
+        }
+
 
 # -- encode -----------------------------------------------------------------
 
@@ -902,6 +912,25 @@ class RouteAccumulator:
         is GIL-atomic, so a concurrent add/pop can't break the
         iteration)."""
         return sum(len(runs) for runs in list(self._runs.values()))
+
+    def pending_status(self) -> Dict[str, Dict[str, int]]:
+        """Per-kind pending breakdown for ``/status``: bucket and
+        frame counts split by the accumulator's two bucket kinds —
+        the PR-12 ``route`` (peer, stream, lane) buckets AND the
+        generalized ``deliver`` (peer, op, port, lane) buckets.  Read
+        racily off the API thread like :meth:`pending_frames` (the
+        ``list()`` copy is GIL-atomic)."""
+        out = {
+            "route": {"buckets": 0, "frames": 0},
+            "deliver": {"buckets": 0, "frames": 0},
+        }
+        for key, runs in list(self._runs.items()):
+            cell = out.get(key[0])
+            if cell is None:  # pragma: no cover - future kinds
+                cell = out[key[0]] = {"buckets": 0, "frames": 0}
+            cell["buckets"] += 1
+            cell["frames"] += len(runs)
+        return out
 
     def peek(self) -> Optional[Tuple[Tuple, Any]]:
         """The oldest pending frame as ``(bucket key, items)`` with

@@ -1,0 +1,560 @@
+"""Flow-map tests (tentpole of the flow-map observability PR): the
+per-step / per-edge live telemetry accumulator, the annotated
+``GET /graph`` topology, the pure bottleneck attribution, and its
+step-scoped feed into the rescale hint.
+
+The flow map is always-on observability data on a global accumulator
+(like the epoch ledger), so tests that assert per-run records reset
+the module singleton first — never the engine's own state.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+from datetime import timedelta
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.engine import flowmap
+from bytewax_tpu.engine.flowmap import (
+    FlowMap,
+    derive_bottleneck,
+    device_footprint,
+    payload_size,
+    topology,
+)
+from bytewax_tpu.testing import TestingSink, TestingSource
+
+ZERO_TD = timedelta(seconds=0)
+
+
+def _reset_flowmap():
+    fm = flowmap.FLOWMAP
+    fm._rows.clear()
+    fm._batches.clear()
+    fm._edges.clear()
+    fm._wire.clear()
+    fm._device.clear()
+    fm._lag.clear()
+    fm.last = None
+    fm._sealed.clear()
+    fm._epoch_t0 = time.monotonic()
+
+
+# -- derive_bottleneck: pure attribution -------------------------------
+
+
+def test_bottleneck_queue_pressure_names_slowest_upstream():
+    # Pressure at the sink's queue, but the slow sustained consumer
+    # is the mapper feeding it: the walk goes transitively upstream
+    # and names the busiest step on the path.
+    steps = {
+        "df.inp": {"busy_s": 0.2},
+        "df.work": {"busy_s": 3.0},
+        "df.out": {"busy_s": 0.1, "queue_depth": 5},
+    }
+    edges = [("df.inp", "df.work"), ("df.work", "df.out")]
+    got = derive_bottleneck(steps, edges)
+    assert got is not None
+    step, why = got
+    assert step == "df.work"
+    assert "queue depth 5 at df.out" in why
+    assert "slowest upstream df.work" in why
+
+
+def test_bottleneck_lag_pressure_wins_over_smaller_queue():
+    # The LARGEST pressure signal anchors the walk: a 30s watermark
+    # lag outranks a depth-2 queue elsewhere.
+    steps = {
+        "df.a": {"busy_s": 1.0, "queue_depth": 2},
+        "df.b": {"busy_s": 0.5, "lag_s": 30.0},
+    }
+    got = derive_bottleneck(steps, edges=[])
+    assert got is not None
+    step, why = got
+    assert step == "df.b"
+    assert "lag 30.0s at df.b" in why
+
+
+def test_bottleneck_pressure_with_no_busy_upstream_names_site():
+    # No attributed busy time anywhere on the pressured path: the
+    # pressure site itself is the answer (never a zero-busy winner).
+    steps = {"df.x": {"queue_depth": 4}, "df.up": {}}
+    got = derive_bottleneck(steps, edges=[("df.up", "df.x")])
+    assert got is not None and got[0] == "df.x"
+
+
+def test_bottleneck_dominant_share_without_pressure():
+    steps = {
+        "df.inp": {"busy_s": 0.1},
+        "df.slow": {"busy_s": 2.0},
+        "df.out": {"busy_s": 0.1},
+    }
+    got = derive_bottleneck(steps)
+    assert got is not None
+    step, why = got
+    assert step == "df.slow"
+    assert "of attributed busy time" in why
+
+
+def test_bottleneck_none_when_nothing_qualifies():
+    # Balanced load, no pressure: naming a "bottleneck" would be
+    # noise — the attribution must decline.
+    assert derive_bottleneck({}) is None
+    assert (
+        derive_bottleneck(
+            {"df.a": {"busy_s": 1.0}, "df.b": {"busy_s": 1.0}}
+        )
+        is None
+    )
+    assert derive_bottleneck({"df.a": {}, "df.b": {}}) is None
+
+
+def test_bottleneck_deterministic_tie_break():
+    # Equal-pressure ties resolve on step id, so repeated polls never
+    # flap between two names.
+    steps = {
+        "df.b": {"queue_depth": 3, "busy_s": 1.0},
+        "df.a": {"queue_depth": 3, "busy_s": 1.0},
+    }
+    got1 = derive_bottleneck(steps)
+    got2 = derive_bottleneck(dict(reversed(list(steps.items()))))
+    assert got1 == got2
+
+
+# -- the FlowMap accumulator -------------------------------------------
+
+
+def test_flowmap_seal_record_shape_and_reset():
+    fm = FlowMap()
+    fm.add_rows("df.inp", "out", 100)
+    fm.add_rows("df.work", "in", 100)
+    fm.add_rows("df.work", "in", 60)
+    fm.add_rows("df.work", "out", 160)
+    fm.add_edge("df.inp.down", 100)
+    fm.add_wire(1, "df.work.up", 50, 4096)
+    fm.set_device("df.win", 7, 1 << 20)
+    fm.set_lag("df.win", 2.5)
+    rec = fm.seal(3, queue_depth={"df.win": 2})
+
+    assert rec["epoch"] == 3 and rec["wall_s"] > 0
+    work = rec["steps"]["df.work"]
+    assert work["rows_in"] == 160 and work["batches_in"] == 2
+    assert work["batch_rows_in"] == 80.0
+    assert work["rows_out"] == 160
+    assert work["rate_in_per_s"] > 0
+    win = rec["steps"]["df.win"]
+    assert win["device_keys"] == 7
+    assert win["device_bytes"] == 1 << 20
+    assert win["watermark_lag_s"] == 2.5
+    assert win["queue_depth_at_drain"] == 2
+    assert rec["edges"]["df.inp.down"]["rows"] == 100
+    assert rec["wire"]["1"]["df.work.up"] == {
+        "frames": 1,
+        "rows": 50,
+        "bytes": 4096,
+    }
+    # Sealed record is the published summary; accumulators reset.
+    assert fm.summary() is rec
+    assert fm.recent() == [rec]
+    empty = fm.seal(4)
+    assert empty["steps"] == {} and empty["edges"] == {}
+
+
+def test_flowmap_prometheus_mirror():
+    from prometheus_client import REGISTRY
+
+    fm = FlowMap()
+    fm.add_rows("pm_df.step", "in", 40)
+    fm.set_lag("pm_df.step", 1.25)
+    fm.set_device("pm_df.step", 3, 2048)
+    fm.seal(1)
+    assert (
+        REGISTRY.get_sample_value(
+            "bytewax_step_rows_count_total",
+            {"step_id": "pm_df.step", "direction": "in"},
+        )
+        >= 40
+    )
+    assert (
+        REGISTRY.get_sample_value(
+            "bytewax_step_watermark_lag_seconds",
+            {"step_id": "pm_df.step"},
+        )
+        == 1.25
+    )
+    assert (
+        REGISTRY.get_sample_value(
+            "bytewax_step_device_bytes", {"step_id": "pm_df.step"}
+        )
+        == 2048
+    )
+
+
+def test_payload_size_and_device_footprint_units():
+    import numpy as np
+
+    from bytewax_tpu.engine.arrays import ArrayBatch
+
+    batch = ArrayBatch(
+        {
+            "key_id": np.zeros(10, dtype=np.int32),
+            "v": np.ones(10, dtype=np.float64),
+        },
+        key_vocab=np.array(["k"]),
+    )
+    rows, nbytes = payload_size(batch)
+    assert rows == 10
+    assert nbytes == 10 * 4 + 10 * 8
+    # Itemized payloads report rows only.
+    assert payload_size([("k", 1), ("k", 2)]) == (2, 0)
+
+    class _Slots:
+        key_to_slot = {"a": 0, "b": 1}
+        _fields = {"acc": np.zeros((4, 2), dtype=np.float32)}
+
+    keys, nbytes = device_footprint(_Slots())
+    assert keys == 2 and nbytes == 32
+    # A wrapper delegating to the same tables never double-counts.
+    inner = _Slots()
+
+    class _Wrap:
+        def __init__(self):
+            self._inner = inner
+            self.key_to_slot = inner.key_to_slot
+            self._fields = inner._fields
+
+    assert device_footprint(_Wrap()) == (2, 32)
+
+
+# -- topology over the lowered plan ------------------------------------
+
+
+def test_topology_steps_edges_and_tiers(monkeypatch):
+    monkeypatch.setenv("BYTEWAX_TPU_ACCEL", "1")
+    from bytewax_tpu.engine.flatten import flatten
+
+    from bytewax_tpu import xla
+
+    flow = Dataflow("topo_df")
+    s = op.input("inp", flow, TestingSource([("k", 1.0)]))
+    st = xla.stats_final("sum", s)
+    fmt = op.map_value("fmt", st, str)
+    op.output("out", fmt, TestingSink([]))
+    topo = topology(flatten(flow))
+
+    by_id = {n["step_id"]: n for n in topo["steps"]}
+    # One node per lowered core op, with its static tier.
+    assert any("inp" in sid for sid in by_id)
+    accel_tiers = {
+        n["step_id"]: n["tier"]
+        for n in topo["steps"]
+        if n["tier"] == "device"
+    }
+    assert accel_tiers, by_id  # the annotated aggregation is device
+    # Every edge names a consumer that exists; sources resolve.
+    for e in topo["edges"]:
+        assert e["dst"] in by_id
+        assert e["src"] is None or e["src"] in by_id
+        assert isinstance(e["port"], str)
+    # The lowered graph is connected input->output.
+    dsts = {e["dst"] for e in topo["edges"]}
+    assert any("out" in d for d in dsts)
+
+
+# -- GET /graph (in-process) -------------------------------------------
+
+
+def test_graph_endpoint(entry_point, monkeypatch, tmp_path):
+    # GET /graph returns the annotated topology under all 3 entry
+    # points: steps with tiers, edges with ports, per-process
+    # telemetry from the sealed flow-map records.
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_ENABLED", "1")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_PORT", "13054")
+    monkeypatch.chdir(tmp_path)
+    _reset_flowmap()
+
+    captured = {}
+
+    class _ProbePartition:
+        def __init__(self):
+            self._seen = 0
+
+        def write_batch(self, items):
+            self._seen += 1
+            # Poll late enough that at least one epoch has sealed a
+            # flow-map record (the summary rides one close behind).
+            if self._seen >= 3 and "graph" not in captured:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:13054/graph", timeout=5
+                ) as resp:
+                    captured["graph"] = json.loads(resp.read())
+
+        def close(self):
+            pass
+
+    from bytewax_tpu.outputs import DynamicSink
+
+    class _ProbeSink(DynamicSink):
+        def build(self, step_id, worker_index, worker_count):
+            return _ProbePartition()
+
+    flow = Dataflow("graph_df")
+    s = op.input(
+        "inp", flow, TestingSource(list(range(40)), batch_size=4)
+    )
+    s = op.map("double", s, lambda x: x * 2)
+    op.output("out", s, _ProbeSink())
+    entry_point(flow, epoch_interval=ZERO_TD)
+
+    graph = captured["graph"]
+    assert graph["flow_id"] == "graph_df"
+    assert graph["proc_id"] == 0 and graph["proc_count"] == 1
+    by_id = {n["step_id"]: n for n in graph["steps"]}
+    mapper = next(sid for sid in by_id if ".double." in sid)
+    assert by_id[mapper]["tier"] == "host"
+    # The mapper's sealed telemetry shows rows flowing through it.
+    tele = by_id[mapper]["telemetry"]
+    assert "0" in tele, graph
+    assert tele["0"]["rows_in"] > 0 and tele["0"]["rows_out"] > 0
+    assert tele["0"]["rate_in_per_s"] > 0
+    # Edges carry per-process routed-row telemetry too.
+    assert any(
+        e["telemetry"].get("0", {}).get("rows", 0) > 0
+        for e in graph["edges"]
+    ), graph["edges"]
+    # And the document is valid JSON end to end (it arrived as such).
+    assert isinstance(graph["wire"], dict)
+    assert "bottleneck" in graph
+
+
+# -- the acceptance check: a throttled step is named -------------------
+
+
+def test_throttled_step_named_bottleneck(entry_point, monkeypatch, tmp_path):
+    # Throttle ONE host-tier mapper: derive_bottleneck must name
+    # exactly that step, /graph carries it, and /status's
+    # rescale_hint reasons carry the step-scoped attribution — under
+    # all 3 entry points.
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_ENABLED", "1")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_PORT", "13055")
+    monkeypatch.chdir(tmp_path)
+    _reset_flowmap()
+    from bytewax_tpu.engine import flight
+
+    flight.RECORDER.last_ledger = None
+
+    captured = {}
+
+    class _ProbePartition:
+        def __init__(self):
+            self._seen = 0
+
+        def write_batch(self, items):
+            self._seen += 1
+            if self._seen >= 4 and "status" not in captured:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:13055/graph", timeout=5
+                ) as resp:
+                    graph = json.loads(resp.read())
+                if graph.get("bottleneck") is None:
+                    return  # not sealed yet; retry next batch
+                captured["graph"] = graph
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:13055/status", timeout=5
+                ) as resp:
+                    captured["status"] = json.loads(resp.read())
+
+        def close(self):
+            pass
+
+    from bytewax_tpu.outputs import DynamicSink
+
+    class _ProbeSink(DynamicSink):
+        def build(self, step_id, worker_index, worker_count):
+            return _ProbePartition()
+
+    flow = Dataflow("bn_df")
+    s = op.input(
+        "inp", flow, TestingSource(list(range(40)), batch_size=4)
+    )
+    s = op.map("fast", s, lambda x: x)
+    s = op.map("slow", s, lambda x: (time.sleep(0.004), x)[1])
+    op.output("out", s, _ProbeSink())
+    entry_point(flow, epoch_interval=ZERO_TD)
+
+    assert "status" in captured, "bottleneck never derived in-run"
+    bn = captured["graph"]["bottleneck"]
+    assert ".slow." in bn["step"], bn
+    assert ".fast." not in bn["step"]
+    assert "busy time" in bn["why"] or "at " in bn["why"]
+    # The rescale hint carries the SAME attribution as a step-scoped
+    # reason (an attribution, never itself a grow trigger).
+    hint = captured["status"]["rescale_hint"]
+    assert any(
+        "bottleneck step" in r and ".slow." in r
+        for r in hint["reasons"]
+    ), hint["reasons"]
+    assert hint["signals"]["bottleneck"]["step"] == bn["step"]
+
+
+# -- the acceptance check: 2-process cluster /graph merge --------------
+
+
+def test_graph_cluster_merges_both_processes(tmp_path):
+    # In a real 2-process cluster, any process's /graph returns ONE
+    # topology with BOTH processes' per-step rates merged in via the
+    # existing epoch-close gsync telemetry summary — no new frame
+    # kinds (the analyzer inventory tests pin that side).
+    flow_py = tmp_path / "graph_flow.py"
+    flow_py.write_text(
+        """
+import time
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+from bytewax_tpu.outputs import DynamicSink, StatelessSinkPartition
+
+
+class _Tick(StatelessSourcePartition):
+    def __init__(self, worker_index):
+        self._i = 0
+        self._w = worker_index
+
+    def next_batch(self):
+        if self._i >= 40:
+            raise StopIteration()
+        self._i += 1
+        time.sleep(0.1)
+        return [(f"k{self._w}", 1), (f"k{self._i % 3}", 1)]
+
+
+class TickSource(DynamicSource):
+    def build(self, step_id, worker_index, worker_count):
+        return _Tick(worker_index)
+
+
+class _Null(StatelessSinkPartition):
+    def write_batch(self, items):
+        pass
+
+
+class NullSink(DynamicSink):
+    def build(self, step_id, worker_index, worker_count):
+        return _Null()
+
+
+flow = Dataflow("graph_cluster_df")
+s = op.input("inp", flow, TickSource())
+s = op.stateful_map("sum", s, lambda st, v: ((st or 0) + v, (st or 0) + v))
+op.output("out", s, NullSink())
+"""
+    )
+    import socket
+
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    env["BYTEWAX_TPU_PLATFORM"] = "cpu"
+    env["BYTEWAX_TPU_ACCEL"] = "0"
+    env["BYTEWAX_DATAFLOW_API_ENABLED"] = "1"
+    env["BYTEWAX_DATAFLOW_API_PORT"] = "13056"
+    env["BYTEWAX_ADDRESSES"] = ";".join(
+        f"127.0.0.1:{p}" for p in ports
+    )
+    env["BYTEWAX_TPU_DIAL_TIMEOUT_S"] = "120"
+    procs = []
+    for proc_id in range(2):
+        penv = dict(env)
+        penv["BYTEWAX_PROCESS_ID"] = str(proc_id)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "bytewax_tpu.run",
+                    f"{flow_py}:flow",
+                    "-s",
+                    "0.3",
+                ],
+                env=penv,
+                cwd=tmp_path,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    graph = None
+    try:
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:13056/graph", timeout=2
+                ) as resp:
+                    got = json.loads(resp.read())
+            except OSError:
+                time.sleep(0.2)
+                continue
+            # Wait until the stateful step's telemetry carries BOTH
+            # processes (this proc's record is live; the peer's rides
+            # the epoch-close summary, one close behind).
+            nodes = {
+                n["step_id"]: n for n in got.get("steps", [])
+            }
+            merged = [
+                n
+                for n in nodes.values()
+                if {"0", "1"} <= set(n.get("telemetry", {}))
+            ]
+            if merged:
+                graph = got
+                break
+            time.sleep(0.2)
+    finally:
+        errs = []
+        for proc in procs:
+            try:
+                _out, err = proc.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                _out, err = proc.communicate()
+            errs.append(err)
+    for proc, err in zip(procs, errs):
+        assert proc.returncode == 0, err[-2000:].decode(errors="replace")
+    assert graph is not None, "peer flow-map never reached proc 0"
+    # ONE topology (the plan is identical cluster-wide)...
+    assert graph["flow_id"] == "graph_cluster_df"
+    assert graph["proc_count"] == 2
+    step_ids = [n["step_id"] for n in graph["steps"]]
+    assert len(step_ids) == len(set(step_ids))
+    # ...with both processes' rates on the shared steps.
+    merged = [
+        n
+        for n in graph["steps"]
+        if {"0", "1"} <= set(n["telemetry"])
+    ]
+    assert merged
+    for node in merged:
+        for pid in ("0", "1"):
+            tele = node["telemetry"][pid]
+            assert tele.get("rows_in", 0) >= 0
+            assert "rate_in_per_s" in tele or "rate_out_per_s" in tele
+    # The keyed exchange crossed the mesh: per-peer wire telemetry
+    # shows shipped rows from at least one process's record.
+    wire = graph["wire"]
+    assert any(
+        streams
+        for per_proc in wire.values()
+        for streams in per_proc.values()
+    ), wire

@@ -273,6 +273,8 @@ def test_perfetto_trace_dump(monkeypatch, tmp_path):
     files = sorted(trace_dir.glob("epoch-p00-*.json"))
     assert files, list(trace_dir.iterdir())
     saw_phase_slice = False
+    saw_counter = False
+    counter_ts = {}  # (path, track) -> [ts, ...]
     for path in files:
         doc = json.loads(path.read_text())
         events = doc["traceEvents"]
@@ -280,7 +282,7 @@ def test_perfetto_trace_dump(monkeypatch, tmp_path):
         for ev in events:
             # Chrome trace_event required fields per phase type.
             assert isinstance(ev["name"], str)
-            assert ev["ph"] in ("M", "X")
+            assert ev["ph"] in ("M", "X", "C")
             assert isinstance(ev["pid"], int)
             if ev["ph"] == "X":
                 assert isinstance(ev["ts"], (int, float))
@@ -288,7 +290,166 @@ def test_perfetto_trace_dump(monkeypatch, tmp_path):
                 assert ev["dur"] >= 0
                 if ev.get("args", {}).get("step_id"):
                     saw_phase_slice = True
+            elif ev["ph"] == "C":
+                # Flow-map counter tracks: numeric args only (Chrome
+                # renders each args key as a series on the track).
+                saw_counter = True
+                assert isinstance(ev["ts"], (int, float))
+                assert ev["args"], ev
+                for v in ev["args"].values():
+                    assert isinstance(v, (int, float)), ev
+                counter_ts.setdefault(
+                    (str(path), ev["name"]), []
+                ).append(ev["ts"])
     assert saw_phase_slice, "no per-step phase slices in any dump"
+    # Counter tracks ride the flow-map seal: every dump after the
+    # first sealed epoch carries rows/s samples...
+    assert saw_counter, "no flow-map counter tracks in any dump"
+    assert any(
+        name.startswith("rows/s ") for (_p, name) in counter_ts
+    ), sorted(counter_ts)
+    # ...and each track's samples are monotone-timestamped (Perfetto
+    # silently drops out-of-order counter samples).
+    for (path, name), stamps in counter_ts.items():
+        assert len(stamps) >= 2, (path, name, stamps)
+        assert stamps == sorted(stamps), (path, name, stamps)
+
+
+_OVERLAP_TRACE_FLOW = '''
+import os
+
+import bytewax_tpu.operators as op
+from bytewax_tpu import xla
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+
+
+class _Part(StatelessSourcePartition):
+    """Paced batches so the run spans several epochs (several
+    overlapped collective flush rounds), not one EOF burst."""
+
+    def __init__(self, worker_index):
+        import time
+
+        self._time = time
+        base = worker_index * 1000
+        self._batches = [
+            [(f"k{{i % 5}}", float(base + b * 100 + i)) for i in range(80)]
+            for b in range(4)
+        ]
+
+    def next_batch(self):
+        if not self._batches:
+            raise StopIteration()
+        self._time.sleep(0.12)
+        return self._batches.pop(0)
+
+
+class Src(DynamicSource):
+    def build(self, step_id, worker_index, worker_count):
+        return _Part(worker_index)
+
+
+flow = Dataflow("trace_ovl_df")
+s = op.input("inp", flow, Src())
+st = xla.stats_final("stats", s)
+fmt = op.map_value("fmt", st, str)
+op.output("out", fmt, FileSink({out_path!r}))
+'''
+
+
+def test_perfetto_overlap_collective_lane_own_tid(tmp_path):
+    # Under BYTEWAX_TPU_GSYNC_OVERLAP=1 the sealed device exchange
+    # runs on the collective lane while the next epoch computes: its
+    # spans must land on their OWN Perfetto tid (3; named by a
+    # thread_name meta), distinct from the driver (1) and device
+    # pipeline (2) tracks — sharing the device tid would render as
+    # nonsense nesting — and the flow-map counter tracks must emit
+    # monotone-timestamped samples in the same dumps.
+    trace_dir = tmp_path / "traces"
+    flow_py = tmp_path / "trace_ovl_flow.py"
+    out_path = str(tmp_path / "trace_ovl_out.txt")
+    flow_py.write_text(_OVERLAP_TRACE_FLOW.format(out_path=out_path))
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    env["BYTEWAX_TPU_PLATFORM"] = "cpu"
+    env["BYTEWAX_TPU_ACCEL"] = "1"
+    env["BYTEWAX_TPU_DISTRIBUTED"] = "1"
+    env["BYTEWAX_TPU_GLOBAL_EXCHANGE"] = "1"
+    env["BYTEWAX_TPU_GSYNC_OVERLAP"] = "1"
+    env["BYTEWAX_TPU_TRACE_DIR"] = str(trace_dir)
+    # Batch-granular ingest: the coalescer would collapse the paced
+    # source into one EOF flush and leave nothing to overlap.
+    env["BYTEWAX_TPU_INGEST_TARGET_ROWS"] = "0"
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.testing",
+            f"{flow_py}:flow",
+            "-p",
+            "2",
+            "-s",
+            "0.2",
+        ],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+
+    lane_spans = []
+    other_tids = set()
+    counter_ts = {}
+    for proc in (0, 1):
+        files = sorted(trace_dir.glob(f"epoch-p{proc:02d}-*.json"))
+        assert files, list(
+            trace_dir.iterdir() if trace_dir.exists() else []
+        )
+        for path in files:
+            doc = json.loads(path.read_text())
+            lane_named = [
+                ev
+                for ev in doc["traceEvents"]
+                if ev["ph"] == "M"
+                and ev["name"] == "thread_name"
+                and ev["args"]["name"] == "collective lane"
+            ]
+            assert lane_named and all(
+                ev["tid"] == 3 for ev in lane_named
+            ), path
+            for ev in doc["traceEvents"]:
+                if ev["ph"] == "X":
+                    if ev["name"] == "collective_lane":
+                        lane_spans.append(ev)
+                    else:
+                        other_tids.add(ev["tid"])
+                elif ev["ph"] == "C":
+                    counter_ts.setdefault(
+                        (str(path), ev["name"]), []
+                    ).append(ev["ts"])
+    # The sealed exchange ran (both procs flush, but dumps are
+    # per-process; one proc's lane spans suffice for the rendering
+    # contract) and every lane span sits on tid 3.
+    assert lane_spans, "no collective_lane spans in any dump"
+    assert {ev["tid"] for ev in lane_spans} == {3}
+    # No other span ever shares the lane's track (the collective
+    # tier bypasses the per-delivery device pipeline, so this flow
+    # has no device-lane spans — only the driver track plus the
+    # lane's own).
+    assert 3 not in other_tids, other_tids
+    assert 1 in other_tids, other_tids
+    # Counter tracks emit monotone-timestamped samples under overlap.
+    assert counter_ts, "no flow-map counter tracks in any dump"
+    for (path, name), stamps in counter_ts.items():
+        assert len(stamps) >= 2, (path, name, stamps)
+        assert stamps == sorted(stamps), (path, name, stamps)
 
 
 # -- /healthz and /stacks ----------------------------------------------

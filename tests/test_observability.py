@@ -226,6 +226,86 @@ def test_status_endpoint(entry_point, monkeypatch, tmp_path):
     }
     assert isinstance(ledger["recent"], list)
     assert isinstance(ledger["phase_totals"], dict)
+    # The wire section always carries the per-kind pending breakdown
+    # and the vocab-session view; in-process runs have no accumulator
+    # or comm layer, so both pin to None (never missing keys).
+    wire = status["wire"]
+    assert set(wire) >= {"mode", "pending_frames", "pending", "session"}
+    assert wire["pending"] is None
+    assert wire["session"] is None
+
+
+def test_route_accumulator_pending_status_covers_both_kinds():
+    # Satellite audit (PR-15 generalized accumulator): the /status
+    # pending breakdown must count coalesced ship_deliver (peer, op,
+    # port, lane) buckets alongside the PR-12 route (peer, stream,
+    # lane) buckets.
+    from bytewax_tpu.engine.wire import RouteAccumulator
+
+    acc = RouteAccumulator()
+    assert acc.pending_status() == {
+        "route": {"buckets": 0, "frames": 0},
+        "deliver": {"buckets": 0, "frames": 0},
+    }
+    acc.add(1, "df.split", 0, [("k", 1)])
+    acc.add(1, "df.split", 0, [("k", 2)])  # same bucket, new run or merge
+    acc.add(2, "df.split", 0, [("k", 3)])
+    acc.add_deliver(1, 4, "up", 0, [("k", 4)])
+    st = acc.pending_status()
+    assert st["route"]["buckets"] == 2
+    assert st["route"]["frames"] >= 2
+    assert st["deliver"]["buckets"] == 1
+    assert st["deliver"]["frames"] >= 1
+    # The breakdown and the flat count agree.
+    assert (
+        st["route"]["frames"] + st["deliver"]["frames"]
+        == acc.pending_frames()
+    )
+    # Drain via the flush protocol: everything returns to zero.
+    while acc.peek() is not None:
+        acc.pop()
+    assert acc.pending_status() == {
+        "route": {"buckets": 0, "frames": 0},
+        "deliver": {"buckets": 0, "frames": 0},
+    }
+
+
+def test_wire_session_status_view():
+    from bytewax_tpu.engine.wire import WireSession
+
+    st = WireSession().status()
+    assert set(st) == {"generation", "tx_streams", "rx_streams"}
+    assert all(isinstance(v, int) for v in st.values())
+    assert st["tx_streams"] == 0 and st["rx_streams"] == 0
+
+
+def test_json_safe_round_trip():
+    # Satellite: every /status // /graph payload is JSON-safe by
+    # construction — the shared sweep converts numpy scalars/arrays
+    # and datetime64 to native types, and non-finite floats to null
+    # (a NaN gauge renders the whole document invalid cluster-wide).
+    import numpy as np
+
+    from bytewax_tpu.engine.flight import _json_safe
+
+    doc = {
+        "i": np.int64(7),
+        "f": np.float32(1.5),
+        "ts": np.datetime64("2024-01-02T03:04:05", "us"),
+        "arr": np.arange(3, dtype=np.int32),
+        "nested": {np.int64(1): [np.float64(2.5), (np.int16(3),)]},
+        "nan": float("nan"),
+        "inf": np.float64("inf"),
+        "b": b"bytes",
+    }
+    text = json.dumps(_json_safe(doc))  # must not raise
+    back = json.loads(text)
+    assert back["i"] == 7 and back["f"] == 1.5
+    assert back["ts"].startswith("2024-01-02T03:04:05")
+    assert back["arr"] == [0, 1, 2]
+    assert back["nested"]["1"] == [2.5, [3]]
+    assert back["nan"] is None and back["inf"] is None
+    assert back["b"] == "bytes"
 
 
 def test_status_cluster_gsync_piggyback(tmp_path):
@@ -362,6 +442,18 @@ op.output("out", s, NullSink())
     # Mesh traffic was metered per peer on proc 0.
     assert status["recorder"]["counters"]["comm_frames_tx"] >= 1
     assert status["recorder"]["counters"]["comm_frames_rx"] >= 1
+    # Clustered wire section: the per-kind pending breakdown covers
+    # BOTH accumulator bucket kinds (route AND the generalized
+    # coalesced ship_deliver buckets), and the vocab-session view is
+    # live — not just the PR-12 route count.
+    wire = status["wire"]
+    assert set(wire["pending"]) == {"route", "deliver"}
+    for kind in ("route", "deliver"):
+        assert set(wire["pending"][kind]) == {"buckets", "frames"}
+        assert wire["pending"][kind]["buckets"] >= 0
+    assert isinstance(wire["session"]["generation"], int)
+    assert wire["session"]["tx_streams"] >= 0
+    assert wire["session"]["rx_streams"] >= 0
 
 
 def test_status_cluster_divergent_env_does_not_hang(tmp_path):

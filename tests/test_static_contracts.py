@@ -93,6 +93,40 @@ def test_docs_knob_table_matches_catalog():
         )
 
 
+def test_docs_metrics_inventory_matches_registry():
+    """docs/observability.md's metrics inventory lists exactly the
+    Prometheus families ``_metrics.py`` registers — same
+    update-both-together rule as KNOBS ↔ configuration.md: adding a
+    family means adding its doc row in the same change (and vice
+    versa)."""
+    from prometheus_client import Counter, Gauge, Histogram
+
+    import bytewax_tpu._metrics as _metrics
+
+    registered = {
+        m._name
+        for m in vars(_metrics).values()
+        if isinstance(m, (Counter, Gauge, Histogram))
+    }
+    registered |= {
+        h._name for h in _metrics.DURATION_HISTOGRAMS.values()
+    }
+
+    text = (REPO / "docs" / "observability.md").read_text()
+    inventory = text.split("## Metrics inventory", 1)[1].split(
+        "\n## ", 1
+    )[0]
+    documented = set(
+        re.findall(r"`(bytewax_[a-z0-9_]+)`", inventory)
+    )
+    assert documented == registered, (
+        "docs/observability.md metrics inventory drifted from "
+        "_metrics.py: doc-only "
+        f"{sorted(documented - registered)}, undocumented "
+        f"{sorted(registered - documented)}"
+    )
+
+
 def test_cli_exits_zero_on_shipped_tree():
     res = subprocess.run(
         [sys.executable, "-m", "bytewax_tpu.analysis"],
