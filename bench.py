@@ -956,6 +956,115 @@ def _run_restart_recovery():
         faults.reset()
 
 
+def _run_ckpt_async_vs_sync(
+    n_rounds: int = 40,
+    n_keys: int = 1024,
+    batch_size: int = 8192,
+    pad_bytes: int = 2048,
+):
+    """Epoch-close p99 with the synchronous whole-state checkpointer
+    vs delta snapshots sealed at the close and committed on the
+    committer lane (``BYTEWAX_TPU_CKPT_DELTA=1`` +
+    ``BYTEWAX_TPU_CKPT_ASYNC=1``), same keyed flow, with output
+    equality asserted in-bench.
+
+    The flow is a saturating running-max over ``n_keys`` keys with a
+    ``pad_bytes`` payload riding in each state: every key is touched
+    every epoch (so the legacy close rewrites every row, every
+    close), but after the first epoch the value never changes — the
+    counters-that-saturate / watermark / dedup-set shape.  The delta
+    digest filter drops the unchanged rows at the seal and the
+    committer lane absorbs what little remains, so the measured gap
+    is the snapshot write+commit the synchronous close pays per
+    epoch.  Also reports the final ``snapshot_lag_epochs`` — the
+    run-ending fence must have drained the lane, so a clean exit is
+    always 0.  Python GC is parked for the probe (both modes) so the
+    rate-limited close-time collection doesn't blur the percentile.
+    """
+    import tempfile
+    from datetime import timedelta
+
+    import bytewax_tpu.operators as op
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine import flight
+    from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+    from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+    env_keys = (
+        "BYTEWAX_TPU_CKPT_ASYNC",
+        "BYTEWAX_TPU_CKPT_DELTA",
+        "BYTEWAX_TPU_CKPT_COMPACT_EVERY",
+        "BYTEWAX_TPU_GC",
+    )
+    saved = {k: os.environ.get(k) for k in env_keys}
+    pad = "x" * pad_bytes
+    # First touch of each key saturates the max; every later value
+    # leaves the state byte-identical while still touching the key.
+    inp = [
+        (
+            f"k{i % n_keys:05d}",
+            1e9 if i < n_keys else float(i % 100),
+        )
+        for i in range(n_rounds * batch_size)
+    ]
+
+    def step(st, v):
+        mx = max((st or (0.0, pad))[0], v)
+        return (mx, pad), mx
+
+    def one_mode(async_delta: bool):
+        for k in env_keys:
+            os.environ.pop(k, None)
+        os.environ["BYTEWAX_TPU_GC"] = "off"
+        if async_delta:
+            os.environ["BYTEWAX_TPU_CKPT_ASYNC"] = "1"
+            os.environ["BYTEWAX_TPU_CKPT_DELTA"] = "1"
+        # A private recorder per mode: the close-percentile buffer is
+        # the measurement, so neither mode may see the other's closes
+        # (or the main recorder's).
+        main_rec = flight.RECORDER
+        flight.RECORDER = flight.FlightRecorder()
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                init_db_dir(td, 1)
+                out = []
+                flow = Dataflow("ckpt_bench_df")
+                s = op.input(
+                    "inp", flow, TestingSource(inp, batch_size=batch_size)
+                )
+                s = op.stateful_map("mx", s, step)
+                op.output("out", s, TestingSink(out))
+                run_main(
+                    flow,
+                    epoch_interval=timedelta(0),
+                    recovery_config=RecoveryConfig(td),
+                )
+            pct = flight.RECORDER.epoch_close_percentiles()
+            if pct is None:
+                raise RuntimeError("no epoch closes recorded")
+            lag = int(
+                flight.RECORDER.counters.get("snapshot_lag_epochs", 0)
+            )
+            return pct[1], lag, sorted(out)
+        finally:
+            flight.RECORDER = main_rec
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    sync_p99, _, sync_out = one_mode(False)
+    async_p99, lag, async_out = one_mode(True)
+    assert async_out == sync_out, "ckpt bench: async/sync outputs diverge"
+    assert lag == 0, f"ckpt bench: clean exit left snapshot lag {lag}"
+    return {
+        "sync_p99_s": sync_p99,
+        "async_p99_s": async_p99,
+        "lag_epochs": lag,
+    }
+
+
 def _run_io_fault_soak(n_rows: int = 20000):
     """Throughput under a seeded transient-fault soak at the
     connector edge, with oracle equality asserted in-bench.
@@ -2453,6 +2562,24 @@ def main() -> None:
     except Exception as ex:  # noqa: BLE001 - bench must still report
         extra["restart_recovery_s"] = None
         extra["restart_recovery_error"] = str(ex)[:200]
+
+    # Async incremental checkpoints (docs/recovery.md): epoch-close
+    # p99 with the synchronous whole-state checkpointer vs sealed
+    # delta snapshots committed on the committer lane — same keyed
+    # flow, output equality and a zero run-ending snapshot lag
+    # asserted in-bench.
+    try:
+        ck = _run_ckpt_async_vs_sync()
+        extra["ckpt_sync_close_p99_ms"] = round(
+            ck["sync_p99_s"] * 1e3, 3
+        )
+        extra["ckpt_async_close_p99_ms"] = round(
+            ck["async_p99_s"] * 1e3, 3
+        )
+        extra["snapshot_lag_epochs"] = ck["lag_epochs"]
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["ckpt_async_close_p99_ms"] = None
+        extra["ckpt_async_error"] = str(ex)[:200]
 
     # Connector-edge resilience (docs/recovery.md): throughput while
     # seeded transient faults fire through the source_poll/sink_write

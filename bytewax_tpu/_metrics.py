@@ -37,6 +37,7 @@ __all__ = [
     "pipeline_flush_stall_seconds",
     "rescale_duration_seconds",
     "rescale_migrated_keys",
+    "snapshot_lag_epochs",
     "source_lag_seconds",
     "state_evictions_count",
     "state_resident_keys",
@@ -259,6 +260,14 @@ worker_restart_count = Counter(
     "bytewax_worker_restart_count",
     "Supervised worker restarts after a restartable fault "
     "(peer death, epoch stall, injected crash)",
+)
+
+snapshot_lag_epochs = Gauge(
+    "bytewax_snapshot_lag_epochs",
+    "Closed epochs whose snapshot commit is still pending on the "
+    "asynchronous checkpoint committer lane — the replay window a "
+    "crash right now would incur (0 synchronous; at most 1 with "
+    "BYTEWAX_TPU_CKPT_ASYNC=1; /healthz degrades above 1)",
 )
 
 rescale_migrated_keys = Counter(

@@ -231,6 +231,13 @@ FAULTS_MODULE = "bytewax_tpu.engine.faults"
 #: ``kind=error`` raises the typed transient I/O errors the retry
 #: ladder absorbs.  Both are process-local — no comm frames, no new
 #: send surface.
+#: ``snapshot_seal`` is the asynchronous-checkpoint seal point
+#: (docs/recovery.md "Asynchronous incremental checkpoints"): fired
+#: at the epoch-close drain point AFTER the consistent delta is
+#: sealed in memory but BEFORE it is handed to anything durable
+#: (inline write or the committer lane), so an injected crash there
+#: proves the crash-between-seal-and-commit window replays exactly
+#: the sealed epoch.
 FAULT_SITES = (
     "comm.send",
     "comm.recv",
@@ -240,6 +247,7 @@ FAULT_SITES = (
     "sink_write",
     "snapshot.write",
     "snapshot.commit",
+    "snapshot_seal",
     "rescale_migrate",
     "barrier",
 )
@@ -363,6 +371,12 @@ DRAIN_ONLY_METHODS = frozenset(
         # epoch-close entry (snapshots + the close sync ladder).
         "_close_epoch",
         "_close_epoch_inner",
+        # checkpoint seal + committer-lane fence (docs/recovery.md
+        # "Asynchronous incremental checkpoints"): the seal reads
+        # every step's epoch_snaps (worker-owned between submit and
+        # finalize) and the fence blocks on the committer lane.
+        "_ckpt_seal",
+        "_ckpt_fence",
         # the route-accumulator flush (engine/wire.py): frames ship
         # and count ONLY at poll boundaries / drain points, so the
         # count-matched barrier sees exactly what left the process.
@@ -579,6 +593,28 @@ WORKER_SAFE = frozenset(
     }
 )
 
+#: The asynchronous-checkpoint committer lane's narrow carve-out
+#: (docs/recovery.md "Asynchronous incremental checkpoints").  The
+#: recovery store is MAIN_ONLY for every other worker-lane root —
+#: that is what keeps snapshot consistency single-threaded — but the
+#: committer task's ENTIRE job is one ``RecoveryStore.write_epoch``
+#: call over a delta the main thread sealed and froze before handoff
+#: (at most one in flight; the next close fences the previous
+#: commit, so the store handle is never used from two threads at
+#: once).  The exemption is root-scoped: ONLY the root named here
+#: may reach the store, ONLY via the method named in
+#: SNAPSHOT_LANE_SAFE, ONLY into SNAPSHOT_LANE_MODULE — every other
+#: MAIN_ONLY name/module check still applies to it, and every other
+#: worker-lane root still sees the store as forbidden.
+SNAPSHOT_LANE_ROOTS = frozenset(
+    {
+        "bytewax_tpu.engine.driver:"
+        "_Driver._ckpt_seal.<locals>.commit_task",
+    }
+)
+SNAPSHOT_LANE_MODULE = "bytewax_tpu.engine.recovery_store"
+SNAPSHOT_LANE_SAFE = frozenset({"write_epoch"})
+
 # ---------------------------------------------------------------------------
 # BTX-KNOB — the BYTEWAX_TPU_* environment-knob catalog
 # ---------------------------------------------------------------------------
@@ -603,6 +639,9 @@ KNOBS: Dict[str, Tuple[str, str]] = {
         "60",
         "docs/deployment.md",
     ),
+    "BYTEWAX_TPU_CKPT_ASYNC": ("0", "docs/recovery.md"),
+    "BYTEWAX_TPU_CKPT_COMPACT_EVERY": ("", "docs/recovery.md"),
+    "BYTEWAX_TPU_CKPT_DELTA": ("0", "docs/recovery.md"),
     "BYTEWAX_TPU_COMPILE_CACHE": ("", "docs/performance.md"),
     "BYTEWAX_TPU_COORDINATOR": ("", "docs/deployment.md"),
     "BYTEWAX_TPU_DEMOTE_AFTER": ("3", "docs/recovery.md"),

@@ -36,6 +36,17 @@ their edges back into the owning class fall under the same
 exclusion: the lane is fenced at the ordered points, and everything
 it touches (``_fields``/``_host_fields``) is lane-owned between seal
 and fence by construction.
+
+The asynchronous-checkpoint committer lane gets a root-scoped
+carve-out (``contracts.SNAPSHOT_LANE_ROOTS``; docs/recovery.md
+"Asynchronous incremental checkpoints"): ONLY the pinned committer
+task may reach the recovery store, ONLY through the method names in
+``contracts.SNAPSHOT_LANE_SAFE`` and ONLY into
+``contracts.SNAPSHOT_LANE_MODULE`` — the main thread seals and
+freezes the delta before handoff and the next close fences the
+previous commit, so the store handle never sees two threads.  Every
+other MAIN_ONLY name/module still applies to that root, and every
+other root still sees the store as main-only.
 """
 
 import ast
@@ -83,9 +94,16 @@ def _global_exchange_owned(project: Project, fid: str) -> bool:
 
 
 def _main_only_hits(
-    project: Project, fn: FunctionInfo
+    project: Project,
+    fn: FunctionInfo,
+    snapshot_lane: bool = False,
 ) -> List[Tuple[int, str]]:
-    """(lineno, what) for every main-thread-only touch in ``fn``."""
+    """(lineno, what) for every main-thread-only touch in ``fn``.
+
+    ``snapshot_lane=True`` applies the committer-lane carve-out:
+    calls named in ``contracts.SNAPSHOT_LANE_SAFE`` and calls
+    resolving into ``contracts.SNAPSHOT_LANE_MODULE`` are exempt for
+    that root only (see the module docstring)."""
     mod = project.modules[fn.module]
     hits: List[Tuple[int, str]] = []
     # Bound-method aliases of a raw send: s = self.comm.send; s(...).
@@ -114,6 +132,10 @@ def _main_only_hits(
         if (
             call.name in contracts.MAIN_ONLY
             and call.name not in contracts.WORKER_SAFE
+            and not (
+                snapshot_lane
+                and call.name in contracts.SNAPSHOT_LANE_SAFE
+            )
         ):
             # A send/broadcast name only counts on a comm-denoting
             # receiver (sockets aside, .send is too common a name);
@@ -134,6 +156,10 @@ def _main_only_hits(
             if (
                 t_mod in contracts.MAIN_ONLY_MODULES
                 and not _global_exchange_owned(project, target)
+                and not (
+                    snapshot_lane
+                    and t_mod == contracts.SNAPSHOT_LANE_MODULE
+                )
             ):
                 hits.append(
                     (
@@ -165,13 +191,19 @@ def check(project: Project) -> List[Diagnostic]:
         root = project.functions.get(root_id)
         if root is None:
             continue
+        # The committer-lane carve-out is keyed on the ROOT, not the
+        # visited function: a device-phase task that somehow reached
+        # write_epoch would still be flagged.
+        lane_exempt = root_id in contracts.SNAPSHOT_LANE_ROOTS
         # BFS over the worker lane, excluding the collective tier.
         parent: Dict[str, Optional[str]] = {root_id: None}
         queue = [root_id]
         while queue:
             fid = queue.pop(0)
             fn = project.functions[fid]
-            hits = _main_only_hits(project, fn)
+            hits = _main_only_hits(
+                project, fn, snapshot_lane=lane_exempt
+            )
             if hits:
                 chain: List[FunctionInfo] = []
                 cur: Optional[str] = fid

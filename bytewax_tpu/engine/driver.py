@@ -17,6 +17,7 @@ this driver's epoch/recovery bookkeeping.
 """
 
 import contextlib
+import hashlib
 import os
 import pickle
 import random
@@ -411,6 +412,7 @@ def derive_rescale_hint(
     stall_s_per_close: float,
     restores_per_close: float,
     spill_bytes_per_close: float = 0.0,
+    snapshot_stall_s_per_close: float = 0.0,
     phase_fractions: Optional[Dict[str, float]] = None,
     bottleneck: Optional[Tuple[str, str]] = None,
 ) -> Tuple[str, List[str]]:
@@ -469,6 +471,21 @@ def derive_rescale_hint(
             f"pipeline flush stalls {stall_s_per_close:.3f}s/epoch "
             f"exceed {_HINT_STALL_FRAC:.0%} of the epoch interval"
         )
+    if (
+        epoch_interval_s > 0
+        and snapshot_stall_s_per_close
+        > _HINT_STALL_FRAC * epoch_interval_s
+    ):
+        # Async checkpointing moved snapshot+commit off the close
+        # window, so a durability-bound flow now shows up as fence
+        # stalls instead of a loud close — it must still read as
+        # pressure, never as quiet (docs/recovery.md "Asynchronous
+        # incremental checkpoints").
+        reasons.append(
+            f"snapshot fence stalls {snapshot_stall_s_per_close:.3f}"
+            f"s/epoch exceed {_HINT_STALL_FRAC:.0%} of the epoch "
+            "interval: checkpoint durability trails the close rate"
+        )
     if restores_per_close > _HINT_RESTORES_PER_CLOSE:
         reasons.append(
             f"{restores_per_close:.1f} residency restores/epoch: the "
@@ -516,6 +533,8 @@ def derive_rescale_hint(
         and close_p99_s is not None
         and close_p99_s < _HINT_QUIET_CLOSE_FRAC * epoch_interval_s
         and stall_s_per_close
+        < _HINT_QUIET_STALL_FRAC * epoch_interval_s
+        and snapshot_stall_s_per_close
         < _HINT_QUIET_STALL_FRAC * epoch_interval_s
         and restores_per_close < _HINT_QUIET_RESTORES
         and spill_bytes_per_close < _HINT_QUIET_SPILL_BYTES
@@ -3035,6 +3054,55 @@ class _Driver:
         self._hint_log: deque = deque(maxlen=64)
         self._last_hint_at = float("-inf")
 
+        # -- incremental asynchronous checkpoints (docs/recovery.md
+        # "Asynchronous incremental checkpoints").  Both knobs default
+        # OFF; unset keeps the close sequence byte-identical.
+        #: Run the SQLite snapshot write+commit on an ordered
+        #: committer lane while the next epoch computes (at most one
+        #: commit in flight; the next close fences the previous one).
+        self.ckpt_async = self.store is not None and os.environ.get(
+            "BYTEWAX_TPU_CKPT_ASYNC", "0"
+        ) not in ("", "0")
+        #: Write only snapshot rows whose serialized state changed
+        #: since the last close (latest-row-per-key resume reads make
+        #: the skipped rows authoritative).
+        self.ckpt_delta = self.store is not None and os.environ.get(
+            "BYTEWAX_TPU_CKPT_DELTA", "0"
+        ) not in ("", "0")
+        #: Under a retain-everything commit schedule
+        #: (``_commit_delay is None``), force a commit/GC pass every K
+        #: closes so a delta chain compacts back to one authoritative
+        #: row per key; 0 = off.
+        self.ckpt_compact_every = max(
+            0,
+            int(
+                os.environ.get("BYTEWAX_TPU_CKPT_COMPACT_EVERY", "0")
+                or 0
+            ),
+        )
+        #: Ordered checkpoint committer lane (depth 2 = at most one
+        #: commit in flight; ``make_room`` at push IS the
+        #: previous-commit fence).  Ledger phase ``snapshot_lane``
+        #: keeps its seconds off the main-thread close window.
+        self._ckpt_lane = None
+        if self.ckpt_async:
+            from bytewax_tpu.engine.pipeline import DevicePipeline
+
+            self._ckpt_lane = DevicePipeline(
+                "ckpt", depth=2, phase="snapshot_lane"
+            )
+        #: Newest epoch whose snapshot commit is durable on disk (this
+        #: process's view; resume_epoch - 1 covers "nothing from this
+        #: execution yet"), and the newest epoch whose snapshot set
+        #: was sealed at a close — their difference is the replay
+        #: window a crash right now would incur.
+        self._durable_epoch = resume.resume_epoch - 1
+        self._ckpt_sealed_epoch = resume.resume_epoch - 1
+        #: Last-written content digest per (step_id, state_key) for
+        #: the delta filter; empty after every (re)start so the first
+        #: close of an execution writes everything it sees.
+        self._ckpt_digests: Dict[Tuple[str, str], bytes] = {}
+
     # -- cluster topology --------------------------------------------------
 
     def is_local(self, w: int) -> bool:
@@ -3312,45 +3380,7 @@ class _Driver:
         # never duplicating (docs/recovery.md "Connector-edge
         # resilience").
         self.dlq.flush()
-        if self.store is not None:
-            snaps: List[Tuple[str, str, Optional[bytes]]] = []
-            with self._ledger_phase("snapshot"):
-                for rt in self.rts:
-                    for state_key, state in rt.epoch_snaps():
-                        ser = (
-                            pickle.dumps(state) if state is not None else None
-                        )
-                        snaps.append((rt.op.step_id, state_key, ser))
-            _flight.RECORDER.record(
-                "snapshot", epoch=self.epoch, states=len(snaps)
-            )
-            if self._commit_delay is None:
-                commit_epoch = None
-            else:
-                commit_epoch = self.epoch - self._commit_delay
-                if self.comm is not None:
-                    # Peers write their frontier for this epoch in
-                    # separate transactions after the coordinator's; a
-                    # crash in that window must not have GC'd past
-                    # their previous frontier.
-                    commit_epoch -= 1
-                commit_epoch = commit_epoch if commit_epoch > 0 else None
-            with self._ledger_phase("commit"):
-                self.store.write_epoch(
-                    self.resume.ex_num,
-                    self.worker_count,
-                    self.epoch,
-                    snaps,
-                    commit_epoch,
-                    workers=workers,
-                    # In a cluster only the coordinator commits/GCs,
-                    # after its own frontier write.
-                    do_commit=self.proc_id == 0,
-                )
-        else:
-            with self._ledger_phase("snapshot"):
-                for rt in self.rts:
-                    rt.epoch_snaps()  # still clears awoken sets
+        self._ckpt_seal(workers)
         pending_reconfig = self._reconfig_spec(_pending_reconfigure())
         if self.comm is not None:
             # Epoch-close sync round: the graceful-stop vote, the
@@ -3409,9 +3439,154 @@ class _Driver:
                 fence = getattr(rt, "collective_fence", None)
                 if fence is not None:
                     fence()
+            # Same for the checkpoint committer lane: the agreed
+            # ending close's commit must be durable before any
+            # process tears down (resume then replays ZERO epochs —
+            # the GracefulStop contract).
+            self._ckpt_fence()
         self.epoch += 1
         _faults.set_epoch(self.epoch)
         _flight.RECORDER.record("epoch_open", epoch=self.epoch)
+
+    #: Content digest standing in for a discard marker (``None``
+    #: serialization) in the delta filter's per-key digest map.
+    _CKPT_TOMBSTONE = b"\x00tombstone"
+
+    def _ckpt_seal(self, workers: Optional[range] = None) -> None:
+        """Seal this close's snapshot set at the drain point and hand
+        it to durability (docs/recovery.md "Asynchronous incremental
+        checkpoints").  Drain-only: called from ``_close_epoch_inner``
+        with pipelines quiesced, so the state read here is the
+        consistent image of the closing epoch.
+
+        With ``BYTEWAX_TPU_CKPT_DELTA=1`` rows whose serialized state
+        is unchanged since the last written row are skipped (resume's
+        latest-row-per-key reads keep the stored row authoritative).
+        With ``BYTEWAX_TPU_CKPT_ASYNC=1`` the SQLite write+commit runs
+        as an ordered task on the committer lane while the next epoch
+        computes — pushing the next seal fences the previous commit
+        (at most one in flight), so the durable frontier never trails
+        the closed frontier by more than one epoch.  The pinned
+        ``snapshot_seal`` fault site fires after the seal is immutable
+        and before anything is handed to either path."""
+        if self.store is None:
+            with self._ledger_phase("snapshot"):
+                for rt in self.rts:
+                    rt.epoch_snaps()  # still clears awoken sets
+            return
+        snaps: List[Tuple[str, str, Optional[bytes]]] = []
+        with self._ledger_phase("snapshot"):
+            for rt in self.rts:
+                sid = rt.op.step_id
+                for state_key, state in rt.epoch_snaps():
+                    ser = (
+                        pickle.dumps(state) if state is not None else None
+                    )
+                    if self.ckpt_delta:
+                        digest = (
+                            hashlib.blake2b(
+                                ser, digest_size=16
+                            ).digest()
+                            if ser is not None
+                            else self._CKPT_TOMBSTONE
+                        )
+                        dkey = (sid, state_key)
+                        if self._ckpt_digests.get(dkey) == digest:
+                            continue  # latest stored row still matches
+                        self._ckpt_digests[dkey] = digest
+                    snaps.append((sid, state_key, ser))
+        _flight.RECORDER.record(
+            "snapshot", epoch=self.epoch, states=len(snaps)
+        )
+        if self._commit_delay is None:
+            commit_epoch = None
+            if (
+                self.ckpt_compact_every
+                and self.epoch % self.ckpt_compact_every == 0
+            ):
+                # Retain-everything schedule: periodically force the
+                # commit/GC pass anyway so an unbounded delta chain
+                # compacts back to one authoritative row per key
+                # (rescale migration and resume reads then touch one
+                # row, and the store stops growing).
+                commit_epoch = self.epoch
+        else:
+            commit_epoch = self.epoch - self._commit_delay
+        if commit_epoch is not None:
+            if self.comm is not None:
+                # Peers write their frontier for this epoch in
+                # separate transactions after the coordinator's; a
+                # crash in that window must not have GC'd past their
+                # previous frontier.  The same one-epoch margin covers
+                # an async peer whose previous commit is still in
+                # flight (the per-close fence bounds the skew at 1).
+                commit_epoch -= 1
+            commit_epoch = commit_epoch if commit_epoch > 0 else None
+        # The sealed delta is immutable from here on; the site fires
+        # before the inline write (sync) or the lane handoff (async),
+        # so an injected crash proves the seal→commit window resumes
+        # from the previous durable close.  Unarmed: one no-op call.
+        _faults.fire("snapshot_seal")
+        sealed_epoch = self.epoch
+        if self._ckpt_lane is None:
+            with self._ledger_phase("commit"):
+                self.store.write_epoch(
+                    self.resume.ex_num,
+                    self.worker_count,
+                    sealed_epoch,
+                    snaps,
+                    commit_epoch,
+                    workers=workers,
+                    # In a cluster only the coordinator commits/GCs,
+                    # after its own frontier write.
+                    do_commit=self.proc_id == 0,
+                )
+            self._durable_epoch = sealed_epoch
+            return
+        store = self.store
+        ex_num = self.resume.ex_num
+        worker_count = self.worker_count
+        do_commit = self.proc_id == 0
+
+        def commit_task() -> int:
+            # Worker-lane root (BTX-THREAD: pinned carve-out to the
+            # recovery store ONLY): one pre-bound durable write, no
+            # emission, no comm, no shared engine state.
+            store.write_epoch(
+                ex_num,
+                worker_count,
+                sealed_epoch,
+                snaps,
+                commit_epoch,
+                workers=workers,
+                do_commit=do_commit,
+            )
+            return sealed_epoch
+
+        def commit_done(epoch: int) -> None:
+            # Finalizer: main thread, at the next fence/drain point.
+            self._durable_epoch = epoch
+            _flight.note_snapshot_lag(
+                epoch, max(0, self._ckpt_sealed_epoch - epoch)
+            )
+
+        self._ckpt_sealed_epoch = sealed_epoch
+        # push() makes room first: at depth 2 that IS the fence on the
+        # previous epoch's commit (stall seconds land in
+        # snapshot_fence_stall_seconds via the lane's phase).
+        self._ckpt_lane.push(commit_task, commit_done)
+        _flight.note_snapshot_lag(
+            self._durable_epoch,
+            max(0, sealed_epoch - self._durable_epoch),
+        )
+
+    def _ckpt_fence(self) -> None:
+        """Block until every pending checkpoint commit is durable.
+        Drain-only: the run-ending close (stop/reconfigure), the
+        post-loop clean exit in ``run()``, and teardown — a normal
+        close fences implicitly through ``push``'s make_room."""
+        if self._ckpt_lane is not None:
+            self._ckpt_lane.flush()
 
     def _pump(self, timeout: float = 0.0) -> None:
         """Receive cluster messages: inject shipped data, apply
@@ -3813,6 +3988,13 @@ class _Driver:
         stall_s_per_close = (
             counters.get("pipeline_flush_stall_seconds", 0.0) / closes
         )
+        # Checkpoint-fence waits are tracked apart from device flush
+        # stalls on purpose: they are durability pressure, and the
+        # hint must see them even though the async close window no
+        # longer contains snapshot+commit time.
+        snapshot_stall_s_per_close = (
+            counters.get("snapshot_fence_stall_seconds", 0.0) / closes
+        )
         restores_per_close = (
             counters.get("residency_restore_count", 0.0) / closes
         )
@@ -3831,6 +4013,7 @@ class _Driver:
             stall_s_per_close=stall_s_per_close,
             restores_per_close=restores_per_close,
             spill_bytes_per_close=spill_bytes_per_close,
+            snapshot_stall_s_per_close=snapshot_stall_s_per_close,
             phase_fractions=phase_fractions,
             bottleneck=bottleneck,
         )
@@ -3839,6 +4022,9 @@ class _Driver:
             "epoch_interval_s": interval_s,
             "epoch_close_p99_s": close_p99_s,
             "flush_stall_s_per_close": round(stall_s_per_close, 6),
+            "snapshot_fence_stall_s_per_close": round(
+                snapshot_stall_s_per_close, 6
+            ),
             "restores_per_close": round(restores_per_close, 3),
             "spill_bytes_per_close": round(spill_bytes_per_close, 1),
             "epoch_closes": int(counters.get("epoch_close_count", 0)),
@@ -3921,6 +4107,26 @@ class _Driver:
             ),
         }
 
+    def _ckpt_status(self) -> Dict[str, Any]:
+        """Committer-lane visibility for ``/status``, ``/healthz``,
+        and crash post-mortems (read racily — observability): the
+        durable frontier vs the last sealed close is the replay
+        window a crash right now would incur."""
+        lag = max(0, self._ckpt_sealed_epoch - self._durable_epoch)
+        return {
+            "async": self.ckpt_async,
+            "delta": self.ckpt_delta,
+            "compact_every": self.ckpt_compact_every,
+            "durable_epoch": self._durable_epoch,
+            "sealed_epoch": self._ckpt_sealed_epoch,
+            "lag_epochs": lag,
+            "pending_commits": (
+                len(self._ckpt_lane)
+                if self._ckpt_lane is not None
+                else 0
+            ),
+        }
+
     def _status(self) -> Dict[str, Any]:
         """Live ``GET /status`` document (read racily off the API
         server thread — observability, not the epoch protocol)."""
@@ -3953,6 +4159,7 @@ class _Driver:
                 "pending_flush": self.dlq.pending_count(),
             },
             "rescale_hint": self._rescale_hint(),
+            "checkpoint": self._ckpt_status(),
             "wire": {
                 "mode": _wire.wire_mode(),
                 "pending_frames": (
@@ -4087,21 +4294,31 @@ class _Driver:
         (HTTP 503), so external probes/k8s stop routing new work to a
         cluster that is winding down while liveness stays green."""
         draining = _STOP_EVENT.is_set() or self._stop_agreed
+        # Replay window the committer lane currently carries.  Lag 1
+        # is the steady-state design point of BYTEWAX_TPU_CKPT_ASYNC=1
+        # (one commit in flight while the next epoch computes) and
+        # stays green; anything above means durability has fallen
+        # behind the close rate and readiness degrades — liveness
+        # stays up so a supervisor can tell "lagging" from "wedged".
+        ckpt_lag = max(0, self._ckpt_sealed_epoch - self._durable_epoch)
+        lagging = ckpt_lag > 1
         if draining:
             state = "draining"
-        elif self._ready:
-            state = "ready"
-        elif self._migrating:
-            state = "migrating"
+        elif not self._ready:
+            state = "migrating" if self._migrating else "starting"
+        elif lagging:
+            state = "checkpoint_lagging"
         else:
-            state = "starting"
+            state = "ready"
         return {
-            "ready": self._ready and not draining,
+            "ready": self._ready and not draining and not lagging,
             "draining": draining,
             "state": state,
             "proc_id": self.proc_id,
             "generation": self.generation,
             "epoch": self.epoch,
+            "durable_epoch": self._durable_epoch,
+            "snapshot_lag_epochs": ckpt_lag,
         }
 
     def run(self) -> Optional[Any]:
@@ -4202,6 +4419,8 @@ class _Driver:
                 shutdown = getattr(rt, "pipeline_shutdown", None)
                 if shutdown is not None:
                     shutdown()
+            if self._ckpt_lane is not None:
+                self._ckpt_lane.shutdown()
             if api_server is not None:
                 api_server.shutdown()
             if clustered:
@@ -4303,6 +4522,12 @@ class _Driver:
                     # barrier can never observe drained queues while
                     # frames still sit in the accumulator.
                     self.ship_flush()
+
+                if self._ckpt_lane is not None:
+                    # Liveness: surface a landed commit's finalizer
+                    # (durable-epoch/lag bookkeeping) without
+                    # blocking on one still in flight.
+                    self._ckpt_lane.finalize_ready()
 
                 elapsed = time.monotonic() - epoch_started
 
@@ -4433,6 +4658,14 @@ class _Driver:
                             self._pump(timeout=wait)
                     elif wait > 0:
                         time.sleep(wait)
+            # Clean exit (EOF, agreed stop, agreed reconfigure): the
+            # final close's snapshot commit may still be riding the
+            # committer lane — land it before teardown so the next
+            # execution resumes past every closed epoch (stop and
+            # reconfigure closes already fenced inside the close; a
+            # commit fault here propagates restartable like any
+            # other).
+            self._ckpt_fence()
         except _Abort:
             aborted = True
             if clustered:
@@ -4470,6 +4703,14 @@ class _Driver:
                 shutdown = getattr(rt, "pipeline_shutdown", None)
                 if shutdown is not None:
                     shutdown()
+            if self._ckpt_lane is not None:
+                # Clean exits fenced above; a fault unwind abandons
+                # the in-flight commit (it either already committed,
+                # or its transaction rolled back — resume replays
+                # that one epoch) and goes quiet before the store
+                # handle closes.
+                self._ckpt_lane.drop_pending()
+                self._ckpt_lane.shutdown()
             if api_server is not None:
                 api_server.shutdown()
             if clustered:

@@ -13,7 +13,7 @@ Plan syntax — comma-separated specs::
 - ``site``: one of :data:`SITES` (``comm.send``, ``comm.recv``,
   ``device_dispatch``, ``residency_restore``, ``source_poll``,
   ``sink_write``, ``snapshot.write``, ``snapshot.commit``,
-  ``rescale_migrate``, ``barrier``).
+  ``snapshot_seal``, ``rescale_migrate``, ``barrier``).
 - ``kind``: ``delay`` (sleep ``BYTEWAX_TPU_FAULT_DELAY_S``, default
   0.05s), ``drop`` (suppress the frame — only meaningful at
   ``comm.send``; breaks the barrier's in-flight accounting on purpose,
@@ -84,6 +84,12 @@ __all__ = [
 #: before a source partition's ``next_batch`` / a sink partition's
 #: ``write_batch``, before any offset advances or byte lands, so an
 #: injected transient error is retry-safe by construction.
+#: ``snapshot_seal`` fires at the epoch-close drain point, after the
+#: consistent delta is sealed in memory but before it is handed to
+#: anything durable (the inline write under the sync path, the
+#: committer lane under ``BYTEWAX_TPU_CKPT_ASYNC=1``) — a crash there
+#: proves the seal→commit window resumes from the previous durable
+#: close (docs/recovery.md "Asynchronous incremental checkpoints").
 SITES = (
     "comm.send",
     "comm.recv",
@@ -93,6 +99,7 @@ SITES = (
     "sink_write",
     "snapshot.write",
     "snapshot.commit",
+    "snapshot_seal",
     "rescale_migrate",
     "barrier",
 )

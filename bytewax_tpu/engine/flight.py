@@ -81,6 +81,7 @@ __all__ = [
     "note_resident",
     "note_residency_restore",
     "note_restart",
+    "note_snapshot_lag",
     "note_source_lag",
     "note_spill",
     "note_stop_requested",
@@ -106,8 +107,12 @@ _SPAN_CAP = 4096
 #: overlap the close window rather than occupying it, so the sealed
 #: close breakdown excludes them.  ``collective_lane`` is the
 #: overlapped global-exchange round (docs/performance.md "Overlapped
-#: collectives").
-_OFF_THREAD_PHASES = frozenset({"device", "collective_lane"})
+#: collectives"); ``snapshot_lane`` is the asynchronous checkpoint
+#: committer (docs/recovery.md "Asynchronous incremental
+#: checkpoints").
+_OFF_THREAD_PHASES = frozenset(
+    {"device", "collective_lane", "snapshot_lane"}
+)
 
 
 def _truthy(name: str) -> bool:
@@ -350,6 +355,13 @@ class FlightRecorder:
                 "args": {"name": "collective lane"},
             },
             {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 4,
+                "args": {"name": "snapshot lane"},
+            },
+            {
                 "name": f"epoch {epoch}",
                 "cat": "epoch",
                 "ph": "X",
@@ -368,12 +380,17 @@ class FlightRecorder:
                     "ts": t0 * 1e6,
                     "dur": gross * 1e6,
                     "pid": pid,
-                    # The overlapped collectives' ordered lane gets
-                    # its own track: its spans overlap the NEXT
-                    # epoch's device work, so sharing the device
-                    # pipeline tid would render as nonsense nesting.
+                    # The overlapped collectives' ordered lane (and
+                    # the checkpoint committer lane) get their own
+                    # tracks: their spans overlap the NEXT epoch's
+                    # device work, so sharing the device pipeline tid
+                    # would render as nonsense nesting.
                     "tid": (
-                        3 if phase == "collective_lane" else 1 + lane
+                        3
+                        if phase == "collective_lane"
+                        else 4
+                        if phase == "snapshot_lane"
+                        else 1 + lane
                     ),
                     "args": {"step_id": step},
                 }
@@ -1034,6 +1051,21 @@ def note_pipeline_stall(step_id: str, seconds: float) -> None:
     )
 
 
+def note_snapshot_lag(durable_epoch: int, lag_epochs: int) -> None:
+    """The checkpoint durable frontier moved (or a close observed
+    it): ``durable_epoch`` is the newest epoch whose snapshot commit
+    is on disk, ``lag_epochs`` is how many closed epochs are still
+    waiting on the committer lane — the replay window a crash right
+    now would incur (0 in the synchronous engine, at most 1 with
+    ``BYTEWAX_TPU_CKPT_ASYNC=1``; see docs/recovery.md "Asynchronous
+    incremental checkpoints")."""
+    from bytewax_tpu._metrics import snapshot_lag_epochs
+
+    snapshot_lag_epochs.set(lag_epochs)
+    RECORDER.counters["snapshot_durable_epoch"] = durable_epoch
+    RECORDER.counters["snapshot_lag_epochs"] = lag_epochs
+
+
 def note_barrier(seconds: float) -> None:
     """Epoch barrier resolved: time from entering the hold to the
     close broadcast taking effect."""
@@ -1116,7 +1148,7 @@ _FRACTION_BUCKETS = {
     "flush": ("flush", "close_flush"),
     "barrier": ("barrier",),
     "gsync": ("gsync", "collective", "collective_lane"),
-    "snapshot": ("snapshot", "commit"),
+    "snapshot": ("snapshot", "commit", "snapshot_lane"),
     "residency": ("restore", "evict"),
 }
 

@@ -103,6 +103,10 @@ class DevicePipeline:
         #: seconds land in the ledger's gsync/collective bucket on
         #: their own lane instead of inflating the main-thread close
         #: window, so ``derive_rescale_hint``'s signals stay truthful.
+        #: ``"snapshot_lane"`` is the asynchronous checkpoint
+        #: committer lane (docs/recovery.md "Asynchronous incremental
+        #: checkpoints") — same off-main-window treatment, snapshot
+        #: fraction bucket.
         self.phase = phase
         #: (future, finalize, submit_monotonic) in submission order.
         self._pending: deque = deque()
@@ -193,6 +197,16 @@ class DevicePipeline:
             if stalled > 0.0005:
                 if self.phase == "device":
                     _flight.note_pipeline_stall(self.step_id, stalled)
+                elif self.phase == "snapshot_lane":
+                    # Checkpoint-fence waits are durability pressure
+                    # (the previous epoch's async commit hasn't landed
+                    # yet), not device-flush pressure: own counter so
+                    # the rescale hint's flush-stall signal stays
+                    # truthful (docs/recovery.md "Asynchronous
+                    # incremental checkpoints").
+                    _flight.RECORDER.count(
+                        "snapshot_fence_stall_seconds", stalled
+                    )
                 else:
                     # Collective-fence waits are gsync pressure, not
                     # device-flush pressure: keep them out of the

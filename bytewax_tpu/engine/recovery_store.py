@@ -144,7 +144,16 @@ class WorkerCountMismatchError(ValueError):
 
 
 def _connect(path: Path) -> sqlite3.Connection:
-    con = sqlite3.connect(path, isolation_level=None)
+    # check_same_thread=False: the async checkpoint committer lane
+    # (docs/recovery.md "Asynchronous incremental checkpoints") runs
+    # write_epoch on its single worker thread.  The handle is still
+    # never used concurrently — the main thread hands a sealed delta
+    # to at most one in-flight commit and fences it before the next
+    # touch (BTX-THREAD pins the lane to exactly that one call) — and
+    # the linked SQLite is THREADSAFE=1 (serialized) regardless.
+    con = sqlite3.connect(
+        path, isolation_level=None, check_same_thread=False
+    )
     # Litestream/backup friendly, matching the reference's pragmas
     # (src/recovery.rs:521-531).
     con.execute("PRAGMA journal_mode = WAL")

@@ -128,6 +128,14 @@ def test_fault_site_inventory_is_pinned():
     # retry ladder; they are NOT device sites, and the whole layer is
     # process-local (no new frame kinds, no send-surface growth —
     # the inventories below are byte-identical).
+    # The async-checkpoint PR added exactly one: snapshot_seal,
+    # fired at the epoch-close drain point after the consistent
+    # delta is sealed in memory but before it is handed to anything
+    # durable (inline write or the committer lane) — an injected
+    # crash there proves the crash-between-seal-and-commit window
+    # replays exactly the sealed epoch.  It is NOT a device site,
+    # and the whole checkpoint tier is process-local (no new frame
+    # kinds, no send-surface growth).
     assert contracts.FAULT_SITES == (
         "comm.send",
         "comm.recv",
@@ -137,6 +145,7 @@ def test_fault_site_inventory_is_pinned():
         "sink_write",
         "snapshot.write",
         "snapshot.commit",
+        "snapshot_seal",
         "rescale_migrate",
         "barrier",
     )
@@ -274,6 +283,12 @@ def test_drain_point_inventory_is_pinned():
         # drain-only — frames ship (and count into the barrier's
         # quiescence math) only at poll boundaries / drain points.
         "ship_flush",
+        # The async-checkpoint PR: the seal reads every step's
+        # epoch_snaps (worker-owned between submit and finalize) and
+        # the fence blocks on the committer lane — both legal only
+        # at the pinned drain points.
+        "_ckpt_seal",
+        "_ckpt_fence",
     }
     assert contracts.PIPELINE_DRAIN_METHODS == {
         "flush",
@@ -331,14 +346,30 @@ def test_worker_lane_inventory_is_pinned():
     # the global tier's collective lane (docs/performance.md
     # "Overlapped collectives"): the exact device exchange and the
     # quantized partial merge, both sealed at a globally-ordered
-    # flush and fenced at the next close/finalize.
+    # flush and fenced at the next close/finalize — plus the
+    # async-checkpoint PR's committer task (docs/recovery.md
+    # "Asynchronous incremental checkpoints"): one write_epoch over
+    # a delta the main thread sealed and froze, at most one in
+    # flight, fenced at the next close/finalize/run-ending close.
     assert set(roots) == {
         f"{driver}:_StatefulBatchRt._push_window_task.<locals>.task",
         f"{driver}:_StatefulBatchRt._push_scan_task.<locals>.task",
         f"{driver}:_StatefulBatchRt._process_accel.<locals>.<lambda>",
         f"{sharded}:GlobalAggState.flush.<locals>.exchange_task",
         f"{sharded}:GlobalAggState.flush.<locals>.merge_task",
+        f"{driver}:_Driver._ckpt_seal.<locals>.commit_task",
     }
+    # The committer lane's recovery-store carve-out is exactly that
+    # one root, one method, one module — root-scoped, so every other
+    # worker-lane root still sees the store as main-only.
+    assert contracts.SNAPSHOT_LANE_ROOTS == {
+        f"{driver}:_Driver._ckpt_seal.<locals>.commit_task",
+    }
+    assert (
+        contracts.SNAPSHOT_LANE_MODULE
+        == "bytewax_tpu.engine.recovery_store"
+    )
+    assert contracts.SNAPSHOT_LANE_SAFE == {"write_epoch"}
     # The send surface, sync rounds, emission/routing, recovery
     # store, residency movement, and pipeline drains are main-only.
     for name in (
@@ -389,7 +420,7 @@ def test_worker_lane_inventory_is_pinned():
 
 
 def test_knob_catalog_is_pinned():
-    """The knob inventory: exactly today's 53 BYTEWAX_TPU_* knobs,
+    """The knob inventory: exactly today's 56 BYTEWAX_TPU_* knobs,
     each with a default and a doc anchor.  Adding a knob requires
     updating contracts.KNOBS, this list, docs/configuration.md, and
     the anchor doc — BTX-KNOB enforces the rest (literal reads,
@@ -408,7 +439,16 @@ def test_knob_catalog_is_pinned():
     lock-step tier, byte-identical to the pre-overlap engine) and
     BYTEWAX_TPU_GSYNC_QUANT (default off — bf16/int8 block-scale the
     gsync partial-aggregate frames; counts stay exact), both
-    anchored at docs/performance.md "Overlapped collectives"."""
+    anchored at docs/performance.md "Overlapped collectives".  The
+    async-checkpoint PR added exactly three:
+    BYTEWAX_TPU_CKPT_ASYNC (default off — 1 commits each sealed
+    epoch delta on the committer lane while the next epoch
+    computes), BYTEWAX_TPU_CKPT_DELTA (default off — 1 writes only
+    keys whose pickled state changed since the last close), and
+    BYTEWAX_TPU_CKPT_COMPACT_EVERY (unset — every K closes forces a
+    commit/GC watermark so an uncompacted delta chain stays
+    bounded), all anchored at docs/recovery.md "Asynchronous
+    incremental checkpoints"."""
     assert sorted(contracts.KNOBS) == [
         "BYTEWAX_TPU_ACCEL",
         "BYTEWAX_TPU_ALLOW_REMOTE_STOP",
@@ -417,6 +457,9 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_AUTOSCALE_LIVE",
         "BYTEWAX_TPU_AUTOSCALE_POLL_S",
         "BYTEWAX_TPU_AUTOSCALE_STOP_TIMEOUT_S",
+        "BYTEWAX_TPU_CKPT_ASYNC",
+        "BYTEWAX_TPU_CKPT_COMPACT_EVERY",
+        "BYTEWAX_TPU_CKPT_DELTA",
         "BYTEWAX_TPU_COMPILE_CACHE",
         "BYTEWAX_TPU_COORDINATOR",
         "BYTEWAX_TPU_DEMOTE_AFTER",
@@ -464,7 +507,7 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_TRACE_DIR",
         "BYTEWAX_TPU_WIRE",
     ]
-    assert len(contracts.KNOBS) == 53
+    assert len(contracts.KNOBS) == 56
     for name, (default, doc) in contracts.KNOBS.items():
         assert isinstance(default, str), name
         assert doc.startswith("docs/") and doc.endswith(".md"), name
