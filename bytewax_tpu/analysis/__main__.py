@@ -20,6 +20,7 @@ from typing import List, Optional
 from bytewax_tpu.analysis import api
 from bytewax_tpu.analysis.diagnostics import (
     format_diagnostics,
+    sarif_report,
     write_baseline,
 )
 from bytewax_tpu.analysis.rules import ALL_RULES
@@ -34,6 +35,8 @@ _RULE_DOC = {
     "BTX-DRAIN": "drain-only ops (evict/restore/flush/...) only at drain points",
     "BTX-THREAD": "the pipeline worker lane never reaches main-only state",
     "BTX-KNOB": "every BYTEWAX_TPU_* knob is cataloged + documented",
+    "BTX-LANE": "every DevicePipeline lane cataloged, fenced, truthfully phased",
+    "BTX-RACE": "worker/main shared attributes pinned in SHARED_STATE",
 }
 
 
@@ -99,6 +102,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json",
         action="store_true",
         help="emit diagnostics as JSON lines",
+    )
+    parser.add_argument(
+        "--output",
+        choices=("text", "sarif"),
+        default="text",
+        help=(
+            "findings format on stdout (default: text; sarif emits "
+            "one SARIF 2.1.0 document and overrides --json's "
+            "per-finding lines)"
+        ),
     )
     args = parser.parse_args(argv)
 
@@ -166,6 +179,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             for rid, secs in sorted(timings.items()):
                 print(f"{rid}\t{secs * 1e3:.1f} ms", file=sys.stderr)
 
+    ran_rules = rule_ids if rule_ids else list(ALL_RULES)
+
     if args.write_baseline:
         if baseline_path is None:
             print(
@@ -175,13 +190,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
             return 2
         write_baseline(baseline_path, diags)
+        if args.output == "sarif":
+            # Baselining and reporting compose: CI can snapshot the
+            # findings it is about to accept.
+            print(json.dumps(sarif_report(diags, {
+                rid: _RULE_DOC.get(rid, "") for rid in ran_rules
+            })))
         print(
             f"wrote {len(diags)} finding(s) to {baseline_path}",
             file=sys.stderr,
         )
         return 0
 
-    if args.json:
+    if args.output == "sarif":
+        print(json.dumps(sarif_report(diags, {
+            rid: _RULE_DOC.get(rid, "") for rid in ran_rules
+        })))
+    elif args.json:
         for d in diags:
             print(
                 json.dumps(

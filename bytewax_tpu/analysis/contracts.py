@@ -371,12 +371,14 @@ DRAIN_ONLY_METHODS = frozenset(
         # epoch-close entry (snapshots + the close sync ladder).
         "_close_epoch",
         "_close_epoch_inner",
-        # checkpoint seal + committer-lane fence (docs/recovery.md
-        # "Asynchronous incremental checkpoints"): the seal reads
-        # every step's epoch_snaps (worker-owned between submit and
-        # finalize) and the fence blocks on the committer lane.
+        # checkpoint seal + committer-lane fence/teardown
+        # (docs/recovery.md "Asynchronous incremental checkpoints"):
+        # the seal reads every step's epoch_snaps (worker-owned
+        # between submit and finalize), the fence blocks on the
+        # committer lane, and the shutdown tears its worker down.
         "_ckpt_seal",
         "_ckpt_fence",
+        "_ckpt_shutdown",
         # the route-accumulator flush (engine/wire.py): frames ship
         # and count ONLY at poll boundaries / drain points, so the
         # count-matched barrier sees exactly what left the process.
@@ -518,6 +520,7 @@ MAIN_ONLY = frozenset(
         "pipeline_flush",
         "pipeline_shutdown",
         "_pipe_shutdown",
+        "_ckpt_shutdown",
         "flush",
         "shutdown",
         "drop_pending",
@@ -614,6 +617,166 @@ SNAPSHOT_LANE_ROOTS = frozenset(
 )
 SNAPSHOT_LANE_MODULE = "bytewax_tpu.engine.recovery_store"
 SNAPSHOT_LANE_SAFE = frozenset({"write_epoch"})
+
+# ---------------------------------------------------------------------------
+# BTX-LANE — the off-main-thread lane catalog
+# ---------------------------------------------------------------------------
+
+#: Every ordered off-main-thread lane in the engine — one entry per
+#: ``DevicePipeline(...)`` construction site.  The rule proves, both
+#: ways (staleness included):
+#:
+#: - ``constructor``: the (module, qualname) of the function holding
+#:   the construction call.  Every construction site in the package
+#:   must be cataloged here, and every entry must still construct.
+#: - ``phase``: the ledger-phase string literal at the construction
+#:   site (absent kwarg = the ``"device"`` default).  A mismatch
+#:   silently mis-buckets worker seconds and breaks
+#:   ``derive_rescale_hint``'s fraction signals.
+#: - ``depth``: the max-in-flight bound as written at the site — an
+#:   integer literal, or None when knob-driven
+#:   (``BYTEWAX_TPU_PIPELINE_DEPTH``; the dispatch pipeline caps at 2
+#:   under a residency budget).
+#: - ``fence`` / ``shutdown``: the lane's drain and teardown
+#:   functions, each of which must be call-graph-reachable from every
+#:   pinned run-ending close in LANE_TEARDOWN_ROOTS — a lane nobody
+#:   fences at teardown loses its in-flight round on a stop or
+#:   reconfigure.
+LANES: Dict[str, Dict[str, object]] = {
+    "dispatch": {
+        "constructor": (
+            "bytewax_tpu.engine.driver",
+            "_StatefulBatchRt.__init__",
+        ),
+        "phase": "device",
+        "depth": None,
+        "fence": (
+            "bytewax_tpu.engine.driver",
+            "_StatefulBatchRt.pipeline_flush",
+        ),
+        "shutdown": (
+            "bytewax_tpu.engine.driver",
+            "_StatefulBatchRt._pipe_shutdown",
+        ),
+    },
+    "collective": {
+        "constructor": (
+            "bytewax_tpu.engine.sharded_state",
+            "GlobalAggState.__init__",
+        ),
+        "phase": "collective_lane",
+        "depth": 2,
+        "fence": (
+            "bytewax_tpu.engine.sharded_state",
+            "GlobalAggState.fence",
+        ),
+        "shutdown": (
+            "bytewax_tpu.engine.sharded_state",
+            "GlobalAggState.lane_shutdown",
+        ),
+    },
+    "checkpoint": {
+        "constructor": (
+            "bytewax_tpu.engine.driver",
+            "_Driver.__init__",
+        ),
+        "phase": "snapshot_lane",
+        "depth": 2,
+        "fence": (
+            "bytewax_tpu.engine.driver",
+            "_Driver._ckpt_fence",
+        ),
+        "shutdown": (
+            "bytewax_tpu.engine.driver",
+            "_Driver._ckpt_shutdown",
+        ),
+    },
+}
+
+#: The pinned run-ending closes: every lane's fence AND shutdown must
+#: be reachable from EACH of these over the call graph (plus the
+#: ``getattr(obj, "name")``-literal dispatch edges the teardown paths
+#: use), so no stop/reconfigure/demotion path can retire the runtime
+#: with a lane still holding work.
+LANE_TEARDOWN_ROOTS: FrozenSet[Tuple[str, str]] = frozenset(
+    {
+        # the run loop: the clean-exit fence, the startup-fault
+        # unwind, and the finally-block teardown all live here.
+        ("bytewax_tpu.engine.driver", "_Driver.run"),
+        # the stop/reconfigure agreed close (the run-ending close).
+        ("bytewax_tpu.engine.driver", "_Driver._close_epoch_inner"),
+        # device-tier demotion: the host tier takes over mid-run.
+        ("bytewax_tpu.engine.driver", "_StatefulBatchRt._demote"),
+    }
+)
+
+#: Sealed-task purity (BTX-LANE component d): attributes a lane task
+#: may transitively READ even though per-batch main-thread code
+#: writes them, each with the synchronization that makes it safe.
+#: Everything else a sealed task reads must be a local sealed at
+#: construction (that is the whole point of the seal) or an attribute
+#: only ordered points touch.  Key format ``module:Class.attr``.
+SEALED_CAPTURE_SAFE: Dict[str, str] = {}
+
+# ---------------------------------------------------------------------------
+# BTX-RACE — attribute-level worker/main shared-state inventory
+# ---------------------------------------------------------------------------
+
+#: Extra worker-side roots for the effect analysis: sealed device
+#: phases handed BACK to the driver as closures and submitted later
+#: through a variable the resolver cannot trace through return
+#: values.  Pinned here so their effects still count as worker-lane
+#: effects.  (The six ``DevicePipeline.push``/``submit`` roots are
+#: discovered from the submit sites themselves — see
+#: ``rules/thread.worker_lane_roots``.)
+RACE_WORKER_CARVEOUTS: FrozenSet[str] = frozenset(
+    {
+        "bytewax_tpu.engine.window_accel:"
+        "DeviceWindowAggState._ingest.<locals>.device_phase",
+        "bytewax_tpu.engine.driver:"
+        "_StatefulBatchRt._scan_batch.<locals>.batch_phase",
+    }
+)
+
+#: Attributes legitimately touched by BOTH the worker lane and
+#: per-batch main-thread code, each with a one-line justification of
+#: the synchronization that makes the sharing safe.  Any other
+#: attribute written on one side and read or written on the other is
+#: a BTX-RACE finding with dual witness chains.  Key format
+#: ``module:Class.attr`` (``module:<globals>.name`` for module
+#: globals).
+SHARED_STATE: Dict[str, str] = {
+    "bytewax_tpu.engine.arrays:KeyEncoder._ids": (
+        "instance-per-owner: source/router encoders mutate on main, "
+        "a device state's encoder mutates only inside its step's "
+        "ordered lane (main touches it at drain points only); the "
+        "attribute-level analysis is instance-insensitive"
+    ),
+    "bytewax_tpu.engine.arrays:KeyEncoder._sorted": (
+        "instance-per-owner: same ownership split as "
+        "KeyEncoder._ids — no encoder instance is ever shared "
+        "between the lane and per-batch main code"
+    ),
+    "bytewax_tpu.engine.driver:_OpRt._m_timers": (
+        "memoized tracing-timer handles: GIL-atomic dict get/set; a "
+        "racy miss creates one duplicate handle and drops it, never "
+        "corrupts"
+    ),
+    "bytewax_tpu.engine.flight:FlightRecorder._ring": (
+        "deliberately shared lock-free telemetry: deque.append is "
+        "thread-safe and readers copy racily "
+        "(docs/observability.md; the WORKER_SAFE append surface)"
+    ),
+    "bytewax_tpu.engine.flight:FlightRecorder.counters": (
+        "GIL-atomic dict adds, read racily by design (engine/flight "
+        "thread-safety note; the WORKER_SAFE append surface)"
+    ),
+    "bytewax_tpu.engine.wire:_Reader.off": (
+        "per-frame decode cursor: a fresh _Reader is constructed "
+        "inside every decode call and never escapes it — instances "
+        "never cross threads"
+    ),
+}
 
 # ---------------------------------------------------------------------------
 # BTX-KNOB — the BYTEWAX_TPU_* environment-knob catalog

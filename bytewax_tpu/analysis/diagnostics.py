@@ -31,6 +31,7 @@ __all__ = [
     "load_baseline",
     "write_baseline",
     "apply_baseline",
+    "sarif_report",
 ]
 
 WAIVER_MARK = "bytewax:"
@@ -170,3 +171,54 @@ def format_diagnostics(diags: Iterable[Diagnostic]) -> str:
     return "\n".join(
         d.render() for d in sorted(diags, key=Diagnostic.sort_key)
     )
+
+
+def sarif_report(
+    diags: Iterable[Diagnostic],
+    rule_docs: Dict[str, str],
+) -> dict:
+    """Findings as a SARIF 2.1.0 document (one run, one result per
+    finding).  ``rule_docs`` maps every rule id that RAN — not just
+    those that fired — to its one-line description, so a clean run
+    still advertises its rule inventory to SARIF consumers."""
+    results = [
+        {
+            "ruleId": d.rule,
+            "level": "error",
+            "message": {"text": d.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": d.path},
+                        "region": {"startLine": max(1, d.lineno)},
+                    }
+                }
+            ],
+        }
+        for d in sorted(diags, key=Diagnostic.sort_key)
+    ]
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "bytewax_tpu.analysis",
+                        "informationUri": "docs/contracts.md",
+                        "rules": [
+                            {
+                                "id": rid,
+                                "shortDescription": {"text": doc},
+                            }
+                            for rid, doc in sorted(rule_docs.items())
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }

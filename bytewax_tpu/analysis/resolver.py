@@ -55,6 +55,36 @@ MODULE_QUAL = "<module>"
 
 _SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
 
+#: Scope pruning for the per-function EFFECT sets: unlike the
+#: backward-compatible body lists, a nested def or lambda owns its own
+#: reads/writes (it runs on whatever thread it is handed to, not its
+#: encloser's), so lambdas prune too.
+_EFFECT_SCOPE_NODES = _SCOPE_NODES + (ast.Lambda,)
+
+#: Container-mutator method names: ``self.X.append(...)`` (and
+#: ``self.X[k] = v``) mutate the object held in ``X`` — for the
+#: effect sets that is a WRITE of ``X``, not a read (a race on the
+#: container is a race on the attribute that shares it).
+_MUTATOR_METHODS = frozenset(
+    {
+        "append",
+        "appendleft",
+        "extend",
+        "extendleft",
+        "insert",
+        "add",
+        "update",
+        "setdefault",
+        "pop",
+        "popleft",
+        "popitem",
+        "remove",
+        "discard",
+        "clear",
+        "put",
+    }
+)
+
 
 def _walk_pruned(node: ast.AST):
     """``ast.walk`` that does not descend into nested function/class
@@ -64,6 +94,19 @@ def _walk_pruned(node: ast.AST):
     while stack:
         child = stack.pop()
         if isinstance(child, _SCOPE_NODES):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _walk_effect_scope(node: ast.AST):
+    """Walk one function's OWN statements only: nested defs, lambdas
+    and class bodies are separate execution scopes with their own
+    :class:`FunctionInfo` entries and their own effect sets."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, _EFFECT_SCOPE_NODES):
             continue
         yield child
         stack.extend(ast.iter_child_nodes(child))
@@ -145,6 +188,10 @@ class FunctionInfo:
         "assigns",
         "call_nodes",
         "subscripts",
+        "self_reads",
+        "self_writes",
+        "global_decls",
+        "name_loads",
     )
 
     def __init__(
@@ -175,6 +222,20 @@ class FunctionInfo:
         #: ``ast.Subscript`` loads whose base is a name/attribute
         #: chain (environment-read detection and the like).
         self.subscripts: List[ast.Subscript] = []
+        #: Effect sets (BTX-LANE / BTX-RACE): attribute names this
+        #: function loads / stores on bare ``self``.  Scope-pruned —
+        #: nested defs and lambdas carry their OWN effects (they may
+        #: execute on a different thread than their encloser), unlike
+        #: the backward-compatible body lists above.  An augmented
+        #: assignment counts as a write (its read is implied).
+        self.self_reads: Set[str] = set()
+        self.self_writes: Set[str] = set()
+        #: Names this function declares ``global`` (the only way a
+        #: function WRITES a module global) and every bare name it
+        #: loads — the race rule intersects the loads with the
+        #: module's globally-mutated names to get global READS.
+        self.global_decls: Set[str] = set()
+        self.name_loads: Set[str] = set()
 
     @property
     def nested(self) -> bool:
@@ -324,6 +385,48 @@ class Project:
                 node.ctx, ast.Load
             ):
                 fn.subscripts.append(node)
+        # Second, scope-pruned pass for the effect sets: ``self.X``
+        # loads and stores belonging to THIS function only (nested
+        # defs/lambdas prune — they have their own FunctionInfo and
+        # may run on another thread).
+        for node in _walk_effect_scope(fn.node):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                if isinstance(node.ctx, ast.Load):
+                    fn.self_reads.add(node.attr)
+                else:
+                    fn.self_writes.add(node.attr)
+            elif isinstance(node, ast.Subscript) and not isinstance(
+                node.ctx, ast.Load
+            ):
+                # self.X[k] = v / del self.X[k]: a write of X.
+                base = node.value
+                if (
+                    isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                ):
+                    fn.self_writes.add(base.attr)
+            elif isinstance(node, ast.Call):
+                # self.X.append(...) and friends: a write of X.
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _MUTATOR_METHODS
+                    and isinstance(f.value, ast.Attribute)
+                    and isinstance(f.value.value, ast.Name)
+                    and f.value.value.id == "self"
+                ):
+                    fn.self_writes.add(f.value.attr)
+            elif isinstance(node, ast.Global):
+                fn.global_decls.update(node.names)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ):
+                fn.name_loads.add(node.id)
 
     # -- indexing ----------------------------------------------------------
 
@@ -670,6 +773,10 @@ class Project:
                 local = self._local_def(fn, callee.id)
                 if local is not None:
                     targets = {local.id}
+                else:
+                    bound = self._bound_alias_target(fn, callee.id)
+                    if bound is not None:
+                        targets = {bound.id}
             fn.calls.append(
                 CallSite(node, name, dotted, targets, fallback)
             )
@@ -685,6 +792,39 @@ class Project:
             target = cur.local_defs.get(name)
             if target is not None:
                 return target
+            cur = (
+                self.functions.get(cur.parent)
+                if cur.parent is not None
+                else None
+            )
+        return None
+
+    def _bound_alias_target(
+        self, fn: FunctionInfo, name: str
+    ) -> Optional[FunctionInfo]:
+        """A bound-method alias visible from ``fn`` under ``name``
+        (``m = self._meth`` in this function or an enclosing one,
+        with ``_meth`` a method of the owning class's MRO).  Without
+        this edge a worker task that binds a method to a local first
+        would vanish from the call graph — the exact smuggling shape
+        the effect-footprint rules must see."""
+        cur: Optional[FunctionInfo] = fn
+        while cur is not None:
+            for targets, value in cur.assigns:
+                if not (
+                    isinstance(value, ast.Attribute)
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id == "self"
+                    and cur.cls is not None
+                ):
+                    continue
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == name:
+                        target = self.class_method(
+                            f"{cur.module}:{cur.cls}", value.attr
+                        )
+                        if target is not None:
+                            return target
             cur = (
                 self.functions.get(cur.parent)
                 if cur.parent is not None

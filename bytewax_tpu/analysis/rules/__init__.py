@@ -27,6 +27,8 @@ from bytewax_tpu.analysis.rules import (
     snapshot,
     thread,
 )
+from bytewax_tpu.analysis.rules import lane, race  # noqa: E402 — import
+# after thread: both walk the worker lane it discovers.
 
 __all__ = ["ALL_RULES", "run_rules"]
 
@@ -40,6 +42,8 @@ ALL_RULES: Dict[str, Callable[[Project], List[Diagnostic]]] = {
     drain.RULE_ID: drain.check,
     thread.RULE_ID: thread.check,
     knobs.RULE_ID: knobs.check,
+    lane.RULE_ID: lane.check,
+    race.RULE_ID: race.check,
 }
 
 

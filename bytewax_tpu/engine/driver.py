@@ -3588,6 +3588,16 @@ class _Driver:
         if self._ckpt_lane is not None:
             self._ckpt_lane.flush()
 
+    def _ckpt_shutdown(self) -> None:
+        """Stop the committer lane's worker (idempotent).  Clean
+        exits fenced via ``_ckpt_fence`` already; a fault unwind
+        abandons the in-flight commit (it either already committed,
+        or its transaction rolled back — resume replays that one
+        epoch) and goes quiet before the store handle closes."""
+        if self._ckpt_lane is not None:
+            self._ckpt_lane.drop_pending()
+            self._ckpt_lane.shutdown()
+
     def _pump(self, timeout: float = 0.0) -> None:
         """Receive cluster messages: inject shipped data, apply
         control decisions.
@@ -4419,8 +4429,7 @@ class _Driver:
                 shutdown = getattr(rt, "pipeline_shutdown", None)
                 if shutdown is not None:
                     shutdown()
-            if self._ckpt_lane is not None:
-                self._ckpt_lane.shutdown()
+            self._ckpt_shutdown()
             if api_server is not None:
                 api_server.shutdown()
             if clustered:
@@ -4703,14 +4712,7 @@ class _Driver:
                 shutdown = getattr(rt, "pipeline_shutdown", None)
                 if shutdown is not None:
                     shutdown()
-            if self._ckpt_lane is not None:
-                # Clean exits fenced above; a fault unwind abandons
-                # the in-flight commit (it either already committed,
-                # or its transaction rolled back — resume replays
-                # that one epoch) and goes quiet before the store
-                # handle closes.
-                self._ckpt_lane.drop_pending()
-                self._ckpt_lane.shutdown()
+            self._ckpt_shutdown()
             if api_server is not None:
                 api_server.shutdown()
             if clustered:

@@ -150,6 +150,60 @@ def test_knob_rule_flags_uncataloged_and_computed_reads():
     assert len(diags) == 4
 
 
+def test_lane_rule_flags_uncataloged_construction():
+    diags = _diags("fixture_lane_uncataloged.py", ["BTX-LANE"])
+    # The module drains its lane and uses a cataloged phase — the ONE
+    # finding is catalog closure.
+    assert [d.rule for d in diags] == ["BTX-LANE"]
+    assert "un-cataloged lane" in diags[0].message
+    assert "SneakyStep.__init__" in diags[0].message
+    # The diagnostic lands on the construction line.
+    source = (FIXTURES / "fixture_lane_uncataloged.py").read_text()
+    assert "DevicePipeline(" in source.splitlines()[diags[0].lineno - 1]
+
+
+def test_lane_rule_flags_unfenced_module():
+    diags = _diags("fixture_lane_unfenced.py", ["BTX-LANE"])
+    msgs = "\n".join(d.message for d in diags)
+    # The module flushes but never tears down: the un-fenced finding
+    # names exactly the missing half.
+    unfenced = [d for d in diags if "un-fenced lane" in d.message]
+    assert unfenced, diags
+    assert ".shutdown()/.drop_pending()" in unfenced[0].message
+    assert ".flush()" not in unfenced[0].message
+    # (The un-cataloged finding fires too — the fixture lane is not
+    # in contracts.LANES either.)
+    assert "un-cataloged lane" in msgs
+
+
+def test_lane_rule_flags_unknown_ledger_phase():
+    diags = _diags("fixture_lane_phase.py", ["BTX-LANE"])
+    phase = [d for d in diags if "unknown ledger phase" in d.message]
+    assert phase, diags
+    assert "'turbo_lane'" in phase[0].message
+    # The message routes the reader to the observable consequence.
+    assert "ledger bucket" in phase[0].message
+
+
+def test_race_rule_flags_alias_smuggled_write():
+    diags = _diags("fixture_race_alias.py", ["BTX-RACE"])
+    assert [d.rule for d in diags] == ["BTX-RACE"]
+    msg = diags[0].message
+    assert "RacyStep._tally" in msg
+    # DUAL witness chains: the worker path resolves the bound-method
+    # alias into the write...
+    assert "RacyStep.process.<locals>.task -> RacyStep._bump" in msg
+    # ...and the main path shows the per-batch access.
+    assert "(via RacyStep.process" in msg
+    # No line inside the task spells a self-attribute store — only
+    # alias resolution can see the worker-side write.
+    source = (FIXTURES / "fixture_race_alias.py").read_text()
+    task = source[source.index("def task") : source.index("def finalize")]
+    assert "self._tally" not in task
+    # The diagnostic lands at the worker-side write site.
+    assert "def _bump" in source.splitlines()[diags[0].lineno - 1]
+
+
 def test_new_rule_waiver_round_trip(tmp_path):
     """Each new rule's finding is suppressed by an inline waiver on
     the flagged line — the same escape hatch the engine's deliberate
@@ -158,6 +212,8 @@ def test_new_rule_waiver_round_trip(tmp_path):
         "fixture_thread_worker_send.py": "BTX-THREAD",
         "fixture_drain_per_batch.py": "BTX-DRAIN",
         "fixture_knob_uncataloged.py": "BTX-KNOB",
+        "fixture_lane_uncataloged.py": "BTX-LANE",
+        "fixture_race_alias.py": "BTX-RACE",
     }
     for name, rule in cases.items():
         diags = _diags(name, [rule])

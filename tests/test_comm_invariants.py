@@ -289,6 +289,10 @@ def test_drain_point_inventory_is_pinned():
         # at the pinned drain points.
         "_ckpt_seal",
         "_ckpt_fence",
+        # The lane-contract PR: the committer lane's teardown joins
+        # the worker thread — run-ending closes only, like
+        # _pipe_shutdown.
+        "_ckpt_shutdown",
     }
     assert contracts.PIPELINE_DRAIN_METHODS == {
         "flush",
@@ -391,6 +395,7 @@ def test_worker_lane_inventory_is_pinned():
         "push",
         "submit",
         "_close_epoch",
+        "_ckpt_shutdown",
     ):
         assert name in contracts.MAIN_ONLY, name
     assert contracts.MAIN_ONLY_MODULES == {
@@ -416,6 +421,111 @@ def test_worker_lane_inventory_is_pinned():
         == "bytewax_tpu.engine.pipeline.DevicePipeline"
     )
     diags = _check(["BTX-THREAD"])
+    assert not diags, format_diagnostics(diags)
+
+
+def test_lane_catalog_is_pinned():
+    """The lane contract (docs/contracts.md BTX-LANE): exactly
+    today's three ordered off-main-thread lanes — the per-step
+    dispatch pipeline, the collective exchange lane, the checkpoint
+    committer lane — each pinned with its constructor, ledger phase,
+    max-in-flight bound, and fence + shutdown functions.  Adding a
+    lane requires updating contracts.LANES, this test, and the
+    "adding a lane" recipe in docs/contracts.md in one change; the
+    rule itself proves the catalog is not stale (every entry still
+    constructs, every fence/shutdown still reachable from the pinned
+    run-ending closes)."""
+    driver = "bytewax_tpu.engine.driver"
+    sharded = "bytewax_tpu.engine.sharded_state"
+    assert contracts.LANES == {
+        "dispatch": {
+            "constructor": (driver, "_StatefulBatchRt.__init__"),
+            "phase": "device",
+            "depth": None,  # knob-driven (BYTEWAX_TPU_PIPELINE_DEPTH)
+            "fence": (driver, "_StatefulBatchRt.pipeline_flush"),
+            "shutdown": (driver, "_StatefulBatchRt._pipe_shutdown"),
+        },
+        "collective": {
+            "constructor": (sharded, "GlobalAggState.__init__"),
+            "phase": "collective_lane",
+            "depth": 2,
+            "fence": (sharded, "GlobalAggState.fence"),
+            "shutdown": (sharded, "GlobalAggState.lane_shutdown"),
+        },
+        "checkpoint": {
+            "constructor": (driver, "_Driver.__init__"),
+            "phase": "snapshot_lane",
+            "depth": 2,
+            "fence": (driver, "_Driver._ckpt_fence"),
+            "shutdown": (driver, "_Driver._ckpt_shutdown"),
+        },
+    }
+    assert contracts.LANE_TEARDOWN_ROOTS == {
+        (driver, "_Driver.run"),
+        (driver, "_Driver._close_epoch_inner"),
+        (driver, "_StatefulBatchRt._demote"),
+    }
+    # Every cataloged ledger phase must be documented in
+    # docs/observability.md's phase table — the buckets feed
+    # derive_rescale_hint, and an observer can only read buckets the
+    # doc names.
+    import pathlib
+
+    obs = (
+        pathlib.Path(__file__).resolve().parent.parent
+        / "docs"
+        / "observability.md"
+    ).read_text()
+    for name, info in contracts.LANES.items():
+        assert f"`{info['phase']}`" in obs, (
+            f"lane {name!r}: phase {info['phase']!r} missing from "
+            "docs/observability.md's phase table"
+        )
+    diags = _check(["BTX-LANE"])
+    assert not diags, format_diagnostics(diags)
+
+
+def test_shared_state_inventory_is_pinned():
+    """The shared-state contract (docs/contracts.md BTX-RACE):
+    exactly today's six worker/main shared attributes, each with a
+    synchronization justification, plus the sealed-capture and
+    worker-carve-out inventories.  An attribute enters SHARED_STATE
+    only with its justification here AND in contracts.py AND a
+    re-check of the docs — never silently."""
+    assert set(contracts.SHARED_STATE) == {
+        # instance-per-owner: no KeyEncoder crosses tiers.
+        "bytewax_tpu.engine.arrays:KeyEncoder._ids",
+        "bytewax_tpu.engine.arrays:KeyEncoder._sorted",
+        # GIL-atomic memoization; duplicate handles are benign.
+        "bytewax_tpu.engine.driver:_OpRt._m_timers",
+        # the deliberately-shared lock-free telemetry surface
+        # (engine/flight thread-safety note; WORKER_SAFE).
+        "bytewax_tpu.engine.flight:FlightRecorder._ring",
+        "bytewax_tpu.engine.flight:FlightRecorder.counters",
+        # per-frame decode cursor; instances never escape one call.
+        "bytewax_tpu.engine.wire:_Reader.off",
+    }
+    for key, why in contracts.SHARED_STATE.items():
+        assert why.strip(), f"SHARED_STATE entry {key} lacks its " \
+            "one-line synchronization justification"
+    # Sealed-task purity holds on the tree with NO exceptions today:
+    # every value a lane task consumes is sealed at submit.  The
+    # inventory exists for the day that changes — extending it means
+    # editing contracts.py AND this test.
+    assert contracts.SEALED_CAPTURE_SAFE == {}
+    # The two sealed device phases handed back as closures (the
+    # resolver cannot trace callables through return values).
+    assert contracts.RACE_WORKER_CARVEOUTS == {
+        "bytewax_tpu.engine.window_accel:"
+        "DeviceWindowAggState._ingest.<locals>.device_phase",
+        "bytewax_tpu.engine.driver:"
+        "_StatefulBatchRt._scan_batch.<locals>.batch_phase",
+    }
+    # Staleness guard: every pinned carve-out root still exists.
+    project = _project()
+    for fid in contracts.RACE_WORKER_CARVEOUTS:
+        assert fid in project.functions, fid
+    diags = _check(["BTX-RACE"])
     assert not diags, format_diagnostics(diags)
 
 

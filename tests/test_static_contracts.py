@@ -49,6 +49,8 @@ def test_rule_registry_is_complete():
         "BTX-DRAIN",
         "BTX-THREAD",
         "BTX-KNOB",
+        "BTX-LANE",
+        "BTX-RACE",
     }
 
 
@@ -160,6 +162,10 @@ def test_cli_exits_nonzero_on_each_new_rule_fixture():
         ("fixture_drain_per_batch.py", "BTX-DRAIN"),
         ("fixture_thread_worker_send.py", "BTX-THREAD"),
         ("fixture_knob_uncataloged.py", "BTX-KNOB"),
+        ("fixture_lane_uncataloged.py", "BTX-LANE"),
+        ("fixture_lane_unfenced.py", "BTX-LANE"),
+        ("fixture_lane_phase.py", "BTX-LANE"),
+        ("fixture_race_alias.py", "BTX-RACE"),
     ):
         res = subprocess.run(
             [
@@ -219,3 +225,73 @@ def test_cli_rule_filter_json_and_timings():
     assert timing_lines and "BTX-KNOB" in timing_lines[0]["timings_s"]
     # Only the requested rule ran.
     assert "BTX-SEND" not in timing_lines[0]["timings_s"]
+
+
+def test_cli_sarif_output(tmp_path):
+    """--output sarif emits one SARIF 2.1.0 document and composes
+    with --rule (rule inventory reflects what ran) and
+    --write-baseline (the document is still emitted alongside the
+    baseline write)."""
+    import json
+
+    fixture = (
+        REPO / "tests" / "analysis_fixtures" / "fixture_race_alias.py"
+    )
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.analysis",
+            "--rule",
+            "BTX-RACE",
+            "--output",
+            "sarif",
+            str(fixture),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "bytewax_tpu.analysis"
+    # The rule inventory is what RAN, not what fired.
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == [
+        "BTX-RACE"
+    ]
+    (result,) = run["results"]
+    assert result["ruleId"] == "BTX-RACE"
+    assert result["level"] == "error"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith(
+        "fixture_race_alias.py"
+    )
+    assert loc["region"]["startLine"] > 0
+    # --write-baseline still emits the document (and exits 0).
+    baseline = tmp_path / "BASELINE"
+    res2 = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.analysis",
+            "--rule",
+            "BTX-RACE",
+            "--output",
+            "sarif",
+            "--write-baseline",
+            "--baseline",
+            str(baseline),
+            str(fixture),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert res2.returncode == 0, res2.stdout + res2.stderr
+    doc2 = json.loads(res2.stdout)
+    assert len(doc2["runs"][0]["results"]) == 1
+    assert baseline.exists()
