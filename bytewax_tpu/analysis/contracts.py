@@ -442,11 +442,15 @@ DRAIN_POINT_METHOD_NAMES = frozenset(
 #: - GlobalAggState.flush: the collective tier never enters the
 #:   per-delivery dispatch pipeline, and its only caller (pre_close)
 #:   flushes every pipeline first — the driver also drains all ops
-#:   before the pre_close pass at epoch close.  Since the
-#:   overlapped-collectives PR it ALSO fences its own exchange lane
-#:   (``self.fence()``) lexically before the rounds — the resolver's
-#:   flush walk can't see through that indirection, hence the pin
-#:   stays, with both orderings re-checked here.
+#:   before the pre_close pass at epoch close.  Since the depth-ladder
+#:   PR its own exchange lane is bounded by ``DevicePipeline.push``'s
+#:   ``make_room`` instead of a lexical ``fence()`` (depth 1 retires
+#:   the previous round before the next seals — byte-identical to the
+#:   old fence-first ordering; depth D allows D sealed rounds in
+#:   flight, retired in order) — the resolver's flush walk can't see
+#:   through that indirection, hence the pin stays, with the lane
+#:   ordering re-checked here and full drains pinned at finalize /
+#:   the run-ending closes via BTX-LANE.
 #: - _Driver.run / _Driver._startup_rescale: run-startup rounds
 #:   ("fcfg", "rescaled") fire before any delivery has been
 #:   dispatched, so no pipeline can hold work yet.
@@ -635,8 +639,11 @@ SNAPSHOT_LANE_SAFE = frozenset({"write_epoch"})
 #:   ``derive_rescale_hint``'s fraction signals.
 #: - ``depth``: the max-in-flight bound as written at the site — an
 #:   integer literal, or None when knob-driven
-#:   (``BYTEWAX_TPU_PIPELINE_DEPTH``; the dispatch pipeline caps at 2
-#:   under a residency budget).
+#:   (``BYTEWAX_TPU_PIPELINE_DEPTH`` for the dispatch pipeline, which
+#:   caps at 2 under a residency budget;
+#:   ``BYTEWAX_TPU_GSYNC_DEPTH`` for the collective exchange lane,
+#:   whose site passes ``_gsync_depth() + 1`` so depth 1 keeps the
+#:   original one-round-in-flight behavior).
 #: - ``fence`` / ``shutdown``: the lane's drain and teardown
 #:   functions, each of which must be call-graph-reachable from every
 #:   pinned run-ending close in LANE_TEARDOWN_ROOTS — a lane nobody
@@ -665,7 +672,7 @@ LANES: Dict[str, Dict[str, object]] = {
             "GlobalAggState.__init__",
         ),
         "phase": "collective_lane",
-        "depth": 2,
+        "depth": None,
         "fence": (
             "bytewax_tpu.engine.sharded_state",
             "GlobalAggState.fence",
@@ -771,11 +778,6 @@ SHARED_STATE: Dict[str, str] = {
         "GIL-atomic dict adds, read racily by design (engine/flight "
         "thread-safety note; the WORKER_SAFE append surface)"
     ),
-    "bytewax_tpu.engine.wire:_Reader.off": (
-        "per-frame decode cursor: a fresh _Reader is constructed "
-        "inside every decode call and never escapes it — instances "
-        "never cross threads"
-    ),
 }
 
 # ---------------------------------------------------------------------------
@@ -825,6 +827,8 @@ KNOBS: Dict[str, Tuple[str, str]] = {
         "0",
         "docs/configuration.md",
     ),
+    "BYTEWAX_TPU_GSYNC_BASELINE_EVERY": ("8", "docs/recovery.md"),
+    "BYTEWAX_TPU_GSYNC_DEPTH": ("1", "docs/performance.md"),
     "BYTEWAX_TPU_GSYNC_OVERLAP": ("0", "docs/performance.md"),
     "BYTEWAX_TPU_GSYNC_QUANT": ("off", "docs/performance.md"),
     "BYTEWAX_TPU_HB_S": ("0", "docs/recovery.md"),

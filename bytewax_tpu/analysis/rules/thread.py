@@ -34,8 +34,8 @@ over-approximation.  The tier's OWN overlapped exchange lane
 those roots ARE traced (their direct calls must stay clean), while
 their edges back into the owning class fall under the same
 exclusion: the lane is fenced at the ordered points, and everything
-it touches (``_fields``/``_host_fields``) is lane-owned between seal
-and fence by construction.
+it touches (``_fields``/``_host_fields``/``_dev_fields``) is
+lane-owned between seal and fence by construction.
 
 The asynchronous-checkpoint committer lane gets a root-scoped
 carve-out (``contracts.SNAPSHOT_LANE_ROOTS``; docs/recovery.md

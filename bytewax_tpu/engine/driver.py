@@ -4137,6 +4137,20 @@ class _Driver:
             ),
         }
 
+    def _collective_lane_status(self) -> Optional[Dict[str, int]]:
+        """The global tier's exchange-lane window for ``/status`` and
+        ``/graph`` (read racily — observability): ``in_flight`` sealed
+        rounds on the collective lane and the configured ``depth``
+        bound (``BYTEWAX_TPU_GSYNC_DEPTH``).  None when no step runs
+        on the collective tier or overlap is off."""
+        for rt in self.rts:
+            agg = getattr(rt, "agg", None)
+            if getattr(agg, "global_exchange", False):
+                status = agg.lane_status()
+                if status is not None:
+                    return status
+        return None
+
     def _status(self) -> Dict[str, Any]:
         """Live ``GET /status`` document (read racily off the API
         server thread — observability, not the epoch protocol)."""
@@ -4205,6 +4219,12 @@ class _Driver:
             "ledger": {
                 "last": _flight.RECORDER.last_ledger,
                 "recent": _flight.RECORDER.ledgers(8),
+                # The collective exchange lane's live window: in-flight
+                # sealed rounds and the configured depth bound
+                # (BYTEWAX_TPU_GSYNC_DEPTH).  None when no global tier
+                # (or no overlap lane) is active.  Racy read, like
+                # every other field here.
+                "collective_lane": self._collective_lane_status(),
                 # API-server thread: copy-with-retry, the main thread
                 # inserts new phase keys mid-iteration otherwise.
                 "phase_totals": {
@@ -4237,6 +4257,7 @@ class _Driver:
         # Live tier overlay: the static plan cannot see the
         # collective global-exchange state or runtime demotions.
         tiers: Dict[str, str] = {}
+        lanes: Dict[str, Optional[Dict[str, int]]] = {}
         for rt in self.rts:
             if getattr(rt, "demoted", None):
                 tiers[rt.op.step_id] = "host"
@@ -4244,8 +4265,13 @@ class _Driver:
                 getattr(rt, "agg", None), "global_exchange", False
             ):
                 tiers[rt.op.step_id] = "collective"
+                # The exchange lane's live window rides the
+                # tier=collective record (None = overlap off).
+                lanes[rt.op.step_id] = rt.agg.lane_status()
         for node in topo["steps"]:
             node["tier"] = tiers.get(node["step_id"], node["tier"])
+            if node["step_id"] in lanes:
+                node["collective_lane"] = lanes[node["step_id"]]
         sources: Dict[str, Any] = {}
         local = _flowmap.FLOWMAP.summary()
         if local is not None:

@@ -52,6 +52,16 @@ __all__ = [
 _MIN_CAP_PER_SHARD = 128
 _MIN_ROWS_PER_SHARD = 64
 
+#: Store row-key prefixes of the global tier's own recovery rows
+#: (store-composable overlap, docs/recovery.md): NUL-prefixed so they
+#: can never collide with user keys that happen to look similar.
+#: Rows ride the EXISTING recovery ``snaps`` format — the keys are
+#: salted per process (``_mine_local_key``) so route-scoped resume
+#: reads deliver each process exactly its own rows.
+_GSYNC_KEY_PREFIX = "\x00gsync-"
+_GSYNC_BASE_KEY = "\x00gsync-base\x00"
+_GSYNC_ROUND_KEY = "\x00gsync-round\x00"
+
 
 def _discard_result(_res) -> None:
     """Collective-lane finalize: the sealed exchange task mutates the
@@ -67,6 +77,46 @@ def _gsync_overlap() -> bool:
         "",
         "0",
     )
+
+
+def _gsync_depth() -> int:
+    """How many overlapped exchange rounds may be in flight on the
+    collective lane (``BYTEWAX_TPU_GSYNC_DEPTH``, default 1 — the
+    double-buffered behavior the overlap shipped with; higher values
+    let the sealed rounds of several epoch closes ladder behind the
+    compute frontier; docs/performance.md "Overlapped collectives").
+    Only read under ``BYTEWAX_TPU_GSYNC_OVERLAP=1`` — lock-step runs
+    never construct the lane."""
+    raw = os.environ.get("BYTEWAX_TPU_GSYNC_DEPTH", "1") or "1"
+    try:
+        depth = int(raw)
+    except ValueError:
+        msg = (
+            f"BYTEWAX_TPU_GSYNC_DEPTH={raw!r} is not an integer; use "
+            "the in-flight exchange-round bound (1 = double-buffered)"
+        )
+        raise ValueError(msg) from None
+    return max(1, depth)
+
+
+def _gsync_baseline_every() -> int:
+    """With a recovery store under ``BYTEWAX_TPU_GSYNC_OVERLAP=1``,
+    how many data-bearing exchange rounds ride between full-aggregate
+    baseline snapshots (``BYTEWAX_TPU_GSYNC_BASELINE_EVERY``, default
+    8): resume replays at most this many sealed rounds on top of the
+    latest baseline (docs/recovery.md "Store-composable overlap")."""
+    raw = (
+        os.environ.get("BYTEWAX_TPU_GSYNC_BASELINE_EVERY", "8") or "8"
+    )
+    try:
+        every = int(raw)
+    except ValueError:
+        msg = (
+            f"BYTEWAX_TPU_GSYNC_BASELINE_EVERY={raw!r} is not an "
+            "integer; use the rounds-per-baseline cadence"
+        )
+        raise ValueError(msg) from None
+    return max(1, every)
 
 
 def _shard_devices() -> Optional[list]:
@@ -114,7 +164,10 @@ def make_agg_state(kind: str, driver=None):
     - **global-mesh exchange** (``GlobalAggState``) when the jax
       distributed runtime spans the cluster's processes
       (``BYTEWAX_TPU_DISTRIBUTED=1``) and the flow has no recovery
-      store: keyed rows stay on the process that ingested them until
+      store — or has one AND ``BYTEWAX_TPU_GSYNC_OVERLAP=1`` is
+      armed (store-composable overlap, docs/recovery.md: the tier
+      snapshots its sealed rounds in recovery ``snaps`` row format):
+      keyed rows stay on the process that ingested them until
       epoch close, then ONE collective ``all_to_all`` over the global
       device mesh (ICI/DCN) routes and folds them — the host TCP mesh
       carries only control-plane metadata.  Opt out with
@@ -125,7 +178,7 @@ def make_agg_state(kind: str, driver=None):
     if (
         driver is not None
         and driver.comm is not None
-        and driver.store is None
+        and (driver.store is None or _gsync_overlap())
         and os.environ.get("BYTEWAX_TPU_DISTRIBUTED") == "1"
         and os.environ.get("BYTEWAX_TPU_GLOBAL_EXCHANGE", "1") != "0"
     ):
@@ -1039,29 +1092,57 @@ class GlobalAggState:
         #: Whether every merged flush so far was all-integer (quant
         #: mode emits ints then, matching the exact tier's int lock).
         self._quant_int = True
+        #: Device-resident merge tables (quant mode, docs/performance.md
+        #: "Overlapped collectives"): peer partial frames upload at
+        #: wire width and dequantize+merge+scatter in HBM
+        #: (engine/xla.py ``agg_merge_fn``), so the merged aggregate
+        #: never leaves HBM between closes.  ``_merge_demoted`` pins
+        #: the host-side ``decode_agg`` fold instead — the
+        #: ``BYTEWAX_TPU_WIRE=pickle``-era fallback and the oracle in
+        #: tests — and flips sticky when an exact integer part cannot
+        #: ride the device's int32 tables (deterministic: every
+        #: process folds identical frames).
+        self._dev_fields: Optional[Dict[str, Any]] = None
+        self._merge_demoted = _wire.wire_mode() == "pickle"
+        #: Store-composable overlap (docs/recovery.md): with a
+        #: recovery store, every data-bearing round stashes a sealed
+        #: round row (and every ``BYTEWAX_TPU_GSYNC_BASELINE_EVERY``
+        #: rounds, a fenced full-aggregate baseline row) in recovery
+        #: ``snaps`` format; resume replays baseline + tail rounds.
+        self._data_rounds = 0
+        self._outstanding_rounds: List[str] = []
+        self._pending_snap_rows: List[Tuple[str, Any]] = []
+        self._resume_rows: List[Tuple[str, Any]] = []
+        self._base_written = False
         #: Overlapped exchange lane (docs/performance.md "Overlapped
         #: collectives"): with ``BYTEWAX_TPU_GSYNC_OVERLAP=1`` the
-        #: sealed exchange for epoch N runs on this ordered
-        #: single-worker lane while the run loop computes epoch N+1;
-        #: only the NEXT flush (and any read of the global result)
-        #: fences on it.  The lane is ONE per driver, shared by every
-        #: global-exchange step: seal order is the agreed round order
-        #: (pre_close iterates steps identically everywhere, and each
-        #: flush fences the shared lane first), so the collective
+        #: sealed exchange for an epoch's close runs on this ordered
+        #: single-worker lane while the run loop computes later
+        #: epochs.  The lane bounds its own in-flight window: at the
+        #: configured ``BYTEWAX_TPU_GSYNC_DEPTH`` (default 1 =
+        #: double-buffered), ``push``'s ``make_room`` retires the
+        #: oldest sealed round before admitting a new one, so at most
+        #: DEPTH rounds ride between the compute frontier and the
+        #: fences (finalize, baselines, the run-ending close).  The
+        #: lane is ONE per driver, shared by every global-exchange
+        #: step: seal order is the agreed round order (pre_close
+        #: iterates steps identically everywhere), so the collective
         #: programs still launch in an identical sequence
-        #: cluster-wide — one epoch behind the compute frontier.
-        #: Per-step lanes would break exactly that: two steps' rounds
-        #: on independent worker threads could launch their
-        #: collectives in a different relative order on each process.
-        #: Off (the default) keeps the lock-step tier byte-identical:
-        #: no lane is ever constructed.
+        #: cluster-wide — up to DEPTH epochs behind the compute
+        #: frontier.  Per-step lanes would break exactly that: two
+        #: steps' rounds on independent worker threads could launch
+        #: their collectives in a different relative order on each
+        #: process.  Off (the default) keeps the lock-step tier
+        #: byte-identical: no lane is ever constructed.
         self._lane = None
         if _gsync_overlap():
             if getattr(driver, "_gsync_lane", None) is None:
                 from bytewax_tpu.engine.pipeline import DevicePipeline
 
                 driver._gsync_lane = DevicePipeline(
-                    "gsync", depth=2, phase="collective_lane"
+                    "gsync",
+                    depth=_gsync_depth() + 1,
+                    phase="collective_lane",
                 )
             self._lane = driver._gsync_lane
 
@@ -1251,19 +1332,31 @@ class GlobalAggState:
 
     def fence(self) -> None:
         """Wait out every in-flight overlapped exchange round on the
-        (driver-shared) collective lane.  The only fences
-        (docs/performance.md "Overlapped collectives"): the NEXT
-        flush (epoch N+1's close), any read of the global result
-        (finalize/EOF), and the run-ending close — nothing per-batch
-        ever blocks here.  With several global-exchange steps in one
-        flow, a later step's same-close flush drains the earlier
-        step's just-sealed round too (shared lane): rounds then
-        overlap only past the LAST step's seal — correct for any
-        step count, fully overlapped for the common single-step
-        flow, and crucially launch-ordered identically on every
-        process."""
+        (driver-shared) collective lane.  The only FULL drains
+        (docs/performance.md "Overlapped collectives"): any read of
+        the global result (finalize/EOF), a baseline snapshot, and
+        the run-ending close — nothing per-batch ever blocks here.
+        A flush no longer drains the lane wholesale: ``push`` bounds
+        the in-flight window itself (``make_room`` retires the
+        oldest sealed round once ``BYTEWAX_TPU_GSYNC_DEPTH`` rounds
+        ride the lane), so the depth ladder keeps up to DEPTH sealed
+        rounds behind the compute frontier with ordered
+        retirement — at the default depth 1 that is exactly the
+        original fence-every-flush behavior."""
         if self._lane is not None:
             self._lane.flush()
+
+    def lane_status(self) -> Optional[Dict[str, int]]:
+        """Collective-lane introspection for /status and /graph
+        (docs/observability.md): sealed rounds currently in flight
+        and the configured overlap depth.  None when the lock-step
+        tier runs (no lane constructed)."""
+        if self._lane is None:
+            return None
+        return {
+            "in_flight": len(self._lane),
+            "depth": self._lane.depth - 1,
+        }
 
     def lane_shutdown(self) -> None:
         """Teardown (driver ``pipeline_shutdown``, fault unwinds):
@@ -1316,22 +1409,23 @@ class GlobalAggState:
         collective lane — the metadata rounds still run HERE, at the
         globally-ordered point, so every process executes the
         identical sequence of sync rounds and seals the identical
-        sequence of collective programs, one epoch behind the compute
-        frontier.  With ``BYTEWAX_TPU_GSYNC_QUANT`` armed, buffered
-        rows pre-reduce locally per key and quantized
-        partial-aggregate frames ride the metadata round
-        (engine/wire.py) instead of raw rows riding the device
-        all_to_all; the merge is a host-side fold of the decoded
-        partials."""
+        sequence of collective programs, up to
+        ``BYTEWAX_TPU_GSYNC_DEPTH`` epochs behind the compute
+        frontier (``push`` itself retires the oldest round once the
+        window is full — no wholesale fence per flush).  With
+        ``BYTEWAX_TPU_GSYNC_QUANT`` armed, buffered rows pre-reduce
+        locally per key and quantized partial-aggregate frames ride
+        the metadata round (engine/wire.py) instead of raw rows
+        riding the device all_to_all; the merge is sealed on the
+        main thread (scatter targets resolved against the main-owned
+        ``key_to_kid``) and folds on device
+        (dequant+merge+scatter in HBM, engine/xla.py) — or
+        host-side under the ``BYTEWAX_TPU_WIRE=pickle`` fallback."""
         import jax
         import jax.numpy as jnp
 
         driver = self.driver
-        # Fence first: the previous epoch's overlapped round must
-        # complete before this close launches the next one (one round
-        # in flight at a time — the lane's task order IS the round
-        # order every process agrees on).
-        self.fence()
+        self._maybe_replay_resume()
         n_local = int(sum(len(a) for a in self._buf_vals))
         local_new = sorted(
             k for k in self._dense_keys if k not in self.key_to_kid
@@ -1366,18 +1460,22 @@ class GlobalAggState:
             self._buf_ids.clear()
             self._buf_vals.clear()
             return
+        self._data_rounds += 1
         if quant != "off":
-            # Quantized host exchange: the partial frames already
-            # rode the round; seal the (deterministically ordered)
-            # merge and launch it.
+            # Quantized exchange: the partial frames already rode the
+            # round; seal the (deterministically ordered) merge ON
+            # MAIN — frame decode and scatter-target resolution
+            # against the main-owned ``key_to_kid`` — and launch the
+            # fold (device or host per the sealed decision).
             self._buf_ids.clear()
             self._buf_vals.clear()
             self._quant_int = self._quant_int and all_int
             peer_frames = [replies[pid][4] for pid in sorted(replies)]
             n_frames = sum(len(f or ()) for f in peer_frames)
+            sealed = self._seal_merge(peer_frames)
 
             def merge_task():
-                self._merge_partials(peer_frames)
+                self._apply_merge(sealed)
 
             # Launch: inline (lock-step) or on the overlapped lane —
             # the direct push site is what BTX-THREAD traces.
@@ -1385,11 +1483,22 @@ class GlobalAggState:
                 merge_task()
             else:
                 self._lane.push(merge_task, _discard_result)
+            where = "host" if sealed["device"] is False else "device"
             self._note_flush(
                 n_local,
                 total_rows,
                 1,
-                f"{n_frames} quantized partial frame(s) [{quant}]",
+                f"{n_frames} quantized partial frame(s) "
+                f"[{quant}, {where} merge]",
+            )
+            self._stash_round(
+                lambda: {
+                    "fmt": "quant",
+                    "round": self._data_rounds,
+                    "frames": peer_frames,
+                    "new": merged_new,
+                    "all_int": all_int,
+                }
             )
             return
         if self.dtype is None:
@@ -1476,25 +1585,21 @@ class GlobalAggState:
         )
         step = self._step_for(chunk_pd, capacity)
         global_rows = chunk_pd * self.n_shards
-        sharding = self._sharding
         val_dtype = np.dtype(self.dtype)
 
         def exchange_task():
             # Sealed device phase: identical program sequence on every
             # process's lane (seal order is the agreed round order).
-            def garr(local, dtype):
-                return jax.make_array_from_process_local_data(
-                    sharding, local.astype(dtype), (global_rows,)
-                )
-
-            for c in range(n_steps):
-                sl = slice(c * chunk_rows, (c + 1) * chunk_rows)
-                self._fields = step(
-                    self._fields,
-                    garr(kids_p[sl], np.int32),
-                    garr(vals_p[sl], val_dtype),
-                    garr(valid_p[sl], bool),
-                )
+            self._exchange_chunks(
+                step,
+                kids_p,
+                vals_p,
+                valid_p,
+                chunk_rows,
+                n_steps,
+                global_rows,
+                val_dtype,
+            )
 
         if self._lane is None:
             exchange_task()
@@ -1503,6 +1608,50 @@ class GlobalAggState:
         self._note_flush(
             n_local, total_rows, n_steps, f"capacity {capacity}"
         )
+        self._stash_round(
+            lambda: {
+                "fmt": "exact",
+                "round": self._data_rounds,
+                "kids": kids,
+                "vals": vals_cat,
+                "new": merged_new,
+                "chunk_pd": chunk_pd,
+                "capacity": capacity,
+                "n_steps": n_steps,
+                "dtype": np.dtype(self.dtype).name,
+            }
+        )
+
+    def _exchange_chunks(
+        self,
+        step,
+        kids_p: np.ndarray,
+        vals_p: np.ndarray,
+        valid_p: np.ndarray,
+        chunk_rows: int,
+        n_steps: int,
+        global_rows: int,
+        val_dtype,
+    ) -> None:
+        """Run one sealed exchange round's chunk sequence (the device
+        phase shared by the flush task and resume replay)."""
+        import jax
+
+        sharding = self._sharding
+
+        def garr(local, dtype):
+            return jax.make_array_from_process_local_data(
+                sharding, local.astype(dtype), (global_rows,)
+            )
+
+        for c in range(n_steps):
+            sl = slice(c * chunk_rows, (c + 1) * chunk_rows)
+            self._fields = step(
+                self._fields,
+                garr(kids_p[sl], np.int32),
+                garr(vals_p[sl], val_dtype),
+                garr(valid_p[sl], bool),
+            )
 
     def _local_partial_frames(self) -> List[bytes]:
         """Pre-reduce this process's buffered rows per key and frame
@@ -1550,25 +1699,49 @@ class GlobalAggState:
             cols[name] = arr
         return _wire.encode_agg(cols, self._quant)
 
-    def _merge_partials(self, frames_by_proc: List[Any]) -> None:
-        """Merge every process's decoded partial frames into the
-        host-side field blocks (the quantized exchange; runs on the
-        collective lane under overlap).  Every process iterates peers
-        in the same sorted order, so the merged floats are identical
-        cluster-wide — same values, same addition order."""
-        if self._host_fields is None:
-            size = self.n_shards * self.cap_per_shard
-            self._host_fields = {
-                name: np.full(size, init, dtype=np.float64)
-                for name, (init, _op) in self.kind.fields.items()
-            }
-        kid_map = self.key_to_kid
-        for frames in frames_by_proc:
+    def _merge_dtype(self, name: str) -> str:
+        """Device merge-table dtype for one field: ``count`` (exact
+        by contract) and every field while the cluster-agreed all-int
+        lock holds fold on int32 tables (bit-identical to the host
+        f64 oracle); once any peer ships floats the value fields
+        promote to float32 — the dequantized wire width."""
+        if name == "count" or self._quant_int:
+            return "int32"
+        return "float32"
+
+    def _seal_merge(self, peer_frames: List[Any]) -> Dict[str, Any]:
+        """Seal one quantized round's merge ON MAIN: decode every
+        peer frame's raw parts (engine/wire.py ``decode_agg_parts``)
+        and resolve scatter targets against the main-owned
+        ``key_to_kid`` — the sealed task never reads main state
+        (BTX-RACE).  Decides device-vs-host per the sticky
+        ``_merge_demoted`` flag: an exact integer part that cannot
+        ride the device's int32 tables demotes the merge to the host
+        fold for the rest of the run (deterministic — every process
+        sees identical frames), and ``BYTEWAX_TPU_WIRE=pickle`` pins
+        the host fold wholesale.  Device-bound parts pad to the
+        power-of-two bucket ladder (``pad_len``) with the
+        exchange-scratch slot as the padding target, so one compiled
+        merge program per (op, encoding, dtype, bucket) serves every
+        round via the compile cache."""
+        from bytewax_tpu.engine.batching import pad_len
+
+        decoded = []
+        for frames in peer_frames:
             for frame in frames or ():
-                cols = _wire.decode_agg(frame)
-                keys = cols.get("key")
-                if keys is None or not len(keys):
+                parts = _wire.decode_agg_parts(frame)
+                kp = parts.get("key")
+                if kp is None or not len(kp[1]):
                     continue
+                decoded.append(
+                    (kp[1], {n: parts[n] for n in self.kind.fields})
+                )
+        if not self._merge_demoted and self._needs_host_fold(decoded):
+            self._demote_merge()
+        kid_map = self.key_to_kid
+        if self._merge_demoted:
+            sealed = []
+            for keys, fields in decoded:
                 gidx = np.fromiter(
                     (
                         self._global_idx(kid_map[k])
@@ -1577,40 +1750,442 @@ class GlobalAggState:
                     dtype=np.int64,
                     count=len(keys),
                 )
-                for name, (_init, op) in self.kind.fields.items():
-                    vals = np.asarray(cols[name], dtype=np.float64)
-                    tgt = self._host_fields[name]
-                    if op == "add":
-                        np.add.at(tgt, gidx, vals)
-                    elif op == "min":
-                        np.minimum.at(tgt, gidx, vals)
-                    else:
-                        np.maximum.at(tgt, gidx, vals)
+                sealed.append((gidx, fields))
+            return {"device": False, "frames": sealed}
+        sealed = []
+        h2d = 0
+        for keys, fields in decoded:
+            n = len(keys)
+            padded = pad_len(n)
+            gidx_p = np.full(
+                padded, self.cap_per_shard - 1, dtype=np.int32
+            )
+            gidx_p[:n] = np.fromiter(
+                (self._global_idx(kid_map[k]) for k in keys.tolist()),
+                dtype=np.int64,
+                count=n,
+            )
+            h2d += gidx_p.nbytes
+            sealed_fields = {}
+            for name in self.kind.fields:
+                enc, parts = fields[name]
+                want = self._merge_dtype(name)
+                if enc == "int8":
+                    scales, q = parts
+                    nb = -(-padded // _wire.QBLOCK)
+                    scales_p = np.zeros(nb, dtype=np.float32)
+                    scales_p[: len(scales)] = scales
+                    q_p = np.zeros(padded, dtype=np.int8)
+                    q_p[:n] = q
+                    sealed_fields[name] = (enc, (scales_p, q_p), want)
+                    h2d += scales_p.nbytes + q_p.nbytes
+                elif enc == "bf16":
+                    hi_p = np.zeros(padded, dtype=np.uint16)
+                    hi_p[:n] = parts
+                    sealed_fields[name] = (enc, (hi_p,), want)
+                    h2d += hi_p.nbytes
+                else:  # raw — pre-cast to the table dtype (lossless:
+                    # _needs_host_fold demoted anything that is not)
+                    arr_p = np.zeros(padded, dtype=np.dtype(want))
+                    arr_p[:n] = parts
+                    sealed_fields[name] = ("raw", (arr_p,), want)
+                    h2d += arr_p.nbytes
+            sealed.append((gidx_p, n, sealed_fields))
+        _flight.note_transfer("h2d", h2d)
+        _flight.RECORDER.count("gsync_merge_h2d_bytes", h2d)
+        return {"device": True, "frames": sealed}
+
+    def _needs_host_fold(self, decoded: List[Any]) -> bool:
+        """Whether any exact part of this round cannot fold on the
+        device tables: an integer column bound for an int32 table
+        whose values overflow it (the host f64 fold holds 53 exact
+        bits; int32 tables hold 31)."""
+        info = np.iinfo(np.int32)
+        for _keys, fields in decoded:
+            for name in self.kind.fields:
+                enc, parts = fields[name]
+                if enc != "raw" or self._merge_dtype(name) != "int32":
+                    continue
+                arr = np.asarray(parts)
+                if arr.dtype.kind not in "iu":
+                    return True
+                if arr.dtype.itemsize > 4 and len(arr) and (
+                    arr.max() > info.max or arr.min() < info.min
+                ):
+                    return True
+        return False
+
+    def _demote_merge(self) -> None:
+        """Sticky demotion to the host fold (main thread): fence any
+        in-flight device merges, fetch the device tables into the
+        host-side f64 blocks, and fold host-side from here on."""
+        self._merge_demoted = True
+        if self._dev_fields is None:
+            return
+        self.fence()
+        self._host_fields = self._fetch_dev_fields()
+        self._dev_fields = None
+
+    def _fetch_dev_fields(self) -> Dict[str, np.ndarray]:
+        """One device→host fetch of the merge tables (f64 host
+        blocks, the emission/baseline format).  Counted under the
+        collective tier's transfer counters — this is the ONLY d2h
+        the device merge pays (finalize, baselines, demotion), where
+        the host fold materialized every round's dequantized
+        partials host-side."""
+        host = {}
+        d2h = 0
+        for name, table in self._dev_fields.items():
+            raw = np.asarray(table)
+            d2h += raw.nbytes
+            host[name] = raw.astype(np.float64)
+        _flight.note_transfer("d2h", d2h)
+        _flight.RECORDER.count("gsync_fetch_d2h_bytes", d2h)
+        return host
+
+    def _apply_merge(self, sealed: Dict[str, Any]) -> None:
+        """Fold one sealed round (runs on the collective lane under
+        overlap, inline otherwise).  Every process folds identical
+        frames in identical order with identical programs, so merged
+        tables stay cluster-identical — same values, same addition
+        order."""
+        if sealed["device"]:
+            self._apply_merge_device(sealed["frames"])
+        else:
+            self._apply_merge_host(sealed["frames"])
+
+    def _apply_merge_host(self, sealed_frames: List[Any]) -> None:
+        """The host fold (the ``BYTEWAX_TPU_WIRE=pickle``-era
+        fallback and the oracle in tests): dequantize each sealed
+        part to f64 and scatter into host-resident field blocks."""
+        if self._host_fields is None:
+            size = self.n_shards * self.cap_per_shard
+            self._host_fields = {
+                name: np.full(size, init, dtype=np.float64)
+                for name, (init, _op) in self.kind.fields.items()
+            }
+        host_bytes = 0
+        for gidx, fields in sealed_frames:
+            for name, (_init, op) in self.kind.fields.items():
+                enc, parts = fields[name]
+                vals = np.asarray(
+                    _wire.dequant_part(enc, parts), dtype=np.float64
+                )
+                host_bytes += vals.nbytes
+                tgt = self._host_fields[name]
+                if op == "add":
+                    np.add.at(tgt, gidx, vals)
+                elif op == "min":
+                    np.minimum.at(tgt, gidx, vals)
+                else:
+                    np.maximum.at(tgt, gidx, vals)
+        _flight.RECORDER.count("gsync_merge_host_bytes", host_bytes)
+
+    def _apply_merge_device(self, sealed_frames: List[Any]) -> None:
+        """The device fold: upload each sealed frame's wire-width
+        parts, dequantize+merge+scatter in HBM (engine/xla.py
+        ``agg_merge_fn``), and keep the merged tables device-resident
+        between closes — no per-round d2h."""
+        import jax
+        import jax.numpy as jnp
+
+        from bytewax_tpu.engine import xla as _xla
+
+        size = self.n_shards * self.cap_per_shard
+        if self._dev_fields is None:
+            self._dev_fields = {}
+        tables = self._dev_fields
+        for gidx_p, n, fields in sealed_frames:
+            g = jax.device_put(gidx_p)
+            for name, (init, op) in self.kind.fields.items():
+                enc, parts, want = fields[name]
+                table = tables.get(name)
+                if table is None:
+                    table = _xla.agg_merge_table(size, init, want)
+                elif str(table.dtype) != want:
+                    # Deterministic promotion (int32 → float32) at
+                    # the first non-all-int round, in round order on
+                    # the lane — identical on every process.
+                    table = table.astype(jnp.dtype(want))
+                fn = _xla.agg_merge_fn(op, enc, want, len(gidx_p))
+                tables[name] = fn(
+                    table, g, n, *(jax.device_put(p) for p in parts)
+                )
+
+    # -- store-composable overlap (docs/recovery.md) -------------------------
+
+    def _mine_local_key(self, base: str) -> str:
+        """A deterministic store row key derived from ``base`` whose
+        worker lane (``adler32 % worker_count`` — the route the store
+        stamps and resume reads scope by) lands on THIS process, so
+        the row comes back to the process that wrote it."""
+        d = self.driver
+        salt = 0
+        while True:
+            key = f"{base}{salt}"
+            if d.is_local(zlib.adler32(key.encode()) % d.worker_count):
+                return key
+            salt += 1
+
+    def _base_key(self) -> str:
+        return self._mine_local_key(_GSYNC_BASE_KEY)
+
+    def _round_key(self, round_no: int) -> str:
+        return self._mine_local_key(
+            f"{_GSYNC_ROUND_KEY}{round_no:08d}\x00"
+        )
+
+    def _stash_round(self, payload_fn) -> None:
+        """With a recovery store, make this data-bearing round
+        durable: stash a sealed round row for this close's snapshot —
+        or, every ``BYTEWAX_TPU_GSYNC_BASELINE_EVERY`` rounds, fence
+        the lane and stash a full-aggregate baseline row instead
+        (same key every time, so the store's latest-row-per-key read
+        supersedes), tombstoning the round rows it covers.  Round
+        stash decisions derive from gsync-agreed values
+        (``total_rows``), so every process stashes symmetric rows for
+        the identical round sequence — resume replays deterministically
+        cluster-wide."""
+        if self.driver.store is None:
+            return
+        if self._data_rounds % _gsync_baseline_every() == 0:
+            self.fence()
+            self._pending_snap_rows.append(
+                (self._base_key(), self._capture_baseline())
+            )
+            self._base_written = True
+            self._pending_snap_rows.extend(
+                (k, None) for k in self._outstanding_rounds
+            )
+            self._outstanding_rounds = []
+            return
+        key = self._round_key(self._data_rounds)
+        self._pending_snap_rows.append((key, payload_fn()))
+        self._outstanding_rounds.append(key)
+
+    def _capture_baseline(self) -> Dict[str, Any]:
+        """Snapshot the full merged aggregate (lane fenced by the
+        caller) in a self-contained host format: resume installs it
+        and replays only the rounds stashed after it."""
+        base: Dict[str, Any] = {
+            "round": self._data_rounds,
+            "key_to_kid": dict(self.key_to_kid),
+            "shard_fill": list(self._shard_fill),
+            "procs": self.driver.proc_count,
+        }
+        if self._quant != "off":
+            if self._dev_fields is not None:
+                fields = self._fetch_dev_fields()
+            elif self._host_fields is not None:
+                fields = {
+                    n: a.copy() for n, a in self._host_fields.items()
+                }
+            else:
+                fields = None
+            base.update(
+                fmt="quant", fields=fields, quant_int=self._quant_int
+            )
+            return base
+        blocks = (
+            self._local_host_fields()
+            if self._fields is not None
+            else None
+        )
+        base.update(
+            fmt="exact",
+            blocks=blocks,
+            dtype=(
+                np.dtype(self.dtype).name
+                if self.dtype is not None
+                else None
+            ),
+        )
+        return base
+
+    def _install_baseline(self, base: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if base.get("procs") != self.driver.proc_count:
+            msg = (
+                "the global-exchange tier cannot rescale on resume: "
+                f"the store's baseline was written by {base.get('procs')} "
+                f"process(es), this cluster runs {self.driver.proc_count}; "
+                "resume at the original size or run with "
+                "BYTEWAX_TPU_GLOBAL_EXCHANGE=0"
+            )
+            raise RuntimeError(msg)
+        self.key_to_kid = dict(base["key_to_kid"])
+        self._shard_fill = list(base["shard_fill"])
+        self._data_rounds = base["round"]
+        self._base_written = True
+        if base["fmt"] == "quant":
+            self._quant_int = base["quant_int"]
+            fields = base["fields"]
+            if fields is None:
+                return
+            if self._merge_demoted:
+                self._host_fields = {
+                    n: np.asarray(a, dtype=np.float64)
+                    for n, a in fields.items()
+                }
+                return
+            self._dev_fields = {}
+            for name, arr in fields.items():
+                want = self._merge_dtype(name)
+                self._dev_fields[name] = jax.device_put(
+                    np.asarray(arr).astype(np.dtype(want))
+                )
+            return
+        if base["dtype"] is not None:
+            self.dtype = (
+                jnp.int32 if base["dtype"] == "int32" else jnp.float32
+            )
+        blocks = base["blocks"]
+        if blocks is None:
+            return
+        shape = (self.n_shards * self.cap_per_shard,)
+        fields = {}
+        for name in self.kind.fields:
+            per = blocks[name]
+
+            def cb(index, _per=per):
+                start = index[0].start or 0
+                return np.ascontiguousarray(_per[start]).astype(
+                    np.dtype(self.dtype)
+                )
+
+            fields[name] = jax.make_array_from_callback(
+                shape, self._sharding, cb
+            )
+        self._fields = fields
+
+    def _maybe_replay_resume(self) -> None:
+        """Install deferred resume rows at the FIRST flush — a
+        globally-ordered point every process reaches in lockstep, so
+        the replayed collective rounds launch in the identical
+        sequence cluster-wide.  The round sequence is symmetric by
+        construction (stash decisions derive from gsync-agreed
+        values), and rows at or before the installed baseline's
+        round are superseded by it."""
+        if not self._resume_rows:
+            return
+        rows, self._resume_rows = self._resume_rows, []
+        baseline = None
+        rounds = []
+        for key, payload in rows:
+            if key.startswith(_GSYNC_BASE_KEY):
+                if (
+                    baseline is None
+                    or payload["round"] > baseline["round"]
+                ):
+                    baseline = payload
+            else:
+                rounds.append(payload)
+        base_no = 0
+        if baseline is not None:
+            self._install_baseline(baseline)
+            base_no = baseline["round"]
+        for payload in sorted(rounds, key=lambda p: p["round"]):
+            if payload["round"] <= base_no:
+                continue
+            self._replay_round(payload)
+            self._outstanding_rounds.append(
+                self._round_key(payload["round"])
+            )
+            self._data_rounds = max(
+                self._data_rounds, payload["round"]
+            )
+        self._data_rounds = max(self._data_rounds, base_no)
+
+    def _replay_round(self, payload: Dict[str, Any]) -> None:
+        """Re-run one sealed-but-uncommitted round from its stashed
+        row (inline — replay precedes any overlap)."""
+        import jax.numpy as jnp
+
+        self._assign_kids(payload["new"])
+        if payload["fmt"] == "quant":
+            self._quant_int = self._quant_int and payload["all_int"]
+            self._apply_merge(self._seal_merge(payload["frames"]))
+            return
+        want = (
+            jnp.int32 if payload["dtype"] == "int32" else jnp.float32
+        )
+        if self.dtype is None:
+            self.dtype = want
+        self._ensure_fields()
+        chunk_pd = payload["chunk_pd"]
+        n_steps = payload["n_steps"]
+        chunk_rows = chunk_pd * self.local_devs
+        pad_total = n_steps * chunk_rows
+        kids = payload["kids"]
+        vals = payload["vals"]
+        n_local = len(kids)
+        kids_p = np.zeros(pad_total, dtype=np.int32)
+        kids_p[:n_local] = kids
+        vals_p = np.zeros(pad_total, dtype=np.dtype(self.dtype))
+        vals_p[:n_local] = vals
+        valid_p = np.zeros(pad_total, dtype=bool)
+        valid_p[:n_local] = True
+        step = self._step_for(chunk_pd, payload["capacity"])
+        self._exchange_chunks(
+            step,
+            kids_p,
+            vals_p,
+            valid_p,
+            chunk_rows,
+            n_steps,
+            chunk_pd * self.n_shards,
+            np.dtype(self.dtype),
+        )
 
     # -- recovery / emission --------------------------------------------------
 
-    def load(self, key: str, state: Any) -> None:  # pragma: no cover
-        msg = "the global-exchange tier does not support resume yet"
-        raise RuntimeError(msg)
+    def load(self, key: str, state: Any) -> None:
+        self.load_many([(key, state)])
 
-    def load_many(self, items) -> None:  # pragma: no cover
-        if items:
-            self.load(*items[0])
+    def load_many(self, items) -> None:
+        """Defer resumed store rows for replay at the first flush.
+        Only the tier's OWN rows (sealed rounds + baselines) resume;
+        a store written by a per-process tier cannot page user-key
+        state into the collective tier (kid assignment is a
+        collective agreement, and resume reads are route-scoped)."""
+        for key, state in items:
+            if not key.startswith(_GSYNC_KEY_PREFIX):
+                msg = (
+                    "the global-exchange tier cannot resume "
+                    "user-key state written by another tier "
+                    f"(got row {key!r}); resume this store with "
+                    "BYTEWAX_TPU_GLOBAL_EXCHANGE=0"
+                )
+                raise RuntimeError(msg)
+            self._resume_rows.append((key, state))
 
     def snapshots_for(self, keys: List[str]) -> List[Tuple[str, Any]]:
-        # Only reachable with no recovery store (make_agg_state gating)
-        # — the epoch snapshot pass discards these.
-        return [(k, None) for k in keys]
+        if self.driver.store is None:
+            # Only reachable with no recovery store (make_agg_state
+            # gating) — the epoch snapshot pass discards these.
+            return [(k, None) for k in keys]
+        # Store-composable overlap: the tier's durable unit is the
+        # sealed round/baseline row, never per-user-key rows (state
+        # lives merged in HBM; a per-key emission would force the
+        # fence the overlap exists to avoid).
+        rows, self._pending_snap_rows = self._pending_snap_rows, []
+        return rows
 
     def _local_host_fields(self) -> Dict[str, Dict[int, np.ndarray]]:
         """Per-field {global_offset: block} of this process's shards."""
         out: Dict[str, Dict[int, np.ndarray]] = {}
+        d2h = 0
         for name in self.kind.fields:
             blocks: Dict[int, np.ndarray] = {}
             for shard in self._fields[name].addressable_shards:
                 start = shard.index[0].start or 0
                 blocks[start] = np.asarray(shard.data)
+                d2h += blocks[start].nbytes
             out[name] = blocks
+        _flight.note_transfer("d2h", d2h)
+        _flight.RECORDER.count("gsync_fetch_d2h_bytes", d2h)
         return out
 
     def _exactify(self, val: Any) -> Any:
@@ -1637,6 +2212,11 @@ class GlobalAggState:
         self.fence()
         out: List[Tuple[str, Any]] = []
         if self._quant != "off":
+            if self._dev_fields is not None:
+                # The device merge's ONE d2h: the merged aggregate
+                # leaves HBM only here (and at baselines/demotion).
+                self._host_fields = self._fetch_dev_fields()
+                self._dev_fields = None
             if self._host_fields is not None and self.key_to_kid:
                 my_shards = set(
                     self._proc_shards[self.driver.proc_id]
@@ -1682,10 +2262,33 @@ class GlobalAggState:
                     for name in self.kind.fields
                 }
                 out.append((key, _final_of(self.kind_name, flat, 0)))
+        if self.driver.store is not None:
+            # The aggregate just emitted and resets: this close's own
+            # not-yet-written round rows drop, durable rounds and the
+            # baseline tombstone (a resumed post-EOF store replays
+            # nothing).
+            dropped = {
+                k
+                for k, p in self._pending_snap_rows
+                if p is not None
+            }
+            self._pending_snap_rows = [
+                (k, p) for k, p in self._pending_snap_rows if p is None
+            ]
+            self._pending_snap_rows.extend(
+                (k, None)
+                for k in self._outstanding_rounds
+                if k not in dropped
+            )
+            self._outstanding_rounds = []
+            if self._base_written:
+                self._pending_snap_rows.append((self._base_key(), None))
+                self._base_written = False
         self.key_to_kid.clear()
         self._shard_fill = [0] * self.n_shards
         self._fields = None
         self._host_fields = None
+        self._dev_fields = None
         self.dtype = None
         self._buf_all_int = True
         self._quant_int = True

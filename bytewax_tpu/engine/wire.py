@@ -602,6 +602,9 @@ _AGG_UTF8 = 3  # unicode (U-dtype) cells packed as UTF-8 bytes (exact)
 #: scale (max|block| / 127), so overhead is 4 bytes per 1024 values
 #: and a single outlier cannot flatten the whole column's resolution.
 _QBLOCK = 1024
+#: Public alias: the device-side merge kernels (engine/xla.py)
+#: dequantize with the same block size.
+QBLOCK = _QBLOCK
 
 #: Rows per aggregate frame: oversized partial-column sets chunk into
 #: bounded frames so encode scratch (and any future streaming decode)
@@ -780,11 +783,22 @@ def _encode_agg_chunk(cols: Dict[str, np.ndarray], quant: str) -> bytes:
     return b"".join(parts)
 
 
-def decode_agg(data: bytes) -> Dict[str, np.ndarray]:
-    """Decode one aggregate frame back into per-key partial columns
-    (quantized float columns dequantize to float64; exact columns
-    rebuild zero-copy).  Unknown magic/version/encoding raises a
-    typed :class:`WireFormatError` — a mixed cluster fails loudly."""
+def decode_agg_parts(
+    data: bytes,
+) -> Dict[str, Tuple[str, Any]]:
+    """Decode one aggregate frame into raw per-column parts,
+    deferring float dequantization to the caller — the device-side
+    merge kernels in ``engine/xla.py`` dequantize in HBM, so the
+    quantized payload crosses the host/device boundary at wire width
+    instead of f64.  Exact columns (``raw``/``utf8``) decode fully
+    (they are key metadata or exact integers the device path uploads
+    as-is).  Returns ``{name: (enc, parts)}`` where ``enc`` is one of
+    ``"raw"``/``"utf8"``/``"bf16"``/``"int8"`` and ``parts`` is the
+    decoded array (raw/utf8), the uint16 mantissa array (bf16), or a
+    ``(scales_f32, q_int8)`` pair (int8) — all zero-copy read-only
+    views over the frame buffer.  Unknown magic/version/encoding
+    raises a typed :class:`WireFormatError` — a mixed cluster fails
+    loudly."""
     if data[:4] != _AGG_MAGIC:
         raise WireFormatError("not a gsync aggregate frame")
     version = data[4]
@@ -815,7 +829,7 @@ def decode_agg(data: bytes) -> Dict[str, np.ndarray]:
             raise WireFormatError(
                 f"unknown aggregate column encoding {enc}"
             )
-    cols: Dict[str, np.ndarray] = {}
+    cols: Dict[str, Tuple[str, Any]] = {}
     for name, enc, dt, nrows, extra in specs:
         if enc in (_AGG_RAW, _AGG_UTF8):
             dtype = np.dtype(dt)
@@ -824,15 +838,15 @@ def decode_agg(data: bytes) -> Dict[str, np.ndarray]:
                 data, dtype=dtype, count=nrows, offset=start
             )
             if enc == _AGG_UTF8:
-                col = np.char.decode(col, "utf-8")
-            cols[name] = col
+                cols[name] = ("utf8", np.char.decode(col, "utf-8"))
+            else:
+                cols[name] = ("raw", col)
         elif enc == _AGG_BF16:
             start, _end = rd.take_buf(nrows * 2)
             hi = np.frombuffer(
                 data, dtype=np.uint16, count=nrows, offset=start
             )
-            as32 = (hi.astype(np.uint32) << 16).view(np.float32)
-            cols[name] = as32.astype(np.float64)
+            cols[name] = ("bf16", hi)
         else:  # _AGG_INT8
             start, _end = rd.take_buf(extra)
             scales = np.frombuffer(
@@ -842,6 +856,42 @@ def decode_agg(data: bytes) -> Dict[str, np.ndarray]:
             q = np.frombuffer(
                 data, dtype=np.int8, count=nrows, offset=qstart
             )
+            cols[name] = ("int8", (scales, q))
+    return cols
+
+
+def dequantize_bf16(hi: np.ndarray) -> np.ndarray:
+    """Host-side bf16 expansion (the oracle for the device kernel)."""
+    as32 = (hi.astype(np.uint32) << 16).view(np.float32)
+    return as32.astype(np.float64)
+
+
+def dequant_part(enc: str, parts: Any) -> np.ndarray:
+    """Host-side dequantization of one :func:`decode_agg_parts`
+    column (the fold path of the host-merge fallback and the oracle
+    for the device kernels): exact parts pass through, ``bf16``/
+    ``int8`` expand exactly as :func:`decode_agg` would."""
+    if enc in ("raw", "utf8"):
+        return np.asarray(parts)
+    if enc == "bf16":
+        return dequantize_bf16(parts)
+    scales, q = parts
+    return _dequantize_int8(scales, q)
+
+
+def decode_agg(data: bytes) -> Dict[str, np.ndarray]:
+    """Decode one aggregate frame back into per-key partial columns
+    (quantized float columns dequantize to float64; exact columns
+    rebuild zero-copy).  The host-side companion of
+    :func:`decode_agg_parts` — one parse path, host dequant."""
+    cols: Dict[str, np.ndarray] = {}
+    for name, (enc, parts) in decode_agg_parts(data).items():
+        if enc in ("raw", "utf8"):
+            cols[name] = parts
+        elif enc == "bf16":
+            cols[name] = dequantize_bf16(parts)
+        else:  # int8
+            scales, q = parts
             cols[name] = _dequantize_int8(scales, q)
     return cols
 

@@ -8,6 +8,7 @@ the slots awoken in the closing epoch, preserving the recovery
 contract of the host tier (states are interchangeable between tiers).
 """
 
+import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -19,6 +20,7 @@ from bytewax_tpu.engine.arrays import ArrayBatch, KeyEncoder, VocabMap
 from bytewax_tpu.engine.batching import pad_len
 from bytewax_tpu.ops.segment import (
     AGG_KINDS,
+    identity_for,
     init_fields,
     update_fields,
     update_fields_packed,
@@ -631,3 +633,76 @@ class DeviceAggState:
         """Reinstall previously-extracted keys (host-format snapshots,
         one scatter per field) — the residency-fault restore path."""
         self.load_many(items)
+
+
+# -- global-exchange device merge (docs/performance.md "Overlapped
+# -- collectives") -----------------------------------------------------------
+#
+# The quantized gsync exchange used to fold peer partial frames
+# host-side (``GlobalAggState._merge_partials``): every round decoded
+# the block-scaled columns to float64 on the host and ``np.add.at``-ed
+# them into host-resident field blocks.  These kernels move that fold
+# into HBM — the wire-width parts (int8 q + f32 block scales, bf16
+# mantissas, narrowed exact integers) upload as-is, dequantize on
+# device, and scatter into a device-resident aggregate table, so the
+# merged aggregate never leaves HBM between closes (EQuARX, PAPERS.md)
+# and the only per-round host traffic is the wire frames themselves.
+# Rows pad to the same power-of-two bucket ladder as every other
+# device dispatch (``pad_len``), so one compiled program per
+# (op, encoding, dtype, bucket) serves every round via the compile
+# cache; a traced ``n`` masks the padding.
+
+
+@functools.lru_cache(maxsize=None)
+def agg_merge_fn(
+    op: str, enc: str, table_dtype: str, padded_len: int
+):
+    """One compiled scatter-merge: ``fn(table, gidx, n, *parts) ->
+    table``.  ``enc`` is the wire encoding of the value part
+    (``raw`` arrives pre-cast to the table dtype; ``int8`` arrives as
+    the (scales, q) pair; ``bf16`` as the uint16 mantissas); rows past
+    ``n`` fold the op identity (their gidx already targets the
+    exchange-scratch slot).  Pure function of its arguments — every
+    process compiles the identical program and folds the identical
+    frames in the identical order, so merged tables stay
+    cluster-identical (same values, same addition order)."""
+    from bytewax_tpu.engine.wire import QBLOCK
+
+    dtype = jnp.dtype(table_dtype)
+    if op == "add":
+        pad_ident = identity_for(0.0, dtype)
+    elif op == "min":
+        pad_ident = identity_for(float("inf"), dtype)
+    else:
+        pad_ident = identity_for(float("-inf"), dtype)
+
+    def fn(table, gidx, n, *parts):
+        if enc == "int8":
+            scales, q = parts
+            expanded = jnp.repeat(scales, QBLOCK)[:padded_len]
+            vals = (q.astype(jnp.float32) * expanded).astype(dtype)
+        elif enc == "bf16":
+            (hi,) = parts
+            vals = jax.lax.bitcast_convert_type(
+                hi.astype(jnp.uint32) << 16, jnp.float32
+            ).astype(dtype)
+        else:  # raw (pre-cast host-side)
+            (vals,) = parts
+        valid = jnp.arange(padded_len, dtype=jnp.int32) < n
+        vals = jnp.where(valid, vals, pad_ident)
+        if op == "add":
+            return table.at[gidx].add(vals)
+        if op == "min":
+            return table.at[gidx].min(vals)
+        return table.at[gidx].max(vals)
+
+    return jax.jit(fn)
+
+
+def agg_merge_table(
+    size: int, init: float, table_dtype: str
+) -> jax.Array:
+    """A fresh device-resident merge table, initialized to the
+    field's fold identity (±inf saturates for integer dtypes)."""
+    dtype = jnp.dtype(table_dtype)
+    return jnp.full((size,), identity_for(init, dtype), dtype=dtype)

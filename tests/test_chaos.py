@@ -727,6 +727,13 @@ def test_cluster_overlapped_round_comm_fault_exactly_once(tmp_path):
             "BYTEWAX_TPU_INGEST_TARGET_ROWS": "0",
             "GX_PACE_S": "0.1",
             "GX_BATCHES": "5",
+            # Hold EOF until 5 epochs really closed on each process:
+            # the epoch-3 injector below can then never race EOF (a
+            # loaded box used to drain all batches inside epochs 1-2
+            # and finish before the fault epoch — the seed-era flake)
+            # and rounds sealed at the earlier data closes are in
+            # flight on the collective lane when it fires.
+            "GX_HOLD_CLOSES": "5",
             # Crash worker 1 inside a comm send at epoch 3: rounds
             # for earlier epochs have been sealed and are running on
             # the collective lanes.  x1 so the restarted generation
@@ -771,3 +778,224 @@ def test_cluster_overlapped_round_comm_fault_exactly_once(tmp_path):
         assert got[k][0] == mn and got[k][2] == mx
         assert got[k][3] == count
         assert abs(got[k][1] - mean) < 1e-6
+
+
+# -- store-composable overlap: crash with a sealed round in flight -----
+
+_GX_STORE_FLOW = '''
+import os
+import time
+
+import bytewax_tpu.operators as op
+from bytewax_tpu import xla
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.inputs import FixedPartitionedSource, StatefulSourcePartition
+
+
+class _Part(StatefulSourcePartition):
+    """Paced batches with exact resume: snapshot() is the batch
+    index, so a supervised restart replays only the uncommitted
+    epochs — the committed ones come back through the global tier's
+    round/baseline recovery rows (docs/recovery.md "Store-composable
+    overlap")."""
+
+    def __init__(self, name, resume):
+        self._base = 1000 if name == "p1" else 0
+        self._i = resume or 0
+        self._cap = int(os.environ.get("GX_BATCHES", "5"))
+        self._pace = float(os.environ.get("GX_PACE_S", "0"))
+        # Hold EOF until this process really closed GX_HOLD_CLOSES
+        # epochs, so the epoch-pinned injector can never race EOF.
+        self._hold = int(os.environ.get("GX_HOLD_CLOSES", "0"))
+        self._hold_deadline = time.monotonic() + 60
+
+    def next_batch(self):
+        if self._i >= self._cap:
+            if self._hold:
+                from bytewax_tpu.engine.flight import RECORDER
+
+                closes = RECORDER.counters.get("epoch_close_count", 0)
+                if (
+                    closes < self._hold
+                    and time.monotonic() < self._hold_deadline
+                ):
+                    time.sleep(0.05)
+                    return []
+            raise StopIteration()
+        if self._pace:
+            time.sleep(self._pace)
+        b = self._i
+        self._i += 1
+        ints = os.environ.get("GX_INTS", "0") == "1"
+        return [
+            (
+                f"k{{i % 7}}",
+                (self._base + b * 100 + i)
+                if ints
+                else float(self._base + b * 100 + i),
+            )
+            for i in range(100)
+        ]
+
+    def snapshot(self):
+        return self._i
+
+
+class Src(FixedPartitionedSource):
+    def list_parts(self):
+        return ["p0", "p1"]
+
+    def build_part(self, step_id, name, resume):
+        return _Part(name, resume)
+
+
+flow = Dataflow("gx_store_df")
+s = op.input("inp", flow, Src())
+st = xla.stats_final("stats", s)
+fmt = op.map(
+    "fmt",
+    st,
+    lambda kv: (
+        kv[0],
+        f"{{kv[0]}};{{kv[1][0]}};{{kv[1][1]:.6f}};{{kv[1][2]}};{{kv[1][3]}}",
+    ),
+)
+op.output("out", fmt, FileSink({out_path!r}))
+'''
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        {},
+        {
+            "BYTEWAX_TPU_GSYNC_DEPTH": "2",
+            "BYTEWAX_TPU_GSYNC_QUANT": "int8",
+            # All-integer values: every column rides the exact path
+            # (device int32 tables), so the exactly-once oracle can
+            # be asserted bit for bit even under int8 quant.
+            "GX_INTS": "1",
+        },
+    ],
+    ids=["depth1", "depth2-int8"],
+)
+def test_cluster_overlap_store_crash_resume_exactly_once(
+    tmp_path, extra
+):
+    """The store-composable-overlap acceptance: a GSYNC_OVERLAP=1
+    flow WITH a recovery store crashes (real comm.send fault site)
+    while sealed rounds ride the collective lane, the supervisors
+    restart both processes, the stateful sources resume from their
+    committed offsets, and the global tier replays its durable
+    round/baseline rows — the final output equals the host oracle
+    exactly once (a committed epoch's rows are never re-folded, an
+    uncommitted epoch's rows never existed)."""
+    from tests.test_cluster import _gx_paced_oracle
+
+    name = "gx_store_" + "_".join(extra.values()).replace("int8", "q")
+    flow_py = tmp_path / f"{name}.py"
+    out_path = str(tmp_path / f"{name}_out.txt")
+    flow_py.write_text(_GX_STORE_FLOW.format(out_path=out_path))
+    db = tmp_path / f"{name}_db"
+    db.mkdir()
+    subprocess.run(
+        [sys.executable, "-m", "bytewax_tpu.recovery", str(db), "2"],
+        env=_env(),
+        check=True,
+        timeout=60,
+    )
+    env = _env(
+        {
+            "BYTEWAX_TPU_ACCEL": "1",
+            "BYTEWAX_TPU_DISTRIBUTED": "1",
+            "BYTEWAX_TPU_GLOBAL_EXCHANGE": "1",
+            "BYTEWAX_TPU_GLOBAL_EXCHANGE_DEBUG": "1",
+            "BYTEWAX_TPU_GSYNC_OVERLAP": "1",
+            "BYTEWAX_TPU_INGEST_TARGET_ROWS": "0",
+            "GX_PACE_S": "0.1",
+            "GX_BATCHES": "5",
+            "GX_HOLD_CLOSES": "6",
+            # Crash worker 1 inside a comm send at epoch 4: earlier
+            # epochs have committed (their round rows are durable)
+            # and their sealed exchanges ride the collective lane.
+            "BYTEWAX_TPU_FAULTS": "comm.send:crash:4:1:x1",
+            "BYTEWAX_TPU_MAX_RESTARTS": "3",
+            "BYTEWAX_TPU_RESTART_BACKOFF_S": "0.1",
+            "BYTEWAX_TPU_EPOCH_STALL_S": "15",
+            **extra,
+        }
+    )
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.testing",
+            f"{flow_py}:flow",
+            "-p",
+            "2",
+            "-r",
+            str(db),
+            "-s",
+            "0.2",
+            "-b",
+            "0",
+        ],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "supervised restart" in res.stderr, res.stderr[-3000:]
+    assert res.stderr.count("global-exchange:") >= 2, res.stderr[-2000:]
+    got = {}
+    for line in Path(out_path).read_text().split():
+        key, mn, mean, mx, count = line.split(";")
+        assert key not in got, f"key {key} emitted twice"
+        got[key] = (float(mn), float(mean), float(mx), int(count))
+    oracle = _gx_paced_oracle(batches=5)
+    assert set(got) == set(oracle)
+    for k, (mn, mean, mx, count) in oracle.items():
+        assert got[k][3] == count, (k, got[k])
+        assert got[k][0] == mn and got[k][2] == mx, (k, got[k])
+        assert abs(got[k][1] - mean) < 0.05 * max(abs(mean), 1.0)
+
+
+def test_overlap_knobs_do_not_break_entrypoint_recovery(
+    entry_point, tmp_path, monkeypatch
+):
+    """The in-process leg of the store-composable-overlap acceptance:
+    under all 3 entry points (no global mesh — the knobs are inert)
+    a GSYNC_OVERLAP=1 + depth + quant flow with a recovery store
+    still recovers exactly-once from an injected snapshot-commit
+    crash, byte-identical to the plain recovery ladder."""
+    monkeypatch.setenv("BYTEWAX_TPU_GSYNC_OVERLAP", "1")
+    monkeypatch.setenv("BYTEWAX_TPU_GSYNC_DEPTH", "3")
+    monkeypatch.setenv("BYTEWAX_TPU_GSYNC_QUANT", "int8")
+    from bytewax_tpu.engine import wire as _wire
+
+    _wire.reconfigure()
+    try:
+        inp = [(f"k{i % 3}", i) for i in range(12)]
+        out_path = tmp_path / "out.txt"
+        db = tmp_path / "db"
+        db.mkdir()
+        init_db_dir(db, 1)
+        _supervision_env(monkeypatch, "snapshot.commit:crash:3:x1")
+        entry_point(
+            _file_flow(inp, str(out_path)),
+            epoch_interval=ZERO_TD,
+            recovery_config=RecoveryConfig(str(db)),
+        )
+        sums, want = {}, []
+        for k, v in inp:
+            sums[k] = sums.get(k, 0) + v
+            want.append(f"{k}={sums[k]}")
+        assert sorted(out_path.read_text().split()) == sorted(want)
+    finally:
+        monkeypatch.delenv("BYTEWAX_TPU_GSYNC_OVERLAP")
+        monkeypatch.delenv("BYTEWAX_TPU_GSYNC_DEPTH")
+        monkeypatch.delenv("BYTEWAX_TPU_GSYNC_QUANT")
+        _wire.reconfigure()

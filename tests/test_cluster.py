@@ -1188,9 +1188,23 @@ class _Part(StatelessSourcePartition):
         base = worker_index * 1000
         self._sleep = float(os.environ.get("GX_PACE_S", "0"))
         self._time = time
+        # GX_HOLD_CLOSES=N: hold EOF (empty polls) until this process
+        # has really closed N epochs — chaos runs use it so an
+        # epoch-pinned injector can never race EOF / the first flush
+        # (wall-clock capped so a stalled run still ends).
+        self._hold = int(os.environ.get("GX_HOLD_CLOSES", "0"))
+        self._hold_deadline = time.monotonic() + 60
+        # GX_INTS=1: ship plain ints so every aggregate column stays
+        # on the exact (integer) path — the bit-for-bit oracle runs.
+        ints = os.environ.get("GX_INTS", "0") == "1"
         self._batches = [
             [
-                (f"k{{i % 7}}", float(base + b * 100 + i))
+                (
+                    f"k{{i % 7}}",
+                    (base + b * 100 + i)
+                    if ints
+                    else float(base + b * 100 + i),
+                )
                 for i in range(100)
             ]
             for b in range(int(os.environ.get("GX_BATCHES", "4")))
@@ -1198,6 +1212,16 @@ class _Part(StatelessSourcePartition):
 
     def next_batch(self):
         if not self._batches:
+            if self._hold:
+                from bytewax_tpu.engine.flight import RECORDER
+
+                closes = RECORDER.counters.get("epoch_close_count", 0)
+                if (
+                    closes < self._hold
+                    and self._time.monotonic() < self._hold_deadline
+                ):
+                    self._time.sleep(0.05)
+                    return []
             raise StopIteration()
         if self._sleep:
             self._time.sleep(self._sleep)
@@ -1393,6 +1417,73 @@ sys.exit(0 if any(c != 0 for c in codes) else 3)
     )
     assert res.returncode == 0, (res.returncode, res.stderr[-3000:])
     assert "disagree on BYTEWAX_TPU_GSYNC_QUANT" in res.stderr
+
+
+@pytest.mark.parametrize("depth", [2, 4])
+def test_cluster_gsync_depth_ladder_matches_lockstep_and_oracle(
+    tmp_path, depth
+):
+    """BYTEWAX_TPU_GSYNC_DEPTH=D: up to D sealed rounds ride the
+    collective lane behind the compute frontier, retired in order —
+    and the final output is BYTE-IDENTICAL to the lock-step tier and
+    the host oracle at every rung of the ladder (depth 1 is the
+    original double-buffered overlap, pinned by
+    test_cluster_gsync_overlap_matches_lockstep_and_oracle)."""
+    env = {"GX_PACE_S": "0.12", "GX_BATCHES": "4"}
+    lockstep, _ = _run_gx_paced(
+        tmp_path,
+        f"gx_d{depth}_lockstep",
+        dict(env, BYTEWAX_TPU_GSYNC_OVERLAP="0"),
+    )
+    laddered, stderr = _run_gx_paced(
+        tmp_path,
+        f"gx_d{depth}",
+        dict(
+            env,
+            BYTEWAX_TPU_GSYNC_OVERLAP="1",
+            BYTEWAX_TPU_GSYNC_DEPTH=str(depth),
+        ),
+    )
+    assert stderr.count("global-exchange: proc 0 flushed") >= 1
+    assert stderr.count("global-exchange: proc 1 flushed") >= 1
+    assert laddered == lockstep
+    oracle = _gx_paced_oracle()
+    assert set(laddered) == set(oracle)
+    for k, (mn, mean, mx, count) in oracle.items():
+        assert laddered[k][0] == mn and laddered[k][2] == mx
+        assert laddered[k][3] == count
+        assert abs(laddered[k][1] - mean) < 1e-6
+
+
+def test_cluster_gsync_quant_device_merge_matches_host_fold(tmp_path):
+    """The device-side dequant+merge (engine/xla.py agg_merge_fn)
+    against the host-fold oracle (the BYTEWAX_TPU_WIRE=pickle-era
+    fallback, which pins _merge_demoted): on an all-integer workload
+    every aggregate column rides the exact path, so the two folds —
+    int32 device tables vs the host float64 fold — must agree BIT
+    FOR BIT, and both must equal the host oracle exactly (float
+    columns are only bound-compared elsewhere: their per-round
+    quantization error is wall-clock round-split dependent)."""
+    env = {
+        "GX_PACE_S": "0.1",
+        "GX_BATCHES": "3",
+        "GX_INTS": "1",
+        "BYTEWAX_TPU_GSYNC_QUANT": "int8",
+        "BYTEWAX_TPU_GSYNC_OVERLAP": "1",
+    }
+    device, _ = _run_gx_paced(tmp_path, "gx_devmerge", env)
+    host, _ = _run_gx_paced(
+        tmp_path,
+        "gx_hostmerge",
+        dict(env, BYTEWAX_TPU_WIRE="pickle"),
+    )
+    assert device == host
+    oracle = _gx_paced_oracle(batches=3)
+    assert set(device) == set(oracle)
+    for k, (mn, mean, mx, count) in oracle.items():
+        assert device[k][0] == mn and device[k][2] == mx
+        assert device[k][3] == count
+        assert abs(device[k][1] - mean) < 1e-9
 
 
 def test_gsync_overlap_knob_inert_without_global_mesh(

@@ -448,7 +448,9 @@ def test_lane_catalog_is_pinned():
         "collective": {
             "constructor": (sharded, "GlobalAggState.__init__"),
             "phase": "collective_lane",
-            "depth": 2,
+            # knob-driven (BYTEWAX_TPU_GSYNC_DEPTH; the site passes
+            # _gsync_depth() + 1, so depth 1 = one round in flight)
+            "depth": None,
             "fence": (sharded, "GlobalAggState.fence"),
             "shutdown": (sharded, "GlobalAggState.lane_shutdown"),
         },
@@ -487,11 +489,13 @@ def test_lane_catalog_is_pinned():
 
 def test_shared_state_inventory_is_pinned():
     """The shared-state contract (docs/contracts.md BTX-RACE):
-    exactly today's six worker/main shared attributes, each with a
+    exactly today's five worker/main shared attributes, each with a
     synchronization justification, plus the sealed-capture and
     worker-carve-out inventories.  An attribute enters SHARED_STATE
     only with its justification here AND in contracts.py AND a
-    re-check of the docs — never silently."""
+    re-check of the docs — never silently.  (The HBM-resident-
+    aggregate PR REMOVED wire:_Reader.off: peer frames now decode on
+    main at seal time, so no lane task constructs a _Reader.)"""
     assert set(contracts.SHARED_STATE) == {
         # instance-per-owner: no KeyEncoder crosses tiers.
         "bytewax_tpu.engine.arrays:KeyEncoder._ids",
@@ -502,8 +506,6 @@ def test_shared_state_inventory_is_pinned():
         # (engine/flight thread-safety note; WORKER_SAFE).
         "bytewax_tpu.engine.flight:FlightRecorder._ring",
         "bytewax_tpu.engine.flight:FlightRecorder.counters",
-        # per-frame decode cursor; instances never escape one call.
-        "bytewax_tpu.engine.wire:_Reader.off",
     }
     for key, why in contracts.SHARED_STATE.items():
         assert why.strip(), f"SHARED_STATE entry {key} lacks its " \
@@ -530,7 +532,7 @@ def test_shared_state_inventory_is_pinned():
 
 
 def test_knob_catalog_is_pinned():
-    """The knob inventory: exactly today's 56 BYTEWAX_TPU_* knobs,
+    """The knob inventory: exactly today's 58 BYTEWAX_TPU_* knobs,
     each with a default and a doc anchor.  Adding a knob requires
     updating contracts.KNOBS, this list, docs/configuration.md, and
     the anchor doc — BTX-KNOB enforces the rest (literal reads,
@@ -558,7 +560,16 @@ def test_knob_catalog_is_pinned():
     BYTEWAX_TPU_CKPT_COMPACT_EVERY (unset — every K closes forces a
     commit/GC watermark so an uncompacted delta chain stays
     bounded), all anchored at docs/recovery.md "Asynchronous
-    incremental checkpoints"."""
+    incremental checkpoints".  The HBM-resident-aggregate PR added
+    exactly two: BYTEWAX_TPU_GSYNC_DEPTH (default 1 — the bounded
+    in-flight window for the collective exchange lane; 1 keeps the
+    original one-round-in-flight overlap, D allows D sealed rounds
+    retired in order), anchored at docs/performance.md "Overlapped
+    collectives", and BYTEWAX_TPU_GSYNC_BASELINE_EVERY (default 8 —
+    under a recovery store the overlapped tier writes a compacting
+    aggregate baseline row every K data rounds so resume replays at
+    most K-1 sealed rounds), anchored at docs/recovery.md
+    "Store-composable overlap"."""
     assert sorted(contracts.KNOBS) == [
         "BYTEWAX_TPU_ACCEL",
         "BYTEWAX_TPU_ALLOW_REMOTE_STOP",
@@ -587,6 +598,8 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_GC",
         "BYTEWAX_TPU_GLOBAL_EXCHANGE",
         "BYTEWAX_TPU_GLOBAL_EXCHANGE_DEBUG",
+        "BYTEWAX_TPU_GSYNC_BASELINE_EVERY",
+        "BYTEWAX_TPU_GSYNC_DEPTH",
         "BYTEWAX_TPU_GSYNC_OVERLAP",
         "BYTEWAX_TPU_GSYNC_QUANT",
         "BYTEWAX_TPU_HB_S",
@@ -617,7 +630,7 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_TRACE_DIR",
         "BYTEWAX_TPU_WIRE",
     ]
-    assert len(contracts.KNOBS) == 56
+    assert len(contracts.KNOBS) == 58
     for name, (default, doc) in contracts.KNOBS.items():
         assert isinstance(default, str), name
         assert doc.startswith("docs/") and doc.endswith(".md"), name

@@ -223,9 +223,14 @@ def test_status_endpoint(entry_point, monkeypatch, tmp_path):
         "phase_totals",
         "phase_fractions",
         "lag",
+        "collective_lane",
     }
     assert isinstance(ledger["recent"], list)
     assert isinstance(ledger["phase_totals"], dict)
+    # The collective exchange-lane window is always present; single
+    # process runs have no global tier, so it pins to None (never a
+    # missing key).
+    assert ledger["collective_lane"] is None
     # The wire section always carries the per-kind pending breakdown
     # and the vocab-session view; in-process runs have no accumulator
     # or comm layer, so both pin to None (never missing keys).
@@ -233,6 +238,39 @@ def test_status_endpoint(entry_point, monkeypatch, tmp_path):
     assert set(wire) >= {"mode", "pending_frames", "pending", "session"}
     assert wire["pending"] is None
     assert wire["session"] is None
+
+
+def test_collective_lane_status_unit_pin():
+    # Satellite pin (HBM-resident-aggregate PR): the exchange-lane
+    # window /status and /graph expose.  lane_status() reports sealed
+    # rounds in flight against the configured depth bound — the lane
+    # is built with depth = BYTEWAX_TPU_GSYNC_DEPTH + 1 (push's
+    # make_room retires round N-depth before round N seals), so the
+    # reported "depth" is the knob value — and pins to None when the
+    # lock-step tier runs (no lane constructed).
+    import threading
+
+    from bytewax_tpu.engine.pipeline import DevicePipeline
+    from bytewax_tpu.engine.sharded_state import GlobalAggState
+
+    st = GlobalAggState.__new__(GlobalAggState)
+    st._lane = None
+    assert st.lane_status() is None
+
+    gate = threading.Event()
+    lane = DevicePipeline("gsync", depth=3, phase="collective_lane")
+    st._lane = lane
+    try:
+        assert st.lane_status() == {"in_flight": 0, "depth": 2}
+        lane.push(lambda: gate.wait(10), lambda _res: None)
+        assert st.lane_status()["in_flight"] == 1
+        gate.set()
+        lane.flush()
+        assert st.lane_status() == {"in_flight": 0, "depth": 2}
+    finally:
+        gate.set()
+        lane.flush()
+        lane.shutdown()
 
 
 def test_route_accumulator_pending_status_covers_both_kinds():

@@ -141,6 +141,39 @@ def test_cli_exits_zero_on_shipped_tree():
     assert "clean" in res.stderr
 
 
+def test_cli_sarif_full_tree_smoke():
+    """CI smoke (satellite of the HBM-resident-aggregate PR): the
+    code-scanning upload path — ``--output sarif`` over the FULL
+    shipped tree (fixtures only exercised it before) — emits one
+    valid SARIF 2.1.0 document: all 11 rules in the driver inventory,
+    zero results (the tree is clean), exit 0."""
+    import json
+
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.analysis",
+            "--output",
+            "sarif",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "bytewax_tpu.analysis"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == set(
+        ALL_RULES
+    )
+    assert run["results"] == []
+
+
 def test_cli_exits_nonzero_on_positive_fixture():
     fixture = (
         REPO / "tests" / "analysis_fixtures" / "fixture_send_alias.py"
