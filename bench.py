@@ -829,6 +829,165 @@ def _run_anomaly_cold_vs_warm():
     return times[0], times[1]
 
 
+# -- streaming inference (docs/inference.md) ---------------------------------
+
+
+def _infer_bench_params(rng):
+    import numpy as np
+
+    return {
+        "w1": rng.randn(4, 8).astype(np.float32),
+        "b1": rng.randn(8).astype(np.float32),
+        "w2": rng.randn(8).astype(np.float32),
+        "b2": np.float32(0.1),
+    }
+
+
+def _infer_bench_apply(params, x):
+    import jax.numpy as jnp
+
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def _run_infer_accel_vs_host(n_rows: int, n_keys: int = 32):
+    """``op.infer`` batched device scoring vs the same model scored
+    per-item on the host tier via ``op.map`` — the path a user would
+    write without the inference subsystem.  The host-tier numpy
+    oracle is asserted in-bench on the device outputs.  Returns
+    ``(accel_events_per_sec, host_events_per_sec)``.
+    """
+    import numpy as np
+
+    import bytewax_tpu.operators as op
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+    rng = np.random.RandomState(11)
+    params = _infer_bench_params(rng)
+    keys = [f"k{i:02d}" for i in range(n_keys)]
+    feats = rng.randn(n_rows, 4).astype(np.float32)
+    inp = [
+        (keys[k], tuple(row))
+        for k, row in zip(rng.randint(0, n_keys, size=n_rows), feats)
+    ]
+    batch_size = 8_192
+
+    def build(tag, rows, accel):
+        flow = Dataflow(f"infer_bench_{tag}")
+        s = op.input(
+            "inp", flow, TestingSource(inp[:rows], batch_size=batch_size)
+        )
+        if accel:
+            s = op.infer("score", s, _infer_bench_apply, params)
+        else:
+            def scorer(kv):
+                x = np.asarray(kv[1], dtype=np.float32)
+                h = np.tanh(x @ params["w1"] + params["b1"])
+                return kv[0], float(h @ params["w2"] + params["b2"])
+
+            s = op.map("score", s, scorer)
+        out = []
+        op.output("out", s, TestingSink(out))
+        return flow, out
+
+    run_main(build("warm", 2 * batch_size, accel=True)[0])  # jit warm
+
+    accel_rate = 0.0
+    accel_out = []
+    for _ in range(2):
+        flow, out = build("accel", n_rows, accel=True)
+        t0 = time.perf_counter()
+        run_main(flow)
+        dt = time.perf_counter() - t0
+        assert len(out) == n_rows
+        accel_rate = max(accel_rate, n_rows / dt)
+        accel_out = out
+
+    # In-bench oracle: the device scores must equal the vectorized
+    # float32 numpy forward pass (order-free — routing interleaves).
+    h = np.tanh(feats @ params["w1"] + params["b1"])
+    want = np.sort(h @ params["w2"] + params["b2"])
+    got = np.sort(np.asarray([v for _k, v in accel_out], dtype=np.float32))
+    assert np.allclose(got, want, rtol=1e-4, atol=1e-5), (
+        "op.infer diverged from the host oracle"
+    )
+
+    host_rows = min(n_rows, 64_000)
+    flow, out = build("host", host_rows, accel=False)
+    t0 = time.perf_counter()
+    run_main(flow)
+    host_rate = host_rows / (time.perf_counter() - t0)
+    assert len(out) == host_rows
+    return accel_rate, host_rate
+
+
+def _run_infer_swap_gap(n_items: int = 300):
+    """Live hot-swap latency: wall milliseconds from a mid-run
+    ``update_params()`` request to the first emission scored by the
+    new generation (the swap itself only commits at the next agreed
+    epoch close — the gap is the user-visible staleness window).
+    """
+    import threading
+    from datetime import timedelta
+
+    import numpy as np
+
+    import bytewax_tpu.operators as op
+    from bytewax_tpu.dataflow import Dataflow
+    from bytewax_tpu.engine import driver as engine_driver
+    from bytewax_tpu.outputs import DynamicSink, StatelessSinkPartition
+    from bytewax_tpu.testing import TestingSource, run_main
+
+    inp = []
+    for _ in range(n_items):
+        inp.append(("k", 1.0))
+        inp.append(TestingSource.PAUSE(timedelta(milliseconds=2)))
+
+    rec = []
+
+    class _TimedPart(StatelessSinkPartition):
+        def write_batch(self, items):
+            now = time.perf_counter()
+            rec.extend((float(v), now) for _k, v in items)
+
+    class _TimedSink(DynamicSink):
+        def build(self, step_id, worker_index, worker_count):
+            return _TimedPart()
+
+    flow = Dataflow("infer_swap_gap_bench")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=1))
+    s = op.infer(
+        "score",
+        s,
+        lambda p, x: x[:, 0] * p["w"],
+        {"w": np.float32(1.0)},
+    )
+    op.output("out", s, _TimedSink())
+
+    swap_at = [None]
+
+    def _swap_when_warm():
+        while len(rec) < n_items // 4:
+            time.sleep(0.001)
+        swap_at[0] = time.perf_counter()
+        engine_driver.update_params({"w": np.float32(3.0)})
+
+    t = threading.Thread(target=_swap_when_warm, daemon=True)
+    t.start()
+    run_main(flow, epoch_interval=timedelta(0))
+    t.join(timeout=5)
+
+    assert len(rec) == n_items
+    assert swap_at[0] is not None, "swap request never fired"
+    post = [ts for v, ts in rec if v == 3.0]
+    assert post, "no emission ever carried the swapped params"
+    # Every item scores exactly once and the timeline splits once.
+    values = [v for v, _ts in rec]
+    assert values == sorted(values), "old-generation score after swap"
+    return (min(post) - swap_at[0]) * 1e3
+
+
 # -- isolated device step ----------------------------------------------------
 
 
@@ -2720,6 +2879,32 @@ def main() -> None:
     except Exception as ex:  # noqa: BLE001 - bench must still report
         extra["flowmap_overhead_pct"] = None
         extra["flowmap_overhead_error"] = str(ex)[:200]
+
+    # Streaming inference (docs/inference.md): op.infer's batched
+    # device scoring vs the same model scored per-item through a
+    # host-tier op.map (the pre-subsystem path), numpy-oracle
+    # asserted in-bench; plus the live hot-swap staleness window
+    # (update_params request -> first new-generation emission).
+    try:
+        infer_rows = int(os.environ.get("BENCH_INFER_ROWS", 512_000))
+        _run_infer_accel_vs_host(2 * 8_192)  # warm both tiers
+        infer_accel, infer_host = max(
+            (_run_infer_accel_vs_host(infer_rows) for _ in range(2)),
+            key=lambda r: r[0],
+        )
+        extra["infer_accel_events_per_sec"] = round(infer_accel)
+        extra["infer_host_map_events_per_sec"] = round(infer_host)
+        extra["infer_accel_vs_host_map"] = round(
+            infer_accel / infer_host, 2
+        )
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["infer_accel_events_per_sec"] = None
+        extra["infer_error"] = str(ex)[:200]
+    try:
+        extra["infer_swap_gap_ms"] = round(_run_infer_swap_gap(), 1)
+    except Exception as ex:  # noqa: BLE001 - bench must still report
+        extra["infer_swap_gap_ms"] = None
+        extra["infer_swap_gap_error"] = str(ex)[:200]
 
     # Persistent-compile-cache cold vs warm start (fresh processes;
     # the warm figure is what a supervised restart or redeploy pays).

@@ -29,6 +29,8 @@ __all__ = [
     "fault_injected_count",
     "generate_python_metrics",
     "gsync_round_count",
+    "infer_params_generation",
+    "infer_rows_count",
     "io_retries_count",
     "item_inp_count",
     "item_out_count",
@@ -288,6 +290,20 @@ step_demotion_count = Counter(
     "bytewax_step_demotion_count",
     "Stateful steps demoted from the device tier to the host tier "
     "after consecutive device faults",
+    ["step_id"],
+)
+
+infer_rows_count = Counter(
+    "bytewax_infer_rows_count",
+    "Rows scored by each op.infer step (both tiers; incremented on "
+    "the main thread when a scoring phase finalizes)",
+    ["step_id"],
+)
+
+infer_params_generation = Gauge(
+    "bytewax_infer_params_generation",
+    "Broadcast-params generation live in each op.infer step "
+    "(0 = the build-time params; each committed hot-swap increments)",
     ["step_id"],
 )
 

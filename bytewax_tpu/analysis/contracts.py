@@ -237,7 +237,11 @@ FAULTS_MODULE = "bytewax_tpu.engine.faults"
 #: sealed in memory but BEFORE it is handed to anything durable
 #: (inline write or the committer lane), so an injected crash there
 #: proves the crash-between-seal-and-commit window replays exactly
-#: the sealed epoch.
+#: the sealed epoch.  ``params_swap`` fires at the agreed epoch close
+#: before any infer runtime installs a pending broadcast-params
+#: update and before the pending target is consumed, so an injected
+#: crash restarts with the target intact and the swap commits exactly
+#: once at the next agreed close (docs/inference.md).
 FAULT_SITES = (
     "comm.send",
     "comm.recv",
@@ -249,6 +253,7 @@ FAULT_SITES = (
     "snapshot.commit",
     "snapshot_seal",
     "rescale_migrate",
+    "params_swap",
     "barrier",
 )
 
@@ -383,6 +388,12 @@ DRAIN_ONLY_METHODS = frozenset(
         # and count ONLY at poll boundaries / drain points, so the
         # count-matched barrier sees exactly what left the process.
         "ship_flush",
+        # broadcast-params hot swap (docs/inference.md): the agreed
+        # install mutates the very params tree in-flight device
+        # phases read, so it may run only with every pipeline
+        # quiesced — i.e. from the epoch-close agreement.
+        "_apply_params_swap",
+        "install_params",
     }
 )
 
@@ -412,6 +423,7 @@ DRAIN_POINTS: FrozenSet[Tuple[str, str]] = frozenset(
     {
         ("bytewax_tpu.engine.driver", "_StatefulBatchRt.advance"),
         ("bytewax_tpu.engine.driver", "_StatefulBatchRt._demote"),
+        ("bytewax_tpu.engine.driver", "_InferRt._demote"),
         ("bytewax_tpu.engine.driver", "_Driver._close_epoch"),
         ("bytewax_tpu.engine.driver", "_Driver._close_epoch_inner"),
         ("bytewax_tpu.engine.driver", "_Driver._drain_pipelines"),
@@ -714,6 +726,8 @@ LANE_TEARDOWN_ROOTS: FrozenSet[Tuple[str, str]] = frozenset(
         ("bytewax_tpu.engine.driver", "_Driver._close_epoch_inner"),
         # device-tier demotion: the host tier takes over mid-run.
         ("bytewax_tpu.engine.driver", "_StatefulBatchRt._demote"),
+        # infer-tier demotion (broadcast params → host numpy apply).
+        ("bytewax_tpu.engine.driver", "_InferRt._demote"),
     }
 )
 
@@ -742,6 +756,8 @@ RACE_WORKER_CARVEOUTS: FrozenSet[str] = frozenset(
         "DeviceWindowAggState._ingest.<locals>.device_phase",
         "bytewax_tpu.engine.driver:"
         "_StatefulBatchRt._scan_batch.<locals>.batch_phase",
+        "bytewax_tpu.engine.driver:"
+        "_InferRt._infer_batch.<locals>.batch_phase",
     }
 )
 
@@ -834,6 +850,7 @@ KNOBS: Dict[str, Tuple[str, str]] = {
     "BYTEWAX_TPU_HB_S": ("0", "docs/recovery.md"),
     "BYTEWAX_TPU_HEARTBEAT_S": ("30", "docs/profiling.md"),
     "BYTEWAX_TPU_HOST_STATE_BUDGET": ("", "docs/state-residency.md"),
+    "BYTEWAX_TPU_INFER_DEVICE": ("1", "docs/inference.md"),
     "BYTEWAX_TPU_INGEST_TARGET_ROWS": ("", "docs/performance.md"),
     "BYTEWAX_TPU_IO_BACKOFF_CAP_S": ("5", "docs/recovery.md"),
     "BYTEWAX_TPU_IO_BACKOFF_S": ("0.05", "docs/recovery.md"),

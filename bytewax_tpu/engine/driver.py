@@ -74,6 +74,7 @@ __all__ = [
     "reset_stop",
     "run_main",
     "stop_requested",
+    "update_params",
 ]
 
 _EMPTY_COOLDOWN = timedelta(milliseconds=1)
@@ -329,6 +330,76 @@ def _consume_reconfigure(
         addrs, wpp = _RECONFIG_TARGET
         if addrs == spec[0] and (wpp is None or wpp == spec[1]):
             _RECONFIG_TARGET = None
+
+
+#: Pending broadcast-params update for this process's infer steps
+#: (docs/inference.md): ``(step_id or None for every infer step,
+#: digest, normalized params pytree)``.  Module-level like the stop
+#: flag and the reconfigure target — the setters (``POST /model``,
+#: embedders) outlive the driver, and the request must survive an
+#: in-process supervised restart until an agreed epoch close installs
+#: it (that survival IS the exactly-once story: a crash between the
+#: agreement and the install replays the close and re-agrees).
+_MODEL_LOCK = threading.Lock()
+_MODEL_TARGET: Optional[Tuple[Optional[str], str, Any]] = None
+
+
+def update_params(
+    params: Any,
+    step_id: Optional[str] = None,
+    source: str = "api",
+) -> str:
+    """Request a hot swap of an ``op.infer`` step's broadcast params.
+
+    The pending update rides the EXISTING epoch-close sync payload
+    (like the stop vote and the reconfigure target — no new
+    control-frame kinds): once every process proposes the same
+    ``(step_id, digest)`` the agreed close installs the new params on
+    every worker before the next epoch opens, so the whole cluster
+    swaps at one globally-ordered point.  Params never cross the mesh
+    — each process is handed the pytree locally (the HTTP body, an
+    embedder call) and the digest agreement proves they match.
+
+    ``step_id`` targets one infer step by its core step id (``None``
+    = every infer step whose params tree is compatible).  Returns the
+    content digest recorded for the swap.  Safe to call from any
+    thread.
+    """
+    global _MODEL_TARGET
+    from bytewax_tpu.engine.infer import normalize_params, params_digest
+
+    normalized = normalize_params(params)
+    digest = params_digest(normalized)
+    with _MODEL_LOCK:
+        _MODEL_TARGET = (step_id, digest, normalized)
+    _flight.note_params_requested(step_id, digest, source)
+    return digest
+
+
+def _pending_params() -> Optional[Tuple[Optional[str], str, Any]]:
+    with _MODEL_LOCK:
+        return _MODEL_TARGET
+
+
+def reset_params_update() -> None:
+    """Clear a pending params update (entry points consume it
+    implicitly when they return — like a stop request, it targets one
+    execution, not the process forever)."""
+    global _MODEL_TARGET
+    with _MODEL_LOCK:
+        _MODEL_TARGET = None
+
+
+def _consume_params(spec: Tuple[Optional[str], str]) -> None:
+    """Clear the pending update iff it still matches the
+    ``(step_id, digest)`` just installed (a NEWER update posted
+    mid-close must survive for the next close)."""
+    global _MODEL_TARGET
+    with _MODEL_LOCK:
+        if _MODEL_TARGET is None:
+            return
+        if (_MODEL_TARGET[0], _MODEL_TARGET[1]) == spec:
+            _MODEL_TARGET = None
 
 
 class _Reconfigure:
@@ -706,6 +777,7 @@ def _supervised(
         # within the invocation.
         _STOP_EVENT.clear()
         reset_reconfigure()
+        reset_params_update()
 
 
 class _StepError(RuntimeError):
@@ -1433,6 +1505,11 @@ class _StatefulBatchRt(_OpRt):
         self.agg: Optional[DeviceAggState] = None
         self.wagg = None
         self.sagg = None
+        #: Device-tier broadcast-params scoring state (``op.infer``
+        #: lowering; engine/infer.py).  Only ever non-None on the
+        #: :class:`_InferRt` subclass the factory picks for infer
+        #: steps.
+        self.iagg = None
         #: Consecutive device-dispatch faults on this step; at
         #: ``driver.demote_after`` the step is demoted to the host
         #: tier (state migrated) for the rest of the execution.
@@ -1467,6 +1544,14 @@ class _StatefulBatchRt(_OpRt):
                 # Per-row-emitting stateful_map lowering (segmented
                 # device scan over per-key numeric state).
                 self.sagg = spec.make_state()
+            elif type(spec).__name__ == "InferAccelSpec" and (
+                os.environ.get("BYTEWAX_TPU_INFER_DEVICE", "1") != "0"
+            ):
+                # Batched model scoring (op.infer): jitted forward
+                # pass over broadcast params.  The knob forces the
+                # host numpy apply without disabling every other
+                # device tier the flow may carry.
+                self.iagg = spec.make_state()
         # Tiered key-state residency (docs/state-residency.md): with
         # BYTEWAX_TPU_STATE_BUDGET set, the keyed-aggregation and scan
         # tiers wrap in a manager that bounds device-resident keys,
@@ -1495,6 +1580,7 @@ class _StatefulBatchRt(_OpRt):
         if (
             self.wagg is not None
             or self.sagg is not None
+            or self.iagg is not None
             or (
                 self.agg is not None
                 and not getattr(self.agg, "global_exchange", False)
@@ -1534,22 +1620,29 @@ class _StatefulBatchRt(_OpRt):
         # src/operators.rs:976-1006).
         page: List[Tuple[str, Any]] = []
         pager = self.agg if self.agg is not None else self.sagg
-        for key, state in driver.iter_resume_states(op.step_id):
-            if not driver.is_local(_route_hash(key) % driver.worker_count):
-                continue
-            if pager is not None:
-                page.append((key, state))
-                if len(page) >= 4096:
-                    pager.load_many(page)
-                    page = []
-            elif self.wagg is not None:
-                self.wagg.load(key, state)
-            else:
-                logic = self._build(state)
-                self.logics[key] = logic
-                self._resched(key, logic)
-        if page:
-            pager.load_many(page)
+        if type(spec).__name__ != "InferAccelSpec":
+            # Infer steps skip the per-key resume walk: their one
+            # broadcast-state row restores route-agnostically in
+            # _InferRt.__init__ (building a host logic from it here
+            # would shadow the params with a bogus keyed state).
+            for key, state in driver.iter_resume_states(op.step_id):
+                if not driver.is_local(
+                    _route_hash(key) % driver.worker_count
+                ):
+                    continue
+                if pager is not None:
+                    page.append((key, state))
+                    if len(page) >= 4096:
+                        pager.load_many(page)
+                        page = []
+                elif self.wagg is not None:
+                    self.wagg.load(key, state)
+                else:
+                    logic = self._build(state)
+                    self.logics[key] = logic
+                    self._resched(key, logic)
+            if page:
+                pager.load_many(page)
 
     # -- dispatch pipeline -------------------------------------------------
 
@@ -1978,6 +2071,7 @@ class _StatefulBatchRt(_OpRt):
                         self.wagg is None
                         and self.agg is None
                         and self.sagg is None
+                        and self.iagg is None
                     ):
                         return False
                 if self.driver.trace_ops:
@@ -2514,6 +2608,246 @@ class _StatefulBatchRt(_OpRt):
         return snaps
 
 
+class _InferRt(_StatefulBatchRt):
+    """Runtime for ``op.infer`` core steps: batched model scoring
+    over broadcast params (engine/infer.py, docs/inference.md).
+
+    Unlike every other stateful runtime the state here is BROADCAST —
+    one params pytree, identical on every worker — so deliveries are
+    never split/re-routed by key (rows score where they land;
+    emissions re-route downstream), the per-key resume walk is
+    skipped in favor of one route-agnostic ``"_params"`` row, and
+    only the row's route owner writes it at epoch close.  The device
+    tier (``self.iagg``) runs the jitted forward pass on the shared
+    dispatch pipeline; demotion and accel-off runs carry the same
+    generation to a host numpy apply (``self._host_infer``).  Params
+    swaps commit ONLY from the epoch-close agreement
+    (:meth:`_Driver._apply_params_swap`) — a drain point, so no
+    in-flight device phase can observe a half-installed tree.
+    """
+
+    def __init__(self, op: Operator, driver: "_Driver"):
+        super().__init__(op, driver)
+        from bytewax_tpu.engine.infer import PARAMS_KEY
+
+        self.spec = op.conf["_accel"]
+        #: Host-tier scorer: live from the start when the device tier
+        #: is off (accel disabled / BYTEWAX_TPU_INFER_DEVICE=0), else
+        #: built at demotion from the device snapshot.
+        self._host_infer = (
+            None
+            if self.iagg is not None
+            else self.spec.make_host_state()
+        )
+        #: (epoch, digest) of the last committed swap, for /status.
+        self.last_swap: Optional[Tuple[int, str]] = None
+        snap = driver.resume_state(op.step_id, PARAMS_KEY)
+        if snap is not None:
+            self._holder().load_state(snap)
+            #: True while the live params lack a durable snaps row.
+            self._params_dirty = False
+        else:
+            # Fresh run: write the generation-0 row at the first
+            # close so resume restores the exact initial params.
+            self._params_dirty = True
+        _flight.note_params_generation(
+            op.step_id, self._holder().generation
+        )
+
+    def _holder(self):
+        """The live params holder — whichever tier owns scoring."""
+        return self.iagg if self.iagg is not None else self._host_infer
+
+    def process(self, port: str, entries: List[Entry]) -> None:
+        # NO _split_remote: scoring is stateless per row over
+        # broadcast params, so rows score wherever they land and only
+        # the OUTPUT re-routes by key (downstream keyed steps still
+        # see correctly-routed deliveries).
+        if self.iagg is not None:
+            if self._dispatch_device(entries):
+                return
+            # Demoted mid-delivery: the host apply (seeded from the
+            # device snapshot) takes this same delivery.
+        self._process_host(entries)
+
+    def _process_device(self, entries: List[Entry]) -> None:
+        assert self.iagg is not None
+        for _w, items in entries:
+            try:
+                with self._timer("stateful_batch_on_batch").time():
+                    phase = self._infer_batch(items)
+            except NonNumericValues as ex:
+                _reraise(self.op.step_id, "the infer features", ex)
+            except TypeError as ex:
+                _reraise(self.op.step_id, "the infer features", ex)
+            if phase is None:
+                continue
+            self._push_infer_task(phase)
+
+    def _infer_batch(self, items: Any):
+        """Host phase of one delivery: feature extraction plus every
+        check that can reject the rows runs HERE, on the caller's
+        thread, before anything enters the pipeline.  Returns None
+        for an empty delivery, else a zero-arg sealed device phase
+        producing ``(keys, out_items)``."""
+        from bytewax_tpu.engine.infer import (
+            assemble_items,
+            extract_features,
+        )
+
+        keys, feats = extract_features(items)
+        if not len(keys):
+            return None
+        iagg = self.iagg
+
+        def batch_phase():
+            cols = iagg.score_rows(feats)
+            return keys, assemble_items(keys, cols)
+
+        return batch_phase
+
+    def _push_infer_task(self, phase) -> None:
+        """Route one delivery's scoring phase (padded jitted forward
+        pass + readback + output assembly) through the pipeline;
+        finalize emits the per-row outputs downstream."""
+        step_id = self.op.step_id
+
+        def task():
+            try:
+                return phase()
+            except DeviceFault:
+                raise
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(step_id, "the model apply", ex)
+
+        def finalize(res) -> None:
+            keys, out_items = res
+            _flight.note_infer_rows(step_id, len(out_items))
+            self._emit_infer(keys, out_items)
+
+        if self._pipe is None:
+            finalize(task())
+        else:
+            self._pipe.push(task, finalize)
+
+    def _process_host(self, entries: List[Entry]) -> None:
+        from bytewax_tpu.engine.infer import (
+            assemble_items,
+            extract_features,
+        )
+
+        for _w, items in entries:
+            try:
+                with self._timer("stateful_batch_on_batch").time():
+                    keys, feats = extract_features(items)
+                    if not len(keys):
+                        continue
+                    cols = self._host_infer.score_rows(feats)
+            except NonNumericValues as ex:
+                _reraise(self.op.step_id, "the infer features", ex)
+            except TypeError as ex:
+                _reraise(self.op.step_id, "the infer features", ex)
+            except BaseException as ex:  # noqa: BLE001
+                _reraise(self.op.step_id, "the model apply", ex)
+            out_items = assemble_items(keys, cols)
+            _flight.note_infer_rows(self.op.step_id, len(out_items))
+            self._emit_infer(keys, out_items)
+
+    def _emit_infer(self, keys, out_items: List[Any]) -> None:
+        """Emit scored rows, re-routed by key hash (the input was
+        taken wherever it landed, so routing correctness for any
+        keyed consumer downstream is restored here)."""
+        w_count = self.driver.worker_count
+        if w_count == 1:
+            self.emit("down", (0, out_items))
+            return
+        dests = _route_hashes_of(list(keys)) % w_count
+        for d in np.unique(dests).tolist():
+            idx = np.nonzero(dests == d)[0].tolist()
+            self.emit("down", (d, [out_items[j] for j in idx]))
+
+    def _demote(self, reason: str) -> None:
+        """Demote scoring to the host numpy apply, carrying the
+        broadcast params across tiers through the same snapshot
+        format recovery uses — the params generation survives
+        demotion exactly."""
+        from bytewax_tpu.engine.infer import PARAMS_KEY
+
+        self.pipeline_flush()
+        self._pipe_shutdown()
+        pairs = dict(self.iagg.demotion_snapshots())
+        self.iagg = None
+        self._host_infer = self.spec.make_host_state(
+            pairs.get(PARAMS_KEY)
+        )
+        self.demoted = reason
+        _flight.note_demotion(self.op.step_id, reason, 1)
+
+    def install_params(
+        self, params: Any, digest: str, epoch: int
+    ) -> bool:
+        """Install an agreed params update into whichever tier is
+        live.  Called ONLY from the epoch-close swap commit (a drain
+        point — the pipeline is quiesced, so no in-flight phase reads
+        the tree mid-swap).  False (tree mismatch) leaves the
+        incumbent params untouched."""
+        holder = self._holder()
+        ok = holder.install(params, digest, epoch)
+        if ok:
+            self._params_dirty = True
+            self.last_swap = (epoch, digest)
+            _flight.note_params_swap(
+                self.op.step_id, epoch, digest, holder.generation
+            )
+        return ok
+
+    def live_tier(self) -> str:
+        """Which tier scores right now (the /graph overlay hook)."""
+        return "device" if self.iagg is not None else "host"
+
+    def infer_status(self) -> Dict[str, Any]:
+        holder = self._holder()
+        return {
+            "tier": self.live_tier(),
+            "generation": holder.generation,
+            "digest": holder.digest,
+            "last_swap": (
+                list(self.last_swap) if self.last_swap else None
+            ),
+        }
+
+    def epoch_snaps(self) -> List[Tuple[str, Optional[Any]]]:
+        # Same backstop as the base: snapshots only read post-flush
+        # state.
+        self.pipeline_flush()
+        self.awoken.clear()
+        if not self._params_dirty:
+            return []
+        from bytewax_tpu.engine.infer import PARAMS_KEY
+
+        # Broadcast state: every process holds identical params, so
+        # exactly one row is durable — written by the key's route
+        # owner (the store route-stamps rows by key hash; resume
+        # reads the row back route-agnostically on every process).
+        self._params_dirty = False
+        owner = _route_hash(PARAMS_KEY) % self.driver.worker_count
+        if not self.driver.is_local(owner):
+            return []
+        with self._timer("snapshot").time():
+            return [(PARAMS_KEY, self._holder().snapshot_state())]
+
+
+def _stateful_batch_rt(op: Operator, driver: "_Driver"):
+    """Runtime factory for core ``stateful_batch`` steps: infer-
+    annotated steps get the dedicated broadcast-params runtime (it
+    owns BOTH tiers — the host fallback logic in
+    operators/inference.py exists only as a safety net), everything
+    else the generic per-key runtime."""
+    if type(op.conf.get("_accel")).__name__ == "InferAccelSpec":
+        return _InferRt(op, driver)
+    return _StatefulBatchRt(op, driver)
+
+
 class _OutputRt(_OpRt):
     def __init__(self, op: Operator, driver: "_Driver"):
         super().__init__(op, driver)
@@ -2730,7 +3064,7 @@ _RT_FOR = {
     "merge": _MergeRt,
     "redistribute": _RedistributeRt,
     "inspect_debug": _InspectDebugRt,
-    "stateful_batch": _StatefulBatchRt,
+    "stateful_batch": _stateful_batch_rt,
     "output": _OutputRt,
     "_noop": _NoopRt,
 }
@@ -2942,6 +3276,12 @@ class _Driver:
                 op.step_id
                 for op in self.plan.ops
                 if op.name in ("input", "output")
+                # Infer steps carry exactly one broadcast-state row
+                # ("_params") that must restore on EVERY process
+                # regardless of which route owner wrote it — eager
+                # and route-agnostic, like the io partition states.
+                or type(op.conf.get("_accel")).__name__
+                == "InferAccelSpec"
             ]
             if io_steps:
                 self._loads = {
@@ -3382,6 +3722,16 @@ class _Driver:
         self.dlq.flush()
         self._ckpt_seal(workers)
         pending_reconfig = self._reconfig_spec(_pending_reconfigure())
+        pending_model = _pending_params()
+        # The vote is (step_id, digest) only — the params tree itself
+        # NEVER rides the wire (each process installs from its own
+        # pending copy, exactly like the reconfigure target's address
+        # list), so the swap adds zero new send surface.
+        model_vote = (
+            (pending_model[0], pending_model[1])
+            if pending_model is not None
+            else None
+        )
         if self.comm is not None:
             # Epoch-close sync round: the graceful-stop vote, the
             # live-reconfigure proposal, and the telemetry piggyback.
@@ -3401,6 +3751,7 @@ class _Driver:
             payload = {
                 "stop": _STOP_EVENT.is_set(),
                 "reconfig": pending_reconfig,
+                "model": model_vote,
                 "summary": (
                     _flight.RECORDER.summary(self.epoch)
                     if self._flight_sync
@@ -3418,6 +3769,21 @@ class _Driver:
                 }
                 if len(specs) == 1 and None not in specs:
                     self._agree_reconfigure(specs.pop())
+                # Params hot-swap rides the same round: commits only
+                # once EVERY process carries the SAME pending
+                # (step, digest) — partial delivery defers the swap
+                # to a later close, exactly like the reconfigure
+                # target.  A close that agreed a membership change
+                # skips the swap (the pending target survives the
+                # in-process re-entry and lands at the new
+                # generation's first close).
+                models = {r.get("model") for r in replies.values()}
+                if (
+                    self._reconfig_agreed is None
+                    and len(models) == 1
+                    and None not in models
+                ):
+                    self._apply_params_swap(models.pop())
             if self._flight_sync:
                 _flight.RECORDER.cluster = {
                     pid: r["summary"]
@@ -3429,6 +3795,9 @@ class _Driver:
             self._stop_agreed = True
         elif pending_reconfig is not None:
             self._agree_reconfigure(pending_reconfig)
+        elif model_vote is not None:
+            # Single process: this close is trivially the agreed one.
+            self._apply_params_swap(model_vote)
         if self._stop_agreed or self._reconfig_agreed is not None:
             # Run-ending close: no next close will fence the global
             # tier's overlapped exchange round, so land it HERE —
@@ -3866,6 +4235,55 @@ class _Driver:
         addrs, wpp = pending
         return (addrs, wpp if wpp is not None else self.wpp)
 
+    def _apply_params_swap(
+        self, spec: Tuple[Optional[str], str]
+    ) -> None:
+        """The close round just proved every process carries the same
+        pending params update (``(step_id, digest)``): install it from
+        the LOCAL pending copy into every matching infer runtime,
+        then consume the target.
+
+        The pinned ``params_swap`` fault site fires FIRST — before any
+        runtime mutates and before the target is consumed — so an
+        injected crash restarts (supervised, in-process) with the
+        module-level pending target intact and the swap lands exactly
+        once at the next agreed close.  Runs at a drain point (every
+        pipeline quiesced by this close), so no in-flight device
+        phase can observe a half-installed tree; the new params score
+        the FIRST delivery of the next epoch."""
+        pending = _pending_params()
+        if pending is None or (pending[0], pending[1]) != spec:
+            # A newer local update raced the agreement: keep it
+            # pending — it rides a later close once every process
+            # holds it.
+            return
+        step_id, digest, params = pending
+        _faults.fire("params_swap", step=step_id or "")
+        swapped = False
+        for rt in self.rts:
+            install = getattr(rt, "install_params", None)
+            if install is None:
+                continue
+            if step_id is not None and rt.op.step_id not in (
+                step_id,
+                f"{step_id}.stateful_batch",
+            ):
+                continue
+            if install(params, digest, self.epoch):
+                swapped = True
+        _consume_params(spec)
+        if not swapped:
+            # No runtime took the tree (no infer step matched, or the
+            # pytree structure/shapes mismatch the incumbent): the
+            # run continues on the incumbent params — surface the
+            # rejection in the flight ring rather than unwind.
+            _flight.RECORDER.record(
+                "params_swap_rejected",
+                step=step_id or "",
+                digest=digest,
+                epoch=self.epoch,
+            )
+
     def _agree_reconfigure(
         self, spec: Tuple[Tuple[str, ...], int]
     ) -> None:
@@ -4184,6 +4602,11 @@ class _Driver:
             },
             "rescale_hint": self._rescale_hint(),
             "checkpoint": self._ckpt_status(),
+            "infer": {
+                rt.op.step_id: rt.infer_status()
+                for rt in rts
+                if isinstance(rt, _InferRt)
+            },
             "wire": {
                 "mode": _wire.wire_mode(),
                 "pending_frames": (
@@ -4259,7 +4682,11 @@ class _Driver:
         tiers: Dict[str, str] = {}
         lanes: Dict[str, Optional[Dict[str, int]]] = {}
         for rt in self.rts:
-            if getattr(rt, "demoted", None):
+            if isinstance(rt, _InferRt):
+                # Infer steps report the tier that actually scores
+                # (device until demotion/knob-off, host after).
+                tiers[rt.op.step_id] = rt.live_tier()
+            elif getattr(rt, "demoted", None):
                 tiers[rt.op.step_id] = "host"
             elif getattr(
                 getattr(rt, "agg", None), "global_exchange", False
@@ -4395,6 +4822,9 @@ class _Driver:
                 addrs, wpp, source="http"
             ),
             graph_fn=self._graph,
+            model_fn=lambda params, step_id=None: update_params(
+                params, step_id, source="http"
+            ),
         )
         try:
             if clustered:

@@ -13,7 +13,8 @@ Plan syntax — comma-separated specs::
 - ``site``: one of :data:`SITES` (``comm.send``, ``comm.recv``,
   ``device_dispatch``, ``residency_restore``, ``source_poll``,
   ``sink_write``, ``snapshot.write``, ``snapshot.commit``,
-  ``snapshot_seal``, ``rescale_migrate``, ``barrier``).
+  ``snapshot_seal``, ``rescale_migrate``, ``params_swap``,
+  ``barrier``).
 - ``kind``: ``delay`` (sleep ``BYTEWAX_TPU_FAULT_DELAY_S``, default
   0.05s), ``drop`` (suppress the frame — only meaningful at
   ``comm.send``; breaks the barrier's in-flight accounting on purpose,
@@ -90,6 +91,11 @@ __all__ = [
 #: committer lane under ``BYTEWAX_TPU_CKPT_ASYNC=1``) — a crash there
 #: proves the seal→commit window resumes from the previous durable
 #: close (docs/recovery.md "Asynchronous incremental checkpoints").
+#: ``params_swap`` fires at the agreed epoch close, before any infer
+#: runtime installs the pending params update and before the pending
+#: target is consumed — a crash there restarts with the target intact
+#: (module state survives supervised in-process restarts), so the swap
+#: commits exactly once at the next agreed close (docs/inference.md).
 SITES = (
     "comm.send",
     "comm.recv",
@@ -101,6 +107,7 @@ SITES = (
     "snapshot.commit",
     "snapshot_seal",
     "rescale_migrate",
+    "params_swap",
     "barrier",
 )
 

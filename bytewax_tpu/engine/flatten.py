@@ -47,6 +47,18 @@ def _annotate_accel(op: Operator) -> None:
             from bytewax_tpu.engine.scan_accel import ScanAccelSpec
 
             spec = ScanAccelSpec(kind)
+    elif op.name == "infer":
+        # Model scoring always lowers: the spec's batched forward
+        # pass is the step's one semantics (the driver's infer
+        # runtime owns both tiers, so accel-off runs the same spec's
+        # host apply, not per-key Python logics).
+        from bytewax_tpu.engine.infer import InferAccelSpec
+
+        spec = InferAccelSpec(
+            op.conf["apply_fn"],
+            op.conf["params"],
+            op.conf.get("host_apply"),
+        )
     elif op.name in ("count_window", "fold_window", "reduce_window"):
         spec = _window_accel_spec(op)
     if spec is not None:

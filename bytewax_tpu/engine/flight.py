@@ -1019,6 +1019,61 @@ def note_demotion(step_id: str, reason: str, keys: int) -> None:
     )
 
 
+_infer_children: Dict[str, Any] = {}
+
+
+def note_infer_rows(step_id: str, rows: int) -> None:
+    """``rows`` scored through an ``op.infer`` step (either tier);
+    incremented on the main thread when a scoring phase finalizes."""
+    child = _infer_children.get(step_id)
+    if child is None:
+        from bytewax_tpu._metrics import infer_rows_count
+
+        with _lock:
+            child = _infer_children.setdefault(
+                step_id, infer_rows_count.labels(step_id)
+            )
+    child.inc(rows)
+    RECORDER.count("infer_rows_count", rows)
+
+
+def note_params_generation(step_id: str, generation: int) -> None:
+    """The live broadcast-params generation of an ``op.infer`` step
+    (set at build/resume and after each committed hot-swap)."""
+    from bytewax_tpu._metrics import infer_params_generation
+
+    infer_params_generation.labels(step_id).set(generation)
+
+
+def note_params_requested(
+    step_id: Optional[str], digest: str, source: str
+) -> None:
+    """A params hot-swap was requested (pending until a cluster-
+    agreed epoch close commits it — docs/inference.md)."""
+    RECORDER.record(
+        "params_requested",
+        step=step_id or "",
+        digest=digest,
+        source=source,
+    )
+
+
+def note_params_swap(
+    step_id: str, epoch: int, digest: str, generation: int
+) -> None:
+    """A params hot-swap committed at the agreed close of ``epoch``
+    (the swap epoch + digest land in the ring for audit)."""
+    note_params_generation(step_id, generation)
+    RECORDER.count("params_swap_count")
+    RECORDER.record(
+        "params_swap",
+        step=step_id,
+        epoch=epoch,
+        digest=digest,
+        generation=generation,
+    )
+
+
 _pipeline_children: Dict[str, Any] = {}
 
 

@@ -38,7 +38,14 @@ Reference parity (``/root/reference/src/webserver/mod.rs``): when
   change agrees at an epoch close and each process rebuilds (or
   retires) at the run-startup re-entry point without leaving the
   process.  Same loopback-only guard as ``/stop``
-  (``BYTEWAX_TPU_ALLOW_REMOTE_STOP``), and
+  (``BYTEWAX_TPU_ALLOW_REMOTE_STOP``),
+- ``POST /model`` — request a hot swap of an ``op.infer`` step's
+  broadcast params (docs/inference.md): body
+  ``{"params": <pytree of numbers/nested lists>, "step_id": "..."?}``
+  records the pending update; it commits on every worker at the next
+  cluster-agreed epoch close (the params never cross the mesh — post
+  the same body to every process).  Same loopback-only guard as
+  ``/stop``, and
 - ``GET /stacks`` — a ``faulthandler``-style plain-text dump of every
   thread's current Python stack (main loop, pipeline workers, comm),
   for diagnosing a hung barrier without attaching py-spy.
@@ -90,6 +97,7 @@ class _Handler(BaseHTTPRequestHandler):
     health_fn: Optional[Callable[[], dict]] = None
     stop_fn: Optional[Callable[[], None]] = None
     reconfigure_fn: Optional[Callable[[list, Optional[int]], None]] = None
+    model_fn: Optional[Callable[..., str]] = None
 
     def _respond_json(self, code: int, payload: dict) -> None:
         body = json.dumps(payload).encode()
@@ -140,6 +148,31 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as ex:  # noqa: BLE001 - never 500 the plane
                 self._respond_json(
                     400, {"reconfiguring": False, "error": str(ex)}
+                )
+            return
+        if self.path == "/model" and type(self).model_fn is not None:
+            # Broadcast-params hot swap (docs/inference.md): record
+            # the pending update; it commits on every worker at the
+            # next cluster-agreed epoch close.  Body:
+            # {"params": <pytree of numbers/nested lists>,
+            #  "step_id": "..."?}.
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if "params" not in req:
+                    msg = "body must carry a 'params' pytree"
+                    raise ValueError(msg)
+                step_id = req.get("step_id")
+                digest = type(self).model_fn(
+                    req["params"],
+                    str(step_id) if step_id is not None else None,
+                )
+                self._respond_json(
+                    200, {"accepted": True, "digest": digest}
+                )
+            except Exception as ex:  # noqa: BLE001 - never 500 the plane
+                self._respond_json(
+                    400, {"accepted": False, "error": str(ex)}
                 )
             return
         self.send_response(404)
@@ -232,6 +265,7 @@ def maybe_start_server(
         Callable[[list, Optional[int]], None]
     ] = None,
     graph_fn: Optional[Callable[[], dict]] = None,
+    model_fn: Optional[Callable[..., str]] = None,
 ) -> Optional[_ApiServer]:
     """Start the API server if ``BYTEWAX_DATAFLOW_API_ENABLED`` is
     set (to anything but ``0``); returns a handle to shut it down,
@@ -246,7 +280,9 @@ def maybe_start_server(
     membership-change request, docs/recovery.md "Live partial
     rescale" — same loopback guard as ``/stop``); ``graph_fn``
     returns the annotated topology for ``GET /graph`` (empty document
-    when absent); ``port_offset`` is this process's rank among
+    when absent); ``model_fn`` arms ``POST /model`` (a broadcast-
+    params hot-swap request, docs/inference.md — same loopback guard
+    as ``/stop``); ``port_offset`` is this process's rank among
     co-located cluster processes."""
     from bytewax_tpu.engine.flight import _truthy
 
@@ -277,30 +313,33 @@ def maybe_start_server(
         + port_offset
     )
     if (
-        stop_fn is not None or reconfigure_fn is not None
+        stop_fn is not None
+        or reconfigure_fn is not None
+        or model_fn is not None
     ) and host not in (
         "127.0.0.1",
         "localhost",
         "::1",
     ):
-        # POST /stop and /reconfigure are the plane's mutating
-        # endpoints and carry no auth: off loopback (the probe-wiring
-        # 0.0.0.0 case) they would let any network peer drain — or
-        # resize — the whole cluster.  Serve them there only behind
-        # the explicit opt-in knob; the read-only endpoints stay up
-        # either way.
+        # POST /stop, /reconfigure and /model are the plane's
+        # mutating endpoints and carry no auth: off loopback (the
+        # probe-wiring 0.0.0.0 case) they would let any network peer
+        # drain, resize — or re-model — the whole cluster.  Serve
+        # them there only behind the explicit opt-in knob; the
+        # read-only endpoints stay up either way.
         if os.environ.get(
             "BYTEWAX_TPU_ALLOW_REMOTE_STOP", "0"
         ) in ("", "0"):
             logger.warning(
-                "POST /stop and /reconfigure disabled on "
+                "POST /stop, /reconfigure and /model disabled on "
                 "non-loopback bind %s; set "
                 "BYTEWAX_TPU_ALLOW_REMOTE_STOP=1 to accept remote "
-                "stop/reconfigure requests (docs/deployment.md)",
+                "control requests (docs/deployment.md)",
                 host,
             )
             stop_fn = None
             reconfigure_fn = None
+            model_fn = None
     handler = type(
         "_BoundHandler",
         (_Handler,),
@@ -311,6 +350,7 @@ def maybe_start_server(
             "health_fn": staticmethod(health_fn),
             "stop_fn": staticmethod(stop_fn),
             "reconfigure_fn": staticmethod(reconfigure_fn),
+            "model_fn": staticmethod(model_fn),
         },
     )
     try:

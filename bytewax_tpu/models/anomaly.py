@@ -16,7 +16,7 @@ import bytewax_tpu.operators as op
 from bytewax_tpu.dataflow import Dataflow
 from bytewax_tpu.outputs import Sink
 
-__all__ = ["ZScoreState", "anomaly_flow"]
+__all__ = ["ZScoreState", "anomaly_flow", "anomaly_infer_flow"]
 
 
 @dataclass
@@ -59,6 +59,88 @@ def anomaly_flow(
     flow = Dataflow("anomaly_detector")
     s = op.input("inp", flow, source)
     scored = op.stateful_map("zscore", s, zscore(threshold))
+    if fmt is not None:
+        scored = op.map("fmt", scored, fmt)
+    op.output("out", scored, sink)
+    return flow
+
+
+def _welford_features(state, value):
+    """Keyed feature extractor for the ``op.infer`` port: emits the
+    PRE-update ``(value, count, value - mean, m2)`` row (matching the
+    bespoke mapper, which scores before the value folds in), then
+    applies the Welford update.  The residual ``value - mean`` is
+    computed here in float64 — re-deriving it on-device from float32
+    ``value`` and ``mean`` columns would cancel catastrophically on
+    near-mean rows."""
+    count, mean, m2 = (0, 0.0, 0.0) if state is None else state
+    feats = (float(value), float(count), float(value - mean), float(m2))
+    count += 1
+    delta = value - mean
+    mean += delta / count
+    m2 += delta * (value - mean)
+    return (count, mean, m2), feats
+
+
+def _zscore_apply(params, x):
+    """jax forward pass: z-score a ``[N, 4]`` pre-update Welford batch
+    against the broadcast ``threshold`` param."""
+    import jax.numpy as jnp
+
+    value, count, resid, m2 = x[:, 0], x[:, 1], x[:, 2], x[:, 3]
+    std = jnp.sqrt(jnp.maximum(m2 / jnp.maximum(count - 1.0, 1.0), 0.0))
+    ok = (count >= 2.0) & (std > 0.0)
+    z = jnp.where(ok, resid / jnp.where(ok, std, 1.0), 0.0)
+    flag = (jnp.abs(z) > params["threshold"]).astype(jnp.float32)
+    return value, z, flag
+
+
+def _zscore_apply_host(params, x):
+    """numpy twin of :func:`_zscore_apply` (the demoted/host tier)."""
+    import numpy as np
+
+    value, count, resid, m2 = x[:, 0], x[:, 1], x[:, 2], x[:, 3]
+    std = np.sqrt(np.maximum(m2 / np.maximum(count - 1.0, 1.0), 0.0))
+    ok = (count >= 2.0) & (std > 0.0)
+    z = np.where(ok, resid / np.where(ok, std, 1.0), 0.0)
+    flag = (np.abs(z) > params["threshold"]).astype(np.float32)
+    return value, z, flag
+
+
+def _finalize(kv):
+    """Restore the bespoke flow's ``(value, z, is_anomaly)`` item
+    shape from the infer step's float columns."""
+    key, (value, z, flag) = kv
+    return key, (float(value), float(z), bool(flag > 0.5))
+
+
+def anomaly_infer_flow(
+    source,
+    sink: Sink,
+    threshold: float = 3.0,
+    fmt=None,
+) -> Dataflow:
+    """The same anomaly detector as :func:`anomaly_flow`, rebuilt on
+    the streaming-inference subsystem (``op.infer``,
+    docs/inference.md): a plain keyed ``stateful_map`` extracts the
+    pre-update Welford feature row per value and a broadcast-params
+    forward pass scores the batch on the device tier — so the
+    threshold is live-swappable via ``driver.update_params()`` /
+    ``POST /model``.  Output items match the bespoke flow
+    (``tests/test_infer.py`` pins the parity)."""
+    import numpy as np
+
+    flow = Dataflow("anomaly_detector_infer")
+    s = op.input("inp", flow, source)
+    feats = op.stateful_map("welford", s, _welford_features)
+    scored = op.infer(
+        "zscore",
+        feats,
+        _zscore_apply,
+        {"threshold": np.float32(threshold)},
+        host_apply=_zscore_apply_host,
+    )
+    scored = op.map("finalize", scored, _finalize)
     if fmt is not None:
         scored = op.map("fmt", scored, fmt)
     op.output("out", scored, sink)

@@ -1,17 +1,21 @@
-"""Per-key rolling z-score anomaly detection
-(reference: examples/anomaly_detector.py).
+"""Per-key rolling z-score anomaly detection on the streaming
+inference subsystem (docs/inference.md).
 
-Wires the SAME flow the benchmarks measure
-(:func:`bytewax_tpu.models.anomaly.anomaly_flow`) to a demo metric
-source and stdout — the marked :func:`bytewax_tpu.xla.zscore` mapper
-lowers to a segmented-scan device program per micro-batch.
+Wires :func:`bytewax_tpu.models.anomaly.anomaly_infer_flow` to a demo
+metric source and stdout: a keyed ``stateful_map`` extracts the
+pre-update Welford feature row per value and ``op.infer`` scores each
+micro-batch through a jitted forward pass over a broadcast params
+pytree — so the anomaly threshold can be hot-swapped mid-run via
+``POST /model`` without restarting the flow.  Output items are
+identical to the bespoke :func:`~bytewax_tpu.models.anomaly.
+anomaly_flow` (the parity is pinned in ``tests/test_infer.py``).
 """
 
 from datetime import timedelta
 
 from bytewax_tpu.connectors.demo import RandomMetricSource
 from bytewax_tpu.connectors.stdio import StdOutSink
-from bytewax_tpu.models.anomaly import anomaly_flow
+from bytewax_tpu.models.anomaly import anomaly_infer_flow
 
 
 def _fmt(kv):
@@ -20,7 +24,7 @@ def _fmt(kv):
     return f"{key}: value={value:+.3f} z={z:+.2f}{flag}"
 
 
-flow = anomaly_flow(
+flow = anomaly_infer_flow(
     RandomMetricSource(
         "system_metric", interval=timedelta(0), count=200, seed=42
     ),

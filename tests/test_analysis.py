@@ -105,6 +105,36 @@ def test_snapshot_rule_flags_residency_pairing():
     )
 
 
+def test_snapshot_rule_flags_infer_broadcast_state():
+    # The inference subsystem's broadcast-params state is ordinary
+    # device-tier state to the analyzer: reachable from a make_state
+    # factory, it must drain its params row via demotion_snapshots.
+    diags = _diags("fixture_infer_snapshot.py", ["BTX-SNAPSHOT"])
+    assert [d.rule for d in diags] == ["BTX-SNAPSHOT"]
+    assert "BroadcastParamsState" in diags[0].message
+    assert "EagerInferSpec.make_state" in diags[0].message
+    assert "demotion_snapshots" in diags[0].message
+
+
+def test_gsync_rule_flags_per_batch_swap_agreement():
+    # A params-swap vote belongs in the epoch-close "fstat" round; an
+    # infer runtime entering a sync round from its per-batch `update`
+    # (behind a bound-method alias) is the same deadlock shape as any
+    # smuggled collective.
+    diags = _diags("fixture_infer_gsync.py", ["BTX-GSYNC"])
+    reach = [d for d in diags if "per-batch path" in d.message]
+    assert reach, diags
+    assert "EagerSwapInfer.update" in reach[0].message
+    assert "_agree_swap" in reach[0].message  # witness chain
+    source = (FIXTURES / "fixture_infer_gsync.py").read_text()
+    body = "\n".join(
+        line
+        for line in source.splitlines()
+        if not line.lstrip().startswith(("#", '"', "'"))
+    )
+    assert not re.search(r"global_sync\s*\(", body)
+
+
 def test_thread_rule_flags_worker_lane_alias_send():
     diags = _diags("fixture_thread_worker_send.py", ["BTX-THREAD"])
     assert [d.rule for d in diags] == ["BTX-THREAD"]
@@ -214,6 +244,8 @@ def test_new_rule_waiver_round_trip(tmp_path):
         "fixture_knob_uncataloged.py": "BTX-KNOB",
         "fixture_lane_uncataloged.py": "BTX-LANE",
         "fixture_race_alias.py": "BTX-RACE",
+        "fixture_infer_snapshot.py": "BTX-SNAPSHOT",
+        "fixture_infer_gsync.py": "BTX-GSYNC",
     }
     for name, rule in cases.items():
         diags = _diags(name, [rule])

@@ -136,6 +136,11 @@ def test_fault_site_inventory_is_pinned():
     # replays exactly the sealed epoch.  It is NOT a device site,
     # and the whole checkpoint tier is process-local (no new frame
     # kinds, no send-surface growth).
+    # The inference PR added exactly one: params_swap, fired at the
+    # agreed epoch close BEFORE any runtime installs the pending
+    # params and BEFORE the module-level target is consumed — an
+    # injected crash there proves the swap lands exactly once across
+    # a supervised restart (the target survives like the stop flag).
     assert contracts.FAULT_SITES == (
         "comm.send",
         "comm.recv",
@@ -147,6 +152,7 @@ def test_fault_site_inventory_is_pinned():
         "snapshot.commit",
         "snapshot_seal",
         "rescale_migrate",
+        "params_swap",
         "barrier",
     )
     assert contracts.FAULT_DEVICE_SITES == {
@@ -293,6 +299,12 @@ def test_drain_point_inventory_is_pinned():
         # the worker thread — run-ending closes only, like
         # _pipe_shutdown.
         "_ckpt_shutdown",
+        # The inference PR: the broadcast-params swap installs only
+        # at the agreed epoch close (every dispatch pipeline
+        # quiesced, so no in-flight forward pass observes a
+        # half-installed tree).
+        "_apply_params_swap",
+        "install_params",
     }
     assert contracts.PIPELINE_DRAIN_METHODS == {
         "flush",
@@ -302,6 +314,7 @@ def test_drain_point_inventory_is_pinned():
     assert contracts.DRAIN_POINTS == {
         ("bytewax_tpu.engine.driver", "_StatefulBatchRt.advance"),
         ("bytewax_tpu.engine.driver", "_StatefulBatchRt._demote"),
+        ("bytewax_tpu.engine.driver", "_InferRt._demote"),
         ("bytewax_tpu.engine.driver", "_Driver._close_epoch"),
         ("bytewax_tpu.engine.driver", "_Driver._close_epoch_inner"),
         ("bytewax_tpu.engine.driver", "_Driver._drain_pipelines"),
@@ -354,7 +367,10 @@ def test_worker_lane_inventory_is_pinned():
     # async-checkpoint PR's committer task (docs/recovery.md
     # "Asynchronous incremental checkpoints"): one write_epoch over
     # a delta the main thread sealed and froze, at most one in
-    # flight, fenced at the next close/finalize/run-ending close.
+    # flight, fenced at the next close/finalize/run-ending close —
+    # plus the inference PR's scoring task (docs/inference.md): the
+    # sealed batched forward pass on the step's dispatch pipeline,
+    # same lane and fences as the aggregation tiers.
     assert set(roots) == {
         f"{driver}:_StatefulBatchRt._push_window_task.<locals>.task",
         f"{driver}:_StatefulBatchRt._push_scan_task.<locals>.task",
@@ -362,6 +378,7 @@ def test_worker_lane_inventory_is_pinned():
         f"{sharded}:GlobalAggState.flush.<locals>.exchange_task",
         f"{sharded}:GlobalAggState.flush.<locals>.merge_task",
         f"{driver}:_Driver._ckpt_seal.<locals>.commit_task",
+        f"{driver}:_InferRt._push_infer_task.<locals>.task",
     }
     # The committer lane's recovery-store carve-out is exactly that
     # one root, one method, one module — root-scoped, so every other
@@ -466,6 +483,7 @@ def test_lane_catalog_is_pinned():
         (driver, "_Driver.run"),
         (driver, "_Driver._close_epoch_inner"),
         (driver, "_StatefulBatchRt._demote"),
+        (driver, "_InferRt._demote"),
     }
     # Every cataloged ledger phase must be documented in
     # docs/observability.md's phase table — the buckets feed
@@ -515,13 +533,15 @@ def test_shared_state_inventory_is_pinned():
     # inventory exists for the day that changes — extending it means
     # editing contracts.py AND this test.
     assert contracts.SEALED_CAPTURE_SAFE == {}
-    # The two sealed device phases handed back as closures (the
+    # The three sealed device phases handed back as closures (the
     # resolver cannot trace callables through return values).
     assert contracts.RACE_WORKER_CARVEOUTS == {
         "bytewax_tpu.engine.window_accel:"
         "DeviceWindowAggState._ingest.<locals>.device_phase",
         "bytewax_tpu.engine.driver:"
         "_StatefulBatchRt._scan_batch.<locals>.batch_phase",
+        "bytewax_tpu.engine.driver:"
+        "_InferRt._infer_batch.<locals>.batch_phase",
     }
     # Staleness guard: every pinned carve-out root still exists.
     project = _project()
@@ -569,7 +589,10 @@ def test_knob_catalog_is_pinned():
     under a recovery store the overlapped tier writes a compacting
     aggregate baseline row every K data rounds so resume replays at
     most K-1 sealed rounds), anchored at docs/recovery.md
-    "Store-composable overlap"."""
+    "Store-composable overlap".  The inference PR added exactly one:
+    BYTEWAX_TPU_INFER_DEVICE (default 1 — 0 forces op.infer steps
+    onto the host numpy apply without disabling any other device
+    tier), anchored at docs/inference.md."""
     assert sorted(contracts.KNOBS) == [
         "BYTEWAX_TPU_ACCEL",
         "BYTEWAX_TPU_ALLOW_REMOTE_STOP",
@@ -605,6 +628,7 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_HB_S",
         "BYTEWAX_TPU_HEARTBEAT_S",
         "BYTEWAX_TPU_HOST_STATE_BUDGET",
+        "BYTEWAX_TPU_INFER_DEVICE",
         "BYTEWAX_TPU_INGEST_TARGET_ROWS",
         "BYTEWAX_TPU_IO_BACKOFF_CAP_S",
         "BYTEWAX_TPU_IO_BACKOFF_S",
@@ -630,7 +654,7 @@ def test_knob_catalog_is_pinned():
         "BYTEWAX_TPU_TRACE_DIR",
         "BYTEWAX_TPU_WIRE",
     ]
-    assert len(contracts.KNOBS) == 58
+    assert len(contracts.KNOBS) == 59
     for name, (default, doc) in contracts.KNOBS.items():
         assert isinstance(default, str), name
         assert doc.startswith("docs/") and doc.endswith(".md"), name

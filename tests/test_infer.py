@@ -1,0 +1,702 @@
+"""Streaming-inference subsystem tests (``op.infer``,
+docs/inference.md): device-tier scoring against the host numpy
+oracle, broadcast-params recovery, hot swap at the agreed epoch
+close, exactly-once across a supervised restart, demotion, and the
+``POST /model`` control plane.
+
+Faults are injected ONLY through the engine's own injector
+(``BYTEWAX_TPU_FAULTS``) — never by monkeypatching engine internals.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+from collections import defaultdict
+from datetime import timedelta
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.engine import driver as engine_driver
+from bytewax_tpu.engine import faults, flight
+from bytewax_tpu.recovery import RecoveryConfig, init_db_dir
+from bytewax_tpu.testing import TestingSink, TestingSource, run_main
+
+ZERO_TD = timedelta(seconds=0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine_state():
+    """No pending params update or spent fault counters may leak
+    between tests (both are module-level by design — that survival is
+    the exactly-once mechanism under supervised restarts)."""
+    faults.reset()
+    engine_driver.reset_params_update()
+    yield
+    faults.reset()
+    engine_driver.reset_params_update()
+
+
+def _linear_apply(params, x):
+    # Works unchanged under jit (jax arrays) and numpy (host tier).
+    return x[:, 0] * params["w"] + params["b"]
+
+
+# -- oracle parity under every entry point ------------------------------
+
+
+def test_infer_matches_host_oracle(entry_point):
+    inp = [(f"k{i % 5}", float(i)) for i in range(40)]
+    out = []
+    flow = Dataflow("infer_parity_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=8))
+    s = op.infer(
+        "score",
+        s,
+        _linear_apply,
+        {"w": np.float32(3.0), "b": np.float32(1.0)},
+    )
+    op.output("out", s, TestingSink(out))
+    entry_point(flow, epoch_interval=ZERO_TD)
+    # 3*i + 1 is exact in float32 for this range, so the device path
+    # must equal the oracle bit-for-bit.
+    want = sorted((k, v * 3.0 + 1.0) for k, v in inp)
+    assert sorted(out) == want
+
+
+def test_infer_multi_feature_tuple_output(entry_point):
+    def apply(params, x):
+        base = x[:, 0] * params["w"][0] + x[:, 1] * params["w"][1]
+        return base, base * 2.0
+
+    inp = [(f"k{i % 3}", (float(i), float(i % 5))) for i in range(30)]
+    out = []
+    flow = Dataflow("infer_multi_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=6))
+    s = op.infer(
+        "score", s, apply, {"w": np.array([2.0, 4.0], np.float32)}
+    )
+    op.output("out", s, TestingSink(out))
+    entry_point(flow, epoch_interval=ZERO_TD)
+    want = sorted(
+        (k, (a * 2.0 + b * 4.0, (a * 2.0 + b * 4.0) * 2.0))
+        for k, (a, b) in inp
+    )
+    assert sorted(out) == want
+
+
+def test_infer_host_knob_forces_host_apply(monkeypatch):
+    # BYTEWAX_TPU_INFER_DEVICE=0 must route scoring through
+    # host_apply without touching the device tier at all: an apply_fn
+    # that cannot be traced proves the jitted path never runs.
+    monkeypatch.setenv("BYTEWAX_TPU_INFER_DEVICE", "0")
+
+    def poisoned_apply(params, x):  # pragma: no cover - must not run
+        raise AssertionError("device apply ran with the knob off")
+
+    def host_apply(params, x):
+        return x[:, 0] * params["w"] + params["b"]
+
+    inp = [(f"k{i % 3}", float(i)) for i in range(12)]
+    out = []
+    flow = Dataflow("infer_hostknob_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=4))
+    s = op.infer(
+        "score",
+        s,
+        poisoned_apply,
+        {"w": np.float32(5.0), "b": np.float32(2.0)},
+        host_apply=host_apply,
+    )
+    op.output("out", s, TestingSink(out))
+    run_main(flow, epoch_interval=ZERO_TD)
+    assert sorted(out) == sorted((k, v * 5.0 + 2.0) for k, v in inp)
+
+
+# -- the anomaly-detector port ------------------------------------------
+
+
+def test_anomaly_infer_flow_matches_bespoke_oracle():
+    # The op.infer port of the anomaly detector must reproduce the
+    # bespoke stateful_map flow's per-key output streams: values and
+    # anomaly flags exactly, z within float32 tolerance of the
+    # float64 host oracle (the input keeps every |z| far from the
+    # threshold boundary, so flags cannot flap on rounding).
+    import random
+
+    from bytewax_tpu.models.anomaly import anomaly_infer_flow
+    from bytewax_tpu.xla import zscore
+
+    random.seed(7)
+    items = [
+        (k, random.gauss(0.0, 1.0))
+        for _ in range(60)
+        for k in ("a", "b", "c")
+    ]
+    items[100] = ("a", 40.0)  # an unambiguous anomaly
+
+    states = {}
+    oracle = defaultdict(list)
+    mapper = zscore(2.5)
+    for k, v in items:
+        states[k], scored = mapper(states.get(k), v)
+        oracle[k].append(scored)
+
+    out = []
+    run_main(
+        anomaly_infer_flow(
+            TestingSource(list(items)), TestingSink(out), threshold=2.5
+        ),
+        epoch_interval=ZERO_TD,
+    )
+    got = defaultdict(list)
+    for k, v in out:
+        got[k].append(v)
+    assert got.keys() == oracle.keys()
+    for k in oracle:
+        assert len(got[k]) == len(oracle[k])
+        for (vo, zo, ao), (vg, zg, ag) in zip(oracle[k], got[k]):
+            assert math.isclose(vo, vg, rel_tol=1e-6, abs_tol=1e-6)
+            assert abs(zo - zg) <= 1e-3 * max(1.0, abs(zo)), (k, zo, zg)
+            assert ao == ag, (k, vo, zo, ao, ag)
+    assert sum(1 for vs in oracle.values() for (_, _, a) in vs if a) > 0
+
+
+# -- broadcast-params recovery ------------------------------------------
+
+
+def _count_feats(state, value):
+    n = (state or 0) + 1
+    return n, (float(value), float(n))
+
+
+def _count_apply(params, x):
+    return x[:, 0] * params["w"] + x[:, 1]
+
+
+def test_infer_resume_restores_params_and_keyed_state(recovery_config):
+    # Run 1 swaps w 10 -> 20 at its first close; run 2 resumes and
+    # must score with the swapped generation AND the per-key count
+    # state from the upstream stateful_map — recovery covers the
+    # broadcast params and the keyed state together.
+    inp = [("a", 1.0), ("a", 2.0), TestingSource.EOF(), ("a", 3.0)]
+
+    def build(out):
+        flow = Dataflow("infer_resume_df")
+        s = op.input("inp", flow, TestingSource(inp, batch_size=1))
+        s = op.stateful_map("count", s, _count_feats)
+        s = op.infer("score", s, _count_apply, {"w": np.float32(10.0)})
+        op.output("out", s, TestingSink(out))
+        return flow
+
+    engine_driver.update_params({"w": np.float32(20.0)})
+    out = []
+    run_main(
+        build(out),
+        epoch_interval=ZERO_TD,
+        recovery_config=recovery_config,
+    )
+    # Epoch 1 scores with the initial params (the swap lands at the
+    # close, after the delivery); epoch 2 scores with the new ones.
+    assert out == [("a", 1.0 * 10.0 + 1.0), ("a", 2.0 * 20.0 + 2.0)]
+
+    # Resume: no pending update this run — the swapped generation and
+    # the count state must come back from the store.
+    out2 = []
+    run_main(
+        build(out2),
+        epoch_interval=ZERO_TD,
+        recovery_config=recovery_config,
+    )
+    assert out2 == [("a", 3.0 * 20.0 + 3.0)]
+
+
+# -- hot swap at the agreed close ---------------------------------------
+
+
+def test_infer_hot_swap_lands_at_epoch_close(entry_point, monkeypatch):
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    inp = [
+        ("a", 1.0),
+        ("a", 2.0),
+        TestingSource.PAUSE(timedelta(milliseconds=50)),
+        ("a", 3.0),
+        ("a", 4.0),
+    ]
+    out = []
+    flow = Dataflow("infer_swap_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=2))
+    s = op.infer(
+        "score",
+        s,
+        lambda p, x: x[:, 0] * p["w"],
+        {"w": np.float32(10.0)},
+    )
+    op.output("out", s, TestingSink(out))
+
+    swaps_before = flight.RECORDER.counters.get("params_swap_count", 0)
+    digest = engine_driver.update_params({"w": np.float32(100.0)})
+    assert isinstance(digest, str) and len(digest) == 16
+    entry_point(flow, epoch_interval=ZERO_TD)
+
+    # The PAUSE spans an epoch close: the first batch scores with the
+    # old params, everything after the agreed close with the new.
+    assert out == [
+        ("a", 10.0),
+        ("a", 20.0),
+        ("a", 300.0),
+        ("a", 400.0),
+    ]
+    assert (
+        flight.RECORDER.counters.get("params_swap_count", 0)
+        == swaps_before + 1
+    )
+    swaps = [
+        e for e in flight.RECORDER.tail() if e["kind"] == "params_swap"
+    ]
+    assert swaps and swaps[-1]["digest"] == digest
+
+
+def test_infer_swap_targets_step_by_id(monkeypatch):
+    # update_params(step_id=...) accepts the user-level step id and
+    # must swap exactly that step, leaving others untouched.
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    inp = [
+        ("a", 1.0),
+        TestingSource.PAUSE(timedelta(milliseconds=50)),
+        ("a", 2.0),
+    ]
+    out = []
+    flow = Dataflow("infer_target_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=1))
+    s = op.infer(
+        "score", s, lambda p, x: x[:, 0] * p["w"], {"w": np.float32(10.0)}
+    )
+    s = op.infer(
+        "score2", s, lambda p, x: x[:, 0] * p["w"], {"w": np.float32(2.0)}
+    )
+    op.output("out", s, TestingSink(out))
+    engine_driver.update_params(
+        {"w": np.float32(100.0)}, step_id="infer_target_df.score"
+    )
+    run_main(flow, epoch_interval=ZERO_TD)
+    # Item 1 scores 1*10*2; item 2 scores with only "score" swapped:
+    # 2*100*2.
+    assert out == [("a", 20.0), ("a", 400.0)]
+
+
+def test_infer_swap_structure_mismatch_rejected(monkeypatch):
+    # A pending tree that does not match the incumbent structure must
+    # be rejected deterministically at the close: generation stays,
+    # scores stay, and the rejection lands in the flight ring.
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+    inp = [
+        ("a", 1.0),
+        TestingSource.PAUSE(timedelta(milliseconds=50)),
+        ("a", 2.0),
+    ]
+    out = []
+    flow = Dataflow("infer_reject_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=1))
+    s = op.infer(
+        "score", s, lambda p, x: x[:, 0] * p["w"], {"w": np.float32(10.0)}
+    )
+    op.output("out", s, TestingSink(out))
+    engine_driver.update_params({"not_w": np.float32(999.0)})
+    run_main(flow, epoch_interval=ZERO_TD)
+    assert out == [("a", 10.0), ("a", 20.0)]
+    rejected = [
+        e
+        for e in flight.RECORDER.tail()
+        if e["kind"] == "params_swap_rejected"
+    ]
+    assert rejected
+
+
+# -- exactly-once across a supervised restart ---------------------------
+
+
+def test_infer_swap_exactly_once_across_supervised_restart(
+    entry_point, tmp_path, monkeypatch
+):
+    # An injected crash at the pinned params_swap site — fired at the
+    # agreed close BEFORE any runtime installs and BEFORE the pending
+    # target is consumed — unwinds the worker; the supervisor
+    # restarts it, the module-level target survives, and the swap
+    # lands exactly once at the replayed close.  Output must equal a
+    # fault-free run's (the sink truncates the torn epoch).
+    from bytewax_tpu.connectors.files import FileSink
+
+    monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "params_swap:crash:1:x1")
+    monkeypatch.setenv("BYTEWAX_TPU_MAX_RESTARTS", "2")
+    monkeypatch.setenv("BYTEWAX_TPU_RESTART_BACKOFF_S", "0.05")
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+
+    inp = [
+        ("a", 1.0),
+        ("a", 2.0),
+        TestingSource.PAUSE(timedelta(milliseconds=100)),
+        ("a", 3.0),
+    ]
+    out_path = tmp_path / "out.txt"
+    db = tmp_path / "db"
+    db.mkdir()
+    init_db_dir(db, 1)
+
+    flow = Dataflow("infer_chaos_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=1))
+    s = op.infer(
+        "score", s, lambda p, x: x[:, 0] * p["w"], {"w": np.float32(10.0)}
+    )
+    s = op.map("fmt", s, lambda kv: (kv[0], f"{kv[0]}={kv[1]}"))
+    op.output("out", s, FileSink(str(out_path)))
+
+    restarts_before = flight.RECORDER.counters.get(
+        "worker_restart_count", 0
+    )
+    swaps_before = flight.RECORDER.counters.get("params_swap_count", 0)
+    engine_driver.update_params({"w": np.float32(20.0)})
+    entry_point(
+        flow,
+        epoch_interval=ZERO_TD,
+        recovery_config=RecoveryConfig(str(db)),
+    )
+    assert (
+        flight.RECORDER.counters.get("worker_restart_count", 0)
+        == restarts_before + 1
+    )
+    # Exactly once: the crash fired before install AND consume, so
+    # the restarted close swaps a single time — never zero (the
+    # target died with the crash) and never twice (the target was
+    # consumed pre-crash and re-applied).
+    assert (
+        flight.RECORDER.counters.get("params_swap_count", 0)
+        == swaps_before + 1
+    )
+    # Every item scores exactly once, and the single agreed swap
+    # splits the per-key timeline exactly once: item 1 committed
+    # pre-swap (the crash fired before any install), and no item may
+    # score with the old generation after one scored with the new.
+    # WHICH close the replayed items land under is emergent restart
+    # timing — epoch boundaries are not part of the contract here.
+    lines = out_path.read_text().split()
+    assert len(lines) == 3
+    gens = [
+        float(line.split("=")[1]) / (i + 1.0)
+        for i, line in enumerate(lines)
+    ]
+    assert gens[0] == 10.0
+    assert all(w in (10.0, 20.0) for w in gens)
+    assert gens == sorted(gens)
+
+
+# -- demotion carries the swapped generation ----------------------------
+
+
+def test_infer_demotion_preserves_swapped_params(monkeypatch):
+    # Epoch 1 scores on device and the close swaps the params; from
+    # epoch 2 every device dispatch faults, so the step demotes to
+    # host_apply — which must score with the SWAPPED generation (the
+    # demotion snapshot carries the params, BTX-SNAPSHOT pairing).
+    monkeypatch.setenv("BYTEWAX_TPU_FAULTS", "device_dispatch:error:2+")
+    monkeypatch.setenv("BYTEWAX_TPU_DEMOTE_AFTER", "2")
+    monkeypatch.setenv("BYTEWAX_TPU_INGEST_TARGET_ROWS", "0")
+    monkeypatch.setenv("BYTEWAX_FLIGHT_RECORDER", "1")
+
+    def host_apply(params, x):
+        return x[:, 0] * params["w"]
+
+    inp = [("a", float(i)) for i in range(1, 13)]
+    out = []
+    flow = Dataflow("infer_demote_df")
+    s = op.input("inp", flow, TestingSource(inp, batch_size=4))
+    s = op.infer(
+        "score",
+        s,
+        lambda p, x: x[:, 0] * p["w"],
+        {"w": np.float32(10.0)},
+        host_apply=host_apply,
+    )
+    op.output("out", s, TestingSink(out))
+    engine_driver.update_params({"w": np.float32(20.0)})
+    run_main(flow, epoch_interval=ZERO_TD)
+
+    events = [
+        e for e in flight.RECORDER.tail() if e["kind"] == "demotion"
+    ]
+    assert events and events[-1]["step"].startswith(
+        "infer_demote_df.score"
+    )
+    # Batch 1 on device with w=10; batches 2-3 post-swap (w=20), the
+    # later ones scored by host_apply after the demotion.
+    want = [("a", float(i) * 10.0) for i in range(1, 5)] + [
+        ("a", float(i) * 20.0) for i in range(5, 13)
+    ]
+    assert out == want
+
+
+# -- 2-process cluster: the swap commits at one agreed close ------------
+
+
+_CLUSTER_FLOW = '''
+import os
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+
+import bytewax_tpu.operators as op
+from bytewax_tpu.dataflow import Dataflow
+from bytewax_tpu.connectors.files import FileSink
+from bytewax_tpu.engine import driver as engine_driver
+from bytewax_tpu.inputs import DynamicSource, StatelessSourcePartition
+
+
+class _Part(StatelessSourcePartition):
+    def __init__(self, worker_index):
+        self._w = worker_index
+        self._sent = 0
+        self._resume_at = None
+
+    def next_batch(self):
+        now = datetime.now(timezone.utc)
+        if self._sent == 0:
+            self._sent = 1
+            # Pause NON-blocking via next_awake so several epoch
+            # closes run between the two batches — the agreed swap
+            # must commit in that window on every process.
+            self._resume_at = now + timedelta(seconds=0.8)
+            return [(f"w{self._w}", 1.0)]
+        if self._sent == 1:
+            if now < self._resume_at:
+                return []
+            self._sent = 2
+            return [(f"w{self._w}", 2.0)]
+        raise StopIteration()
+
+    def next_awake(self):
+        return self._resume_at if self._sent == 1 else None
+
+
+class PerWorkerSource(DynamicSource):
+    def build(self, step_id, worker_index, worker_count):
+        return _Part(worker_index)
+
+
+# Every process records the same pending update at startup; the swap
+# itself must land at one cluster-agreed epoch close.
+engine_driver.update_params({"w": np.float32(100.0)})
+
+flow = Dataflow("cluster_infer_df")
+s = op.input("inp", flow, PerWorkerSource())
+s = op.infer(
+    "score", s, lambda p, x: x[:, 0] * p["w"], {"w": np.float32(10.0)}
+)
+s = op.map("fmt", s, lambda kv: (kv[0], f"{kv[0]}={kv[1]}"))
+op.output("out", s, FileSink(@OUT_PATH@))
+'''
+
+
+@pytest.mark.slow
+def test_cluster_2proc_swap_agreed_close(tmp_path):
+    out_path = str(tmp_path / "out.txt")
+    flow_py = tmp_path / "cluster_infer_flow.py"
+    flow_py.write_text(_CLUSTER_FLOW.replace("@OUT_PATH@", repr(out_path)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    env["BYTEWAX_TPU_PLATFORM"] = "cpu"
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bytewax_tpu.testing",
+            f"{flow_py}:flow",
+            "-p",
+            "2",
+            "-s",
+            "0.1",
+        ],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = sorted(Path(out_path).read_text().split())
+    # Every worker's first item scored pre-swap and its second item
+    # post-swap: the swap landed at one agreed close on BOTH
+    # processes (a one-sided swap would leave a w*=10.0 second item).
+    assert lines == ["w0=10.0", "w0=200.0", "w1=10.0", "w1=200.0"]
+
+
+# -- POST /model control plane ------------------------------------------
+
+
+def _tiny_flow():
+    flow = Dataflow("model_api_df")
+    s = op.input("inp", flow, TestingSource([("a", 1.0)]))
+    s = op.infer(
+        "score", s, lambda p, x: x[:, 0] * p["w"], {"w": np.float32(1.0)}
+    )
+    op.output("out", s, TestingSink([]))
+    return flow
+
+
+def test_webserver_model_endpoint(tmp_path, monkeypatch):
+    # POST /model records the pending update through model_fn and
+    # answers the digest; malformed bodies are a 400, not a 500; and
+    # without a model_fn the path stays a 404 (no new surface).
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_ENABLED", "1")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_PORT", "0")
+    from bytewax_tpu.engine.webserver import maybe_start_server
+
+    srv = maybe_start_server(
+        _tiny_flow(),
+        model_fn=lambda params, step_id=None: engine_driver.update_params(
+            params, step_id, source="http"
+        ),
+    )
+    assert srv is not None
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        body = json.dumps(
+            {"params": {"w": 42.0}, "step_id": "model_api_df.score"}
+        ).encode()
+        req = urllib.request.Request(
+            base + "/model", data=body, method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=5) as rsp:
+            payload = json.loads(rsp.read())
+        assert payload["accepted"] is True
+        assert isinstance(payload["digest"], str)
+        pending = engine_driver._pending_params()
+        assert pending is not None
+        assert pending[0] == "model_api_df.score"
+        assert pending[1] == payload["digest"]
+
+        # A body without a params pytree records nothing.
+        req = urllib.request.Request(
+            base + "/model", data=b"{}", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc_info.value.code == 400
+    finally:
+        srv.shutdown()
+
+    srv = maybe_start_server(_tiny_flow())
+    assert srv is not None
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/model",
+            data=b'{"params": {"w": 1.0}}',
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc_info.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_webserver_model_requires_loopback_opt_in(tmp_path, monkeypatch):
+    # Same guard as POST /stop: on a non-loopback bind the endpoint
+    # is disabled unless BYTEWAX_TPU_ALLOW_REMOTE_STOP=1 — any
+    # network peer could otherwise re-model the cluster.
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_ENABLED", "1")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_PORT", "0")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_HOST", "0.0.0.0")
+    from bytewax_tpu.engine.webserver import maybe_start_server
+
+    got = []
+    srv = maybe_start_server(
+        _tiny_flow(), model_fn=lambda p, s=None: got.append(p) or "x"
+    )
+    assert srv is not None
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/model",
+            data=b'{"params": {"w": 1.0}}',
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc_info.value.code == 404
+        assert got == []
+    finally:
+        srv.shutdown()
+
+
+# -- observability ------------------------------------------------------
+
+
+def test_status_and_graph_carry_infer(entry_point, monkeypatch, tmp_path):
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_ENABLED", "1")
+    monkeypatch.setenv("BYTEWAX_DATAFLOW_API_PORT", "13061")
+    monkeypatch.chdir(tmp_path)
+
+    captured = {}
+
+    class _ProbePartition:
+        def write_batch(self, items):
+            if "status" not in captured:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:13061/status", timeout=5
+                ) as rsp:
+                    captured["status"] = json.loads(rsp.read())
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:13061/graph", timeout=5
+                ) as rsp:
+                    captured["graph"] = json.loads(rsp.read())
+
+        def close(self):
+            pass
+
+    from bytewax_tpu.outputs import DynamicSink
+
+    class _ProbeSink(DynamicSink):
+        def build(self, step_id, worker_index, worker_count):
+            return _ProbePartition()
+
+    flow = Dataflow("infer_obs_df")
+    s = op.input(
+        "inp",
+        flow,
+        TestingSource([("a", 1.0), ("b", 2.0)], batch_size=2),
+    )
+    s = op.infer(
+        "score", s, lambda p, x: x[:, 0] * p["w"], {"w": np.float32(3.0)}
+    )
+    op.output("out", s, _ProbeSink())
+    entry_point(flow, epoch_interval=ZERO_TD)
+
+    # The probe sink is downstream of the infer step, so by capture
+    # time the step exists and has scored the delivered rows.
+    infer = captured["status"]["infer"]
+    assert len(infer) == 1
+    (step_id,), (view,) = zip(*infer.items())
+    assert step_id.startswith("infer_obs_df.score")
+    assert view["tier"] == "device"
+    assert view["generation"] == 0
+    assert isinstance(view["digest"], str) and len(view["digest"]) == 16
+    assert view["last_swap"] is None
+
+    by_id = {n["step_id"]: n for n in captured["graph"]["steps"]}
+    assert by_id[step_id]["tier"] == "device"
+
+    from bytewax_tpu._metrics import generate_python_metrics
+
+    families = generate_python_metrics()
+    assert "bytewax_infer_rows_count" in families
+    assert "bytewax_infer_params_generation" in families
